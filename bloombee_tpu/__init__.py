@@ -1,0 +1,16 @@
+"""bloombee_tpu: a TPU-native decentralized LLM serving and fine-tuning framework.
+
+Capabilities mirror ai-decentralized/BloomBee (see /root/repo/SURVEY.md): a model's
+transformer blocks are split across a swarm of worker servers; the client holds only
+embeddings + final norm + LM head; decode ships hidden states through a chain of
+servers that keep per-session paged KV caches server-side.
+
+The design is JAX/XLA-first: blocks are pure functions jitted over bucketed static
+shapes, KV lives in a paged device arena updated functionally with donation,
+intra-server parallelism is a `jax.sharding.Mesh` with sharding annotations (XLA
+inserts the collectives), and the inter-server plane is an asyncio wire protocol.
+"""
+
+from bloombee_tpu.version import __version__
+
+__all__ = ["__version__"]

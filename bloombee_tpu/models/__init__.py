@@ -1,0 +1,11 @@
+"""Model families.
+
+Mirrors /root/reference/src/bloombee/models/: each family provides a config
+(HF config -> ModelSpec mapping), a block implementation (pure jax function), and
+weight conversion from HF checkpoints. Registration happens via
+`bloombee_tpu.models.auto` (reference: utils/auto_config.py:82-100).
+"""
+
+from bloombee_tpu.models.spec import ModelSpec
+
+__all__ = ["ModelSpec"]

@@ -1,0 +1,4 @@
+from bloombee_tpu.models.llama.block import block_forward, init_block_params
+from bloombee_tpu.models.llama.config import llama_spec_from_hf
+
+__all__ = ["block_forward", "init_block_params", "llama_spec_from_hf"]

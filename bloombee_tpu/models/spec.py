@@ -1,0 +1,64 @@
+"""ModelSpec: the static shape/hyperparameter description of a model family.
+
+This is the hashable static argument threaded through every jitted function —
+the TPU-native replacement for the reference's Distributed*Config carrying an HF
+config object around (/root/reference/src/bloombee/models/llama/config.py:16-19).
+Keeping it a frozen dataclass of primitives means it can be a `jax.jit` static
+arg and a compilation-cache key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    family: str
+    hidden_size: int
+    intermediate_size: int
+    num_attention_heads: int
+    num_key_value_heads: int
+    head_dim: int
+    num_hidden_layers: int
+    vocab_size: int
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    max_position_embeddings: int = 4096
+    # MoE (Mixtral-style); 0 experts = dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    # Qwen3-style per-head q/k RMSNorm
+    qk_norm: bool = False
+    # Gemma-style sliding-window layers: pattern of layer types, e.g.
+    # ("sliding", "sliding", "full", ...); empty = all full attention.
+    layer_types: tuple[str, ...] = ()
+    sliding_window: int = 0
+    # Falcon/Bloom-style extras
+    alibi: bool = False
+    parallel_attn: bool = False
+    num_ln_in_parallel_attn: int = 0
+    attention_multiplier: float | None = None
+    # Gemma-style logit soft-capping / embedding scaling
+    logits_soft_cap: float = 0.0
+    embedding_multiplier: float = 1.0
+    # Per-layer rope theta override for sliding layers (Gemma3-style)
+    rope_local_theta: float = 0.0
+
+    @property
+    def gqa_groups(self) -> int:
+        return self.num_attention_heads // self.num_key_value_heads
+
+    def layer_type(self, layer_idx: int) -> str:
+        if not self.layer_types:
+            return "full"
+        return self.layer_types[layer_idx % len(self.layer_types)]
+
+    @classmethod
+    def from_hf_config(cls, config: Any) -> "ModelSpec":
+        """Build from a transformers PretrainedConfig (duck-typed)."""
+        from bloombee_tpu.models.auto import spec_from_hf_config
+
+        return spec_from_hf_config(config)

@@ -1,0 +1,23 @@
+"""Core numeric ops: norms, rotary embeddings, attention, MLP.
+
+These replace the reference's TorchDevice kernel collection
+(/root/reference/src/bloombee/flexgen_utils/pytorch_backend.py:285-1081 —
+mha_llama/mha_gen_llama/mlp_llama/rms_norm and rotary helpers). Here each op is a
+pure jax function; XLA fuses elementwise work into the surrounding matmuls, so the
+mha/mha_gen x {gpu,cpu,mixed,compressed} variant matrix collapses into one
+implementation family.
+"""
+
+from bloombee_tpu.ops.norms import rms_norm
+from bloombee_tpu.ops.rotary import apply_rotary, rotary_cos_sin
+from bloombee_tpu.ops.attention import masked_attention, repeat_kv
+from bloombee_tpu.ops.mlp import silu_mlp
+
+__all__ = [
+    "rms_norm",
+    "apply_rotary",
+    "rotary_cos_sin",
+    "masked_attention",
+    "repeat_kv",
+    "silu_mlp",
+]

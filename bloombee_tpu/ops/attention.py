@@ -1,0 +1,59 @@
+"""Attention ops (GQA, arbitrary boolean masks).
+
+Replaces the reference decode/prefill attention kernels
+(/root/reference/src/bloombee/flexgen_utils/pytorch_backend.py:665 `mha_llama`,
+:733 `mha_gen_llama`). One masked implementation covers prefill (causal mask),
+decode (length mask over the paged cache) and speculative tree verify (arbitrary
+tree mask, reference backend.py:596-652) — the mask is data, not code.
+
+Softmax accumulates in fp32; matmuls stay in the input dtype so the MXU runs
+bfloat16 on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, Hkv, hd] -> [B, S, Hkv*n_rep, hd] (GQA share pattern)."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d))
+    return x.reshape(b, s, h * n_rep, d)
+
+
+def masked_attention(
+    q: jax.Array,  # [B, T, H, hd]
+    k: jax.Array,  # [B, S, Hkv, hd]
+    v: jax.Array,  # [B, S, Hkv, hd]
+    mask: jax.Array,  # [B, T, S] bool (True = attend) or [B, 1, T, S]
+    scale: float | None = None,
+) -> jax.Array:
+    """Full masked attention; returns [B, T, H, hd] in q.dtype."""
+    n_rep = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    # [B, H, T, S]
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    if mask.ndim == 3:
+        mask = mask[:, None, :, :]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v)
+    return out
+
+
+def causal_mask(t: int, offset: int = 0, s: int | None = None) -> jax.Array:
+    """[T, S] causal mask: query i (absolute position offset+i) sees keys <= it."""
+    if s is None:
+        s = offset + t
+    q_pos = offset + jnp.arange(t)[:, None]
+    k_pos = jnp.arange(s)[None, :]
+    return k_pos <= q_pos

@@ -1,0 +1,21 @@
+"""Gated MLP (SiLU / SwiGLU).
+
+Replaces /root/reference/src/bloombee/flexgen_utils/pytorch_backend.py:1033
+`mlp_llama`. XLA fuses the elementwise silu/mul into the surrounding matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def silu_mlp(
+    x: jax.Array,
+    gate_w: jax.Array,  # [D, I]
+    up_w: jax.Array,  # [D, I]
+    down_w: jax.Array,  # [I, D]
+) -> jax.Array:
+    g = x @ gate_w
+    u = x @ up_w
+    return (jax.nn.silu(g) * u) @ down_w

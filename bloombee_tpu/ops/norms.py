@@ -1,0 +1,23 @@
+"""Normalization ops.
+
+Matches HF Llama semantics bit-for-bit in fp32 (reference kernel:
+/root/reference/src/bloombee/flexgen_utils/pytorch_backend.py:111 `rms_norm`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm with fp32 accumulation, output cast back to input dtype.
+
+    Order of operations matches HF LlamaRMSNorm: normalize in fp32, cast back to
+    the input dtype, then multiply by the (un-cast) weight.
+    """
+    in_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    return weight * y.astype(in_dtype)

@@ -1,0 +1,51 @@
+"""Rotary position embeddings (RoPE).
+
+Replaces the reference's rotary helpers + CUDA-graphed rotary
+(/root/reference/src/bloombee/flexgen_utils/pytorch_backend.py:59-110,
+/root/reference/src/bloombee/models/llama/block.py:76-81). The CUDA-graph capture
+role is played by `jax.jit`: the whole step is traced once and compiled.
+
+Position ids are explicit everywhere (no module state) because the paged KV design
+and tree speculative decoding both need arbitrary per-token positions
+(reference: backend.py:944-1047 tree rotary position ids).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rotary_cos_sin(
+    positions: jax.Array,  # [..., T] int32 absolute positions
+    head_dim: int,
+    theta: float = 10000.0,
+    dtype: jnp.dtype = jnp.float32,
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given absolute positions; fp32 math like HF."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., T, hd/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [..., T, hd]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary(
+    q: jax.Array,  # [B, T, H, hd]
+    k: jax.Array,  # [B, T, Hkv, hd]
+    cos: jax.Array,  # [B, T, hd]
+    sin: jax.Array,  # [B, T, hd]
+) -> tuple[jax.Array, jax.Array]:
+    """Apply RoPE to q and k (head axis broadcast)."""
+    cos = cos[:, :, None, :].astype(q.dtype)
+    sin = sin[:, :, None, :].astype(q.dtype)
+    q_out = q * cos + _rotate_half(q) * sin
+    k_out = k * cos.astype(k.dtype) + _rotate_half(k) * sin.astype(k.dtype)
+    return q_out, k_out

@@ -1,0 +1,33 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual devices so multi-chip sharding (tp/dp/sp meshes)
+is exercised without TPU hardware — mirrors the reference's tier-1 strategy of
+pure-host unit tests (/root/reference: SURVEY.md section 4).
+
+Env vars must be set before the first jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The machine image's sitecustomize registers a TPU PJRT plugin at interpreter
+# start and rewrites jax_platforms; override it back to CPU before any backend
+# is initialized (config update is honored until first backend use).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    import jax
+
+    return jax.random.PRNGKey(0)
