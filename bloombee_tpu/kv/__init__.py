@@ -1,0 +1,35 @@
+"""Paged KV-cache substrate.
+
+TPU-native redesign of the reference's KV stack
+(/root/reference/src/bloombee/server/paged_kv.py, memory_cache.py,
+memory_cache_manager.py and the FlexGen slab devices in
+flexgen_utils/pytorch_backend.py). The split is control plane vs data plane:
+
+- `PagedKVTable` (host, numpy): page allocator + per-sequence bookkeeping with
+  the reference's commit/rollback/clamped-read invariants. Pure data, no jax.
+- `arena` ops (device, jnp): a per-layer-stacked KV arena updated functionally
+  inside the jitted span step (donated buffers, scatter writes, page gathers) —
+  the in-place slab mutation of the reference becomes XLA donation.
+- `CacheManager`: token-budget admission + handle lifecycle (async, single
+  process) + host-DRAM page tiering (the FlexGen offload capability).
+"""
+
+from bloombee_tpu.kv.paged import PagedKVTable, SeqState
+from bloombee_tpu.kv.arena import (
+    make_arena,
+    arena_write,
+    gather_pages,
+    arena_reorder,
+)
+from bloombee_tpu.kv.cache_manager import CacheManager, CacheHandle
+
+__all__ = [
+    "PagedKVTable",
+    "SeqState",
+    "make_arena",
+    "arena_write",
+    "gather_pages",
+    "arena_reorder",
+    "CacheManager",
+    "CacheHandle",
+]

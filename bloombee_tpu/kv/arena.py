@@ -1,0 +1,87 @@
+"""Device KV arena: the data plane of the paged cache.
+
+Replaces the reference's torch slab + in-place writes
+(/root/reference/src/bloombee/server/memory_cache_manager.py:1373 `_write_kvs`,
+paged_kv.py:137-204 page-at-a-time writes) with functional jnp ops designed to
+live *inside* the jitted span step: the arena is a donated carry, writes are
+scatters, reads are page gathers. XLA turns the donated scatter into an
+in-place HBM update — the slab-write-vs-cat win of the reference's arch reform
+(tests/bench_arch_reform.py) is the default here.
+
+Layout: per layer, a flat slot dimension of num_pages * page_size tokens:
+    k, v: [L, num_pages * page_size, n_kv_heads, head_dim]
+Slot ids come from the host-side PagedKVTable (page * page_size + offset).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_arena(
+    num_layers: int,
+    num_pages: int,
+    page_size: int,
+    n_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+) -> dict:
+    shape = (num_layers, num_pages * page_size, n_kv_heads, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def arena_write(
+    k_layer: jax.Array,  # [S_tot, n_kv, hd] one layer's slab
+    v_layer: jax.Array,
+    slots: jax.Array,  # [N] int32 flat slot ids
+    k_new: jax.Array,  # [N, n_kv, hd]
+    v_new: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter new KV rows into a layer slab (functional; donate the slab).
+
+    Out-of-bounds slot ids are dropped — the span step points padding rows at
+    slot == num_slots to discard their writes.
+    """
+    k_layer = k_layer.at[slots].set(k_new.astype(k_layer.dtype), mode="drop")
+    v_layer = v_layer.at[slots].set(v_new.astype(v_layer.dtype), mode="drop")
+    return k_layer, v_layer
+
+
+def gather_pages(
+    layer_slab: jax.Array,  # [S_tot, n_kv, hd]
+    page_table: jax.Array,  # [B, max_pages] int32
+    page_size: int,
+) -> jax.Array:
+    """Gather each sequence's pages: returns [B, max_pages*page_size, n_kv, hd].
+
+    Invalid (padding) pages gather garbage rows; callers mask by context
+    length — the clamped-read invariant lives in the attention mask, mirroring
+    the reference's gather_prefix clamp (paged_kv.py:265-316).
+    """
+    b, max_pages = page_table.shape
+    slots = (
+        page_table[:, :, None] * page_size
+        + jnp.arange(page_size, dtype=page_table.dtype)[None, None, :]
+    ).reshape(b, max_pages * page_size)
+    return layer_slab[slots]
+
+
+def arena_reorder(
+    k_layer: jax.Array,
+    v_layer: jax.Array,
+    src_slots: jax.Array,  # [N] gather sources (surviving speculative slots)
+    dst_slots: jax.Array,  # [N] scatter destinations (compacted prefix slots)
+) -> tuple[jax.Array, jax.Array]:
+    """Compact surviving speculative KV rows onto the committed prefix.
+
+    The reference does this with a background reorder thread
+    (memory_cache_manager.py:2011-2160 update_cache_and_async_reorder); here it
+    is a single on-device gather+scatter fused into the step that needs it —
+    SURVEY.md section 7 'hard parts' #2 recommends exactly this.
+    `src_slots == dst_slots` rows are no-ops (gather-before-scatter semantics:
+    all reads happen from the pre-update slab).
+    """
+    k_rows = k_layer[src_slots]
+    v_rows = v_layer[src_slots]
+    return k_layer.at[dst_slots].set(k_rows), v_layer.at[dst_slots].set(v_rows)

@@ -1,0 +1,228 @@
+"""CacheManager: token-budget admission + session cache lifecycle + host tiering.
+
+TPU-native replacement for the reference's MemoryCache + KVCacheManager pair
+(/root/reference/src/bloombee/server/memory_cache.py:83-460,
+memory_cache_manager.py:28-2160). The reference splits allocation across
+handler processes and a runtime process via pipes and shared mp.Values; the
+JAX runtime is process-hostile, so here everything is one asyncio process and
+the cross-process machinery collapses into an asyncio.Condition.
+
+Capabilities kept:
+- token-budget admission with timeout (memory_cache.py `_schedule_alloc`)
+- handle -> per-sequence cache state, freed on context exit
+- speculative write / commit / rollback via the PagedKVTable
+- HBM <-> host-DRAM tiering at page granularity (the FlexGen offload
+  capability, flexgen_utils/pytorch_backend.py TorchMixedDevice) via
+  `park_sequence` / `unpark_sequence`: a parked sequence's KV moves to host
+  numpy and its device pages are freed for other sessions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import itertools
+import time
+from typing import Iterator
+
+import jax
+import numpy as np
+
+from bloombee_tpu.kv import arena as arena_ops
+from bloombee_tpu.kv.paged import PagedKVTable
+
+
+class AllocationTimeout(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class CacheHandle:
+    handle_id: int
+    seq_ids: list[int]
+    max_length: int
+
+    @property
+    def batch_size(self) -> int:
+        return len(self.seq_ids)
+
+
+class CacheManager:
+    def __init__(
+        self,
+        num_layers: int,
+        num_pages: int,
+        page_size: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype=None,
+    ):
+        import jax.numpy as jnp
+
+        dtype = dtype or jnp.bfloat16
+        self.table = PagedKVTable(num_pages, page_size)
+        self.arena = arena_ops.make_arena(
+            num_layers, num_pages, page_size, n_kv_heads, head_dim, dtype
+        )
+        self.num_layers = num_layers
+        self.page_size = page_size
+        self.capacity_tokens = num_pages * page_size
+        self._reserved_tokens = 0
+        self._cond: asyncio.Condition | None = None
+        self._seq_counter = itertools.count()
+        self._handle_counter = itertools.count()
+        self._parked: dict[int, tuple[np.ndarray, np.ndarray, int, int]] = {}
+
+    # reference: ServerInfo.cache_tokens_left (handler.py:3256-3273 rpc_info)
+    @property
+    def tokens_left(self) -> int:
+        return self.capacity_tokens - self._reserved_tokens
+
+    def _condition(self) -> asyncio.Condition:
+        if self._cond is None:
+            self._cond = asyncio.Condition()
+        return self._cond
+
+    # ------------------------------------------------------------- admission
+    @contextlib.asynccontextmanager
+    async def allocate(
+        self, batch_size: int, max_length: int, timeout: float | None = None
+    ):
+        """Async context manager reserving `batch_size * max_length` tokens.
+
+        Mirrors KVCacheManager.allocate_cache (memory_cache_manager.py:391-420):
+        blocks until the budget fits or the timeout elapses; frees everything
+        on exit.
+        """
+        # charge page-granular budget: a sequence of max_length tokens pins
+        # ceil(max_length / page_size) whole pages
+        per_seq = -(-max_length // self.page_size) * self.page_size
+        need = batch_size * per_seq
+        if need > self.capacity_tokens:
+            raise AllocationTimeout(
+                f"request for {need} tokens exceeds capacity "
+                f"{self.capacity_tokens}"
+            )
+        cond = self._condition()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        async with cond:
+            while self._reserved_tokens + need > self.capacity_tokens:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise AllocationTimeout(
+                            f"timed out waiting for {need} cache tokens"
+                        )
+                try:
+                    await asyncio.wait_for(cond.wait(), remaining)
+                except asyncio.TimeoutError:
+                    raise AllocationTimeout(
+                        f"timed out waiting for {need} cache tokens"
+                    ) from None
+            self._reserved_tokens += need
+        handle = CacheHandle(
+            handle_id=next(self._handle_counter),
+            seq_ids=[next(self._seq_counter) for _ in range(batch_size)],
+            max_length=max_length,
+        )
+        for sid in handle.seq_ids:
+            self.table.add_seq(sid)
+        try:
+            yield handle
+        finally:
+            for sid in handle.seq_ids:
+                if self.table.has_seq(sid):
+                    self.table.drop_seq(sid)
+                self._parked.pop(sid, None)
+            async with cond:
+                self._reserved_tokens -= need
+                cond.notify_all()
+
+    # ----------------------------------------------------------- device plans
+    def write_slots(
+        self, handle: CacheHandle, num_tokens: int, commit: bool = True
+    ) -> np.ndarray:
+        """[B * num_tokens] flat slots for this step's new tokens (row-major
+        batch-then-token order, matching hidden.reshape(B*T, ...)).
+
+        Atomic across the batch: page availability is pre-checked so a
+        mid-batch OutOfPages cannot leave earlier sequences claiming tokens
+        that were never written.
+        """
+        table = self.table
+        need = 0
+        for sid in handle.seq_ids:
+            st = table.seq(sid)
+            need += max(
+                0,
+                -(-(st.l_seq + num_tokens) // self.page_size)
+                - len(st.pages),
+            )
+        if need > table.free_pages:
+            from bloombee_tpu.kv.paged import OutOfPages
+
+            raise OutOfPages(
+                f"batch write needs {need} pages, only "
+                f"{table.free_pages} free"
+            )
+        return np.concatenate(
+            [
+                table.assign_write_slots(sid, num_tokens, commit=commit)
+                for sid in handle.seq_ids
+            ]
+        )
+
+    def page_table(self, handle: CacheHandle, max_pages: int) -> np.ndarray:
+        return self.table.page_table(handle.seq_ids, max_pages)
+
+    def context_lens(
+        self, handle: CacheHandle, committed_only: bool = False
+    ) -> np.ndarray:
+        return self.table.context_lens(handle.seq_ids, committed_only)
+
+    def commit(self, handle: CacheHandle, lengths: list[int] | None = None):
+        for i, sid in enumerate(handle.seq_ids):
+            self.table.commit(sid, None if lengths is None else lengths[i])
+
+    def rollback(self, handle: CacheHandle):
+        for sid in handle.seq_ids:
+            self.table.rollback(sid)
+
+    # ------------------------------------------------------- host tiering
+    def park_sequence(self, seq_id: int) -> None:
+        """Move one sequence's KV to host DRAM and free its device pages.
+
+        Lengths are preserved; `unpark_sequence` restores (possibly to
+        different pages). This is the paged equivalent of the reference's
+        micro-batch KV offload to CPU staging
+        (memory_cache_manager.py:972-1335).
+        """
+        slots = self.table.prefix_slots(seq_id, committed_only=False)
+        state = self.table.seq(seq_id)
+        k_host = np.asarray(self.arena["k"][:, slots])  # [L, n, kv, hd]
+        v_host = np.asarray(self.arena["v"][:, slots])
+        self._parked[seq_id] = (k_host, v_host, state.l_acc, state.l_seq)
+        # free device pages but keep the seq registered with zero length
+        state.l_acc = 0
+        state.l_seq = 0
+        self.table.rollback(seq_id)
+
+    def unpark_sequence(self, seq_id: int) -> None:
+        import jax.numpy as jnp
+
+        k_host, v_host, l_acc, l_seq = self._parked[seq_id]
+        state = self.table.seq(seq_id)
+        assert state.l_seq == 0, "unpark target must be empty"
+        # may raise OutOfPages: the parked host copy must survive a failed
+        # attempt, so only drop it once slots are secured
+        slots_np = self.table.assign_write_slots(seq_id, l_seq, commit=False)
+        del self._parked[seq_id]
+        state.l_acc = l_acc
+        slots = jnp.asarray(slots_np)
+        self.arena["k"] = self.arena["k"].at[:, slots].set(jnp.asarray(k_host))
+        self.arena["v"] = self.arena["v"].at[:, slots].set(jnp.asarray(v_host))
+
+    def parked_seqs(self) -> Iterator[int]:
+        return iter(self._parked)

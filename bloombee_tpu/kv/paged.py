@@ -1,0 +1,186 @@
+"""Paged KV table: host-side control plane.
+
+Ports the *invariants* of the reference's PagedKVTable
+(/root/reference/src/bloombee/server/paged_kv.py:52-317): page-granular
+allocation (default page size 16, :35), per-sequence page lists, committed
+length `l_acc` vs speculative length `l_seq`, `commit`/`rollback` freeing
+orphaned pages (:235-261), and prefix reads clamped to `l_acc` (:265-316).
+
+The design differs from the reference in one deliberate way: this table never
+touches tensors. The reference's `write` moves KV bytes page-at-a-time into a
+torch slab (:137-204); here the table only *assigns slots* —
+`assign_write_slots` returns flat arena slot indices that the jitted device
+step scatters into (see bloombee_tpu/kv/arena.py). The reference's
+`track_write` state-only mirror (:206-231) is therefore the native operation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+DEFAULT_PAGE_SIZE = 16
+
+
+class OutOfPages(RuntimeError):
+    """Raised when the arena has no free pages for a reservation."""
+
+
+@dataclasses.dataclass
+class SeqState:
+    pages: list[int]
+    l_acc: int = 0  # committed token count
+    l_seq: int = 0  # total written (committed + speculative)
+
+
+class PagedKVTable:
+    """Page allocator + per-sequence length bookkeeping (host side)."""
+
+    def __init__(self, num_pages: int, page_size: int = DEFAULT_PAGE_SIZE):
+        if num_pages <= 0 or page_size <= 0:
+            raise ValueError("num_pages and page_size must be positive")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+        self._seqs: dict[int, SeqState] = {}
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def free_tokens(self) -> int:
+        return len(self._free) * self.page_size
+
+    def has_seq(self, seq_id: int) -> bool:
+        return seq_id in self._seqs
+
+    def seq(self, seq_id: int) -> SeqState:
+        return self._seqs[seq_id]
+
+    def add_seq(self, seq_id: int) -> None:
+        if seq_id in self._seqs:
+            raise ValueError(f"sequence {seq_id} already exists")
+        self._seqs[seq_id] = SeqState(pages=[])
+
+    def drop_seq(self, seq_id: int) -> None:
+        state = self._seqs.pop(seq_id)
+        self._free.extend(state.pages)
+
+    # ------------------------------------------------------------ allocation
+    def _pages_for(self, tokens: int) -> int:
+        return -(-tokens // self.page_size)
+
+    def reserve(self, seq_id: int, new_total_len: int) -> None:
+        """Grow the sequence's page list to cover `new_total_len` tokens."""
+        state = self._seqs[seq_id]
+        need = self._pages_for(new_total_len) - len(state.pages)
+        if need <= 0:
+            return
+        if need > len(self._free):
+            raise OutOfPages(
+                f"need {need} pages, only {len(self._free)} free"
+            )
+        for _ in range(need):
+            state.pages.append(self._free.pop())
+
+    # --------------------------------------------------------------- writing
+    def assign_write_slots(
+        self, seq_id: int, num_tokens: int, commit: bool = True
+    ) -> np.ndarray:
+        """Assign flat arena slots for the next `num_tokens` tokens.
+
+        Tokens land at positions [l_seq, l_seq + num_tokens); reserves pages
+        as needed. `commit=False` marks them speculative (rollback-able),
+        mirroring the reference write(commit=...) flag (paged_kv.py:137-204).
+        Returns int32 flat slot ids (page * page_size + offset).
+        """
+        state = self._seqs[seq_id]
+        start = state.l_seq
+        self.reserve(seq_id, start + num_tokens)
+        positions = np.arange(start, start + num_tokens)
+        pages = np.asarray(state.pages, dtype=np.int64)[
+            positions // self.page_size
+        ]
+        slots = pages * self.page_size + positions % self.page_size
+        state.l_seq = start + num_tokens
+        if commit:
+            if state.l_acc != start:
+                raise ValueError(
+                    "committed write must follow the committed prefix "
+                    f"(l_acc={state.l_acc}, write starts at {start})"
+                )
+            state.l_acc = state.l_seq
+        return slots.astype(np.int32)
+
+    # ------------------------------------------------------ commit / rollback
+    def commit(self, seq_id: int, length: int | None = None) -> None:
+        """Promote speculative tokens to committed; free pages past the end.
+
+        `length` defaults to l_seq (commit everything written). Mirrors
+        paged_kv.py:235-246.
+        """
+        state = self._seqs[seq_id]
+        if length is None:
+            length = state.l_seq
+        if not (state.l_acc <= length <= state.l_seq):
+            raise ValueError(
+                f"commit length {length} outside [{state.l_acc}, {state.l_seq}]"
+            )
+        state.l_acc = length
+        state.l_seq = length
+        self._trim(state)
+
+    def rollback(self, seq_id: int) -> None:
+        """Discard speculative tokens; free orphaned pages
+        (paged_kv.py:247-261)."""
+        state = self._seqs[seq_id]
+        state.l_seq = state.l_acc
+        self._trim(state)
+
+    def _trim(self, state: SeqState) -> None:
+        keep = self._pages_for(max(state.l_seq, state.l_acc))
+        while len(state.pages) > keep:
+            self._free.append(state.pages.pop())
+
+    # ---------------------------------------------------------- device plans
+    def page_table(
+        self, seq_ids: list[int], max_pages: int
+    ) -> np.ndarray:
+        """[B, max_pages] int32 page ids, padded with 0 (masked by length)."""
+        out = np.zeros((len(seq_ids), max_pages), dtype=np.int32)
+        for i, sid in enumerate(seq_ids):
+            pages = self._seqs[sid].pages
+            if len(pages) > max_pages:
+                raise ValueError(
+                    f"sequence {sid} has {len(pages)} pages > bucket {max_pages}"
+                )
+            out[i, : len(pages)] = pages
+        return out
+
+    def context_lens(
+        self, seq_ids: list[int], committed_only: bool = False
+    ) -> np.ndarray:
+        """Per-sequence visible lengths; `committed_only` clamps to l_acc —
+        the reference's gather_prefix clamp (paged_kv.py:265-316)."""
+        return np.asarray(
+            [
+                self._seqs[s].l_acc if committed_only else self._seqs[s].l_seq
+                for s in seq_ids
+            ],
+            dtype=np.int32,
+        )
+
+    def prefix_slots(self, seq_id: int, committed_only: bool = True) -> np.ndarray:
+        """Flat slot ids of the sequence prefix, clamped to l_acc by default."""
+        state = self._seqs[seq_id]
+        n = state.l_acc if committed_only else state.l_seq
+        positions = np.arange(n)
+        pages = np.asarray(state.pages, dtype=np.int64)[
+            positions // self.page_size
+        ]
+        return (pages * self.page_size + positions % self.page_size).astype(
+            np.int32
+        )

@@ -1,0 +1,15 @@
+"""Server-side compute runtime: the jitted span step and its executor.
+
+Replaces the reference's TransformerBackend + hivemind Runtime + task-pool
+machinery (/root/reference/src/bloombee/server/backend.py:62-1399,
+task_pool.py:30-236). The reference routes every request through MPFuture
+queues into a separate runtime process; the JAX runtime is process-hostile,
+so here a span of blocks is ONE jitted function (`span_step`) — a lax.scan
+over stacked per-layer params with the KV arena as a donated carry — and the
+executor handles bucketed compilation + host-side plumbing.
+"""
+
+from bloombee_tpu.runtime.step import span_step
+from bloombee_tpu.runtime.executor import SpanExecutor
+
+__all__ = ["span_step", "SpanExecutor"]

@@ -1,0 +1,140 @@
+"""SpanExecutor: host-side orchestration around the jitted span step.
+
+Covers the roles of the reference's TransformerBackend.inference_step plumbing
+(/root/reference/src/bloombee/server/backend.py:487-789): cache select/update,
+mask choice, chunked prefill (`_estimate_max_chunk_length`, backend.py:839-845)
+— but with bucketed static shapes instead of dynamic ones. Each distinct
+(batch, tokens, pages) bucket compiles once; subsequent steps reuse the cached
+executable (the CUDA-graph role of the reference's cuda_graphs.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import ml_dtypes
+
+from bloombee_tpu.kv.cache_manager import CacheHandle, CacheManager
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.runtime.step import pack_plan, span_step
+
+
+def next_pow2(n: int, floor: int = 1) -> int:
+    v = floor
+    while v < n:
+        v *= 2
+    return v
+
+
+class SpanExecutor:
+    def __init__(
+        self,
+        stacked_params: dict,
+        spec: ModelSpec,
+        manager: CacheManager,
+        max_chunk_tokens: int = 512,
+        compute_dtype=jnp.bfloat16,
+    ):
+        self.params = stacked_params
+        self.spec = spec
+        self.manager = manager
+        self.max_chunk_tokens = max_chunk_tokens
+        self.compute_dtype = compute_dtype
+        # ship hidden states over the host link at half width when computing
+        # in bf16 (transfer latency/bandwidth is the bottleneck; SURVEY.md
+        # section 3.3 timing decomposition)
+        self._transfer_dtype = (
+            ml_dtypes.bfloat16 if compute_dtype == jnp.bfloat16 else np.float32
+        )
+        self.page_size = manager.page_size
+
+    # ------------------------------------------------------------------ steps
+    def prefill(
+        self, handle: CacheHandle, hidden: np.ndarray, commit: bool = True
+    ) -> np.ndarray:
+        """Run full-sequence prefill, chunked to bound attention logits memory
+        (reference: backend.py:525-531 chunked inference)."""
+        outs = []
+        t = hidden.shape[1]
+        for start in range(0, t, self.max_chunk_tokens):
+            chunk = hidden[:, start : start + self.max_chunk_tokens]
+            outs.append(self._step(handle, chunk, commit=commit))
+        return np.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
+
+    def decode(
+        self,
+        handle: CacheHandle,
+        hidden: np.ndarray,
+        commit: bool = True,
+        tree_mask: np.ndarray | None = None,
+    ) -> np.ndarray:
+        return self._step(handle, hidden, commit=commit, tree_mask=tree_mask)
+
+    # --------------------------------------------------------------- internals
+    def _step(
+        self,
+        handle: CacheHandle,
+        hidden: np.ndarray,
+        commit: bool,
+        tree_mask: np.ndarray | None = None,
+    ) -> np.ndarray:
+        spec = self.spec
+        b, t, d = hidden.shape
+        assert d == spec.hidden_size
+
+        starts = self.manager.context_lens(handle)  # [B] before write
+        slots = self.manager.write_slots(handle, t, commit=commit)  # [B*T]
+        total_lens = self.manager.context_lens(handle)  # [B] after write
+
+        # buckets; tree steps keep T exact — the tree mask's key-position
+        # arithmetic in step._attend_paged assumes the written token count
+        # equals T (tree shapes are already bucketed by the drafter)
+        bb = next_pow2(b)
+        tb = t if (t == 1 or tree_mask is not None) else next_pow2(t)
+        arena_tokens = self.manager.arena["k"].shape[1]
+        pages_needed = int(
+            max(-(-int(l) // self.page_size) for l in total_lens)
+        )
+        pb = min(
+            next_pow2(max(pages_needed, 1), floor=4),
+            arena_tokens // self.page_size,
+        )
+
+        oob = arena_tokens  # out-of-bounds slot => dropped write
+        h_pad = np.zeros((bb, tb, d), dtype=np.float32)
+        h_pad[:b, :t] = hidden
+        slots_pad = np.full((bb, tb), oob, dtype=np.int32)
+        slots_pad[:b, :t] = slots.reshape(b, t)
+        positions = np.zeros((bb, tb), dtype=np.int32)
+        for i in range(b):
+            positions[i, :t] = np.arange(starts[i], starts[i] + t)
+        pt_pad = np.zeros((bb, pb), dtype=np.int32)
+        pt_pad[:b] = self.manager.page_table(handle, pb)
+        lens_pad = np.zeros((bb,), dtype=np.int32)
+        lens_pad[:b] = total_lens
+        plan = pack_plan(slots_pad, pt_pad, positions, lens_pad)
+        tm_pad = None
+        if tree_mask is not None:
+            tm_pad = np.zeros((bb, tb, tb), dtype=bool)
+            tm_pad[:b, :t, :t] = tree_mask
+
+        arena = self.manager.arena
+        out, new_k, new_v = span_step(
+            self.params,
+            arena["k"],
+            arena["v"],
+            jnp.asarray(h_pad.astype(self._transfer_dtype)).astype(
+                self.compute_dtype
+            ),
+            jnp.asarray(plan),
+            jnp.asarray(tm_pad) if tm_pad is not None else None,
+            spec=spec,
+            page_size=self.page_size,
+            max_pages=pb,
+            use_tree_mask=tree_mask is not None,
+        )
+        self.manager.arena = {"k": new_k, "v": new_v}
+        return np.asarray(out[:b, :t]).astype(np.float32)

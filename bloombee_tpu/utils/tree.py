@@ -1,0 +1,18 @@
+"""Pytree helpers."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def stack_params(per_layer: list) -> dict:
+    """Stack per-layer param pytrees into one pytree with leading dim L
+    (the lax.scan layout used by runtime.step.span_step)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+
+
+def unstack_params(stacked: dict, num_layers: int) -> list:
+    return [
+        jax.tree.map(lambda x: x[i], stacked) for i in range(num_layers)
+    ]

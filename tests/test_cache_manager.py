@@ -1,0 +1,109 @@
+"""CacheManager admission control + host tiering.
+
+Ports the intent of /root/reference/tests/test_cache.py (token budget,
+blocking allocation, timeout) onto the asyncio single-process design.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bloombee_tpu.kv.cache_manager import AllocationTimeout, CacheManager
+
+
+def make_manager(**kw):
+    defaults = dict(
+        num_layers=2, num_pages=8, page_size=4, n_kv_heads=1, head_dim=4,
+        dtype=jnp.float32,
+    )
+    defaults.update(kw)
+    return CacheManager(**defaults)
+
+
+def test_allocation_budget_and_release():
+    async def run():
+        m = make_manager()  # capacity 32 tokens
+        async with m.allocate(batch_size=2, max_length=8) as h:
+            assert m.tokens_left == 16
+            assert h.batch_size == 2
+            async with m.allocate(1, 16):
+                assert m.tokens_left == 0
+        assert m.tokens_left == 32
+        assert m.table.free_pages == 8  # seqs dropped, pages freed
+
+    asyncio.run(run())
+
+
+def test_oversized_request_rejected():
+    async def run():
+        m = make_manager()
+        with pytest.raises(AllocationTimeout):
+            async with m.allocate(1, 33):
+                pass
+
+    asyncio.run(run())
+
+
+def test_allocation_blocks_until_free():
+    async def run():
+        m = make_manager()
+        order = []
+
+        async def first():
+            async with m.allocate(1, 32):
+                order.append("first-in")
+                await asyncio.sleep(0.05)
+            order.append("first-out")
+
+        async def second():
+            await asyncio.sleep(0.01)
+            async with m.allocate(1, 8):
+                order.append("second-in")
+
+        await asyncio.gather(first(), second())
+        assert order == ["first-in", "first-out", "second-in"]
+
+    asyncio.run(run())
+
+
+def test_allocation_timeout():
+    async def run():
+        m = make_manager()
+        async with m.allocate(1, 32):
+            with pytest.raises(AllocationTimeout):
+                async with m.allocate(1, 8, timeout=0.05):
+                    pass
+
+    asyncio.run(run())
+
+
+def test_park_unpark_roundtrip():
+    async def run():
+        m = make_manager()
+        rng = np.random.default_rng(0)
+        async with m.allocate(1, 16) as h:
+            sid = h.seq_ids[0]
+            k_new = rng.normal(size=(6, 1, 4)).astype(np.float32)
+            v_new = rng.normal(size=(6, 1, 4)).astype(np.float32)
+            slots = jnp.asarray(m.write_slots(h, 6))
+            for layer in range(m.num_layers):
+                m.arena["k"] = (
+                    m.arena["k"].at[layer, slots].set(jnp.asarray(k_new))
+                )
+                m.arena["v"] = (
+                    m.arena["v"].at[layer, slots].set(jnp.asarray(v_new))
+                )
+            pages_before = m.table.free_pages
+            m.park_sequence(sid)
+            assert m.table.free_pages == pages_before + 2  # device pages freed
+            m.unpark_sequence(sid)
+            assert m.table.seq(sid).l_acc == 6
+            got = np.asarray(
+                m.arena["k"][0][jnp.asarray(m.table.prefix_slots(sid))]
+            )
+            np.testing.assert_array_equal(got, k_new)
+
+    asyncio.run(run())

