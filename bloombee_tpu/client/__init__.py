@@ -1,0 +1,17 @@
+"""Client: embeddings + LM head locally, blocks via the swarm.
+
+Mirrors /root/reference/src/bloombee/client/ — RemoteSequenceManager
+(routing), InferenceSession (stateful decode with retry/re-route/replay), and
+the distributed model facade with generate(). All client math is jax (runs on
+CPU or any accelerator — the reference's `device='xla'` goal).
+"""
+
+from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+from bloombee_tpu.client.session import InferenceSession
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+
+__all__ = [
+    "RemoteSequenceManager",
+    "InferenceSession",
+    "DistributedModelForCausalLM",
+]

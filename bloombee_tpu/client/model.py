@@ -1,0 +1,175 @@
+"""Distributed model facade: embeddings + span chain + norm + LM head.
+
+Equivalent of /root/reference/src/bloombee/models/*/model.py
+(Distributed*ForCausalLM) + RemoteGenerationMixin
+(client/remote_generation.py:104-402). Client math is pure jax (jitted embed
+and head), so it runs on CPU or any accelerator — the reference's
+`device='xla'` goal of needing no GPU anywhere.
+
+`generate` is the fast greedy/sampling loop (reference `_fast_generate_greedy`
+bypasses HF GenerationMixin, remote_generation.py:286-386); resuming a session
+across calls mirrors `session.output_ids` resume (:182-216).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+from bloombee_tpu.client.session import InferenceSession
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.ops import rms_norm
+
+
+@functools.partial(jax.jit, static_argnames=("embedding_multiplier",))
+def _embed(embed_w, input_ids, embedding_multiplier: float = 1.0):
+    h = embed_w[input_ids]
+    if embedding_multiplier != 1.0:
+        h = h * embedding_multiplier
+    return h
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "soft_cap"))
+def _norm_head(norm_w, head_w, hidden, eps: float, soft_cap: float = 0.0):
+    h = rms_norm(hidden, norm_w, eps)
+    logits = (h @ head_w).astype(jnp.float32)
+    if soft_cap:
+        logits = jnp.tanh(logits / soft_cap) * soft_cap
+    return logits
+
+
+class DistributedModelForCausalLM:
+    """Client-side model: local embed/norm/head + remote block chain."""
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        client_params: dict,
+        manager: RemoteSequenceManager,
+        use_push: bool = True,
+    ):
+        self.spec = spec
+        self.params = client_params
+        self.manager = manager
+        self.use_push = use_push
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        model_dir: str,
+        registry,
+        model_uid: str | None = None,
+        dtype=None,
+        use_push: bool = True,
+    ) -> "DistributedModelForCausalLM":
+        from bloombee_tpu.models.checkpoint import (
+            load_client_params,
+            load_spec,
+        )
+
+        spec = load_spec(model_dir)
+        params = load_client_params(model_dir, dtype=dtype)
+        manager = RemoteSequenceManager(
+            registry,
+            model_uid or model_dir.rstrip("/").split("/")[-1],
+            spec.num_hidden_layers,
+        )
+        return cls(spec, params, manager, use_push=use_push)
+
+    # ------------------------------------------------------------- components
+    def embed(self, input_ids: np.ndarray) -> np.ndarray:
+        h = _embed(
+            self.params["embed"],
+            jnp.asarray(input_ids),
+            self.spec.embedding_multiplier,
+        )
+        return np.asarray(h, dtype=np.float32)
+
+    def logits(self, hidden: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            _norm_head(
+                self.params["norm"],
+                self.params["lm_head"],
+                jnp.asarray(hidden),
+                eps=self.spec.rms_norm_eps,
+                soft_cap=self.spec.logits_soft_cap,
+            )
+        )
+
+    def inference_session(
+        self, max_length: int, batch_size: int = 1
+    ) -> InferenceSession:
+        return InferenceSession(
+            self.manager, max_length, batch_size, use_push=self.use_push
+        )
+
+    # --------------------------------------------------------------- generate
+    async def generate(
+        self,
+        input_ids: np.ndarray,  # [B, S] int
+        max_new_tokens: int = 20,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_p: float = 1.0,
+        eos_token_id: int | None = None,
+        session: InferenceSession | None = None,
+        seed: int = 0,
+    ) -> np.ndarray:
+        input_ids = np.asarray(input_ids)
+        b, s = input_ids.shape
+        max_length = s + max_new_tokens
+        own_session = session is None
+        if own_session:
+            session = self.inference_session(max_length, b)
+            await session.__aenter__()
+        rng = np.random.default_rng(seed)
+        try:
+            hidden = self.embed(input_ids)
+            out = await session.step(hidden)
+            ids = input_ids
+            finished = np.zeros((b,), dtype=bool)
+            for _ in range(max_new_tokens):
+                logits = self.logits(out[:, -1:])[:, 0]  # [B, V]
+                next_ids = self._select(
+                    logits, do_sample, temperature, top_p, rng
+                )
+                if eos_token_id is not None:
+                    next_ids = np.where(finished, eos_token_id, next_ids)
+                    finished |= next_ids == eos_token_id
+                ids = np.concatenate([ids, next_ids[:, None]], axis=1)
+                if eos_token_id is not None and finished.all():
+                    break
+                if ids.shape[1] >= max_length:
+                    break
+                out = await session.step(self.embed(next_ids[:, None]))
+            return ids
+        finally:
+            if own_session:
+                await session.__aexit__(None, None, None)
+
+    @staticmethod
+    def _select(logits, do_sample, temperature, top_p, rng):
+        if not do_sample:
+            return np.argmax(logits, axis=-1).astype(np.int64)
+        logits = logits / max(temperature, 1e-6)
+        logits = logits - logits.max(axis=-1, keepdims=True)
+        probs = np.exp(logits)
+        probs /= probs.sum(axis=-1, keepdims=True)
+        if top_p < 1.0:
+            # nucleus: zero out the tail outside the top-p mass
+            order = np.argsort(-probs, axis=-1)
+            sorted_p = np.take_along_axis(probs, order, axis=-1)
+            csum = np.cumsum(sorted_p, axis=-1)
+            keep_sorted = csum - sorted_p < top_p
+            keep = np.zeros_like(probs, dtype=bool)
+            np.put_along_axis(keep, order, keep_sorted, axis=-1)
+            probs = np.where(keep, probs, 0.0)
+            probs /= probs.sum(axis=-1, keepdims=True)
+        return np.stack(
+            [rng.choice(probs.shape[-1], p=p) for p in probs]
+        ).astype(np.int64)

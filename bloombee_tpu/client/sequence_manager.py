@@ -1,0 +1,164 @@
+"""RemoteSequenceManager: the client routing brain.
+
+Port of /root/reference/src/bloombee/client/routing/sequence_manager.py:66-599:
+keeps a fresh view of which server spans cover which blocks, builds a chain of
+spans covering [0, num_blocks) by shortest-path search ("min_latency": Dijkstra
+over block boundaries with per-span compute cost + per-hop network cost,
+reference `_build_inference_graph` :235-296), or length-weighted random choice
+("max_throughput", :320-342), and bans failing peers with backoff (:412-429).
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import random
+import time
+
+from bloombee_tpu.swarm.data import RemoteSpanInfo
+from bloombee_tpu.swarm.spans import compute_spans
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_HOP_COST_S = 0.01  # client<->server / server->server RTT estimate
+CACHE_MISSING_PENALTY_S = 10.0  # reference: +10s if cache won't fit
+
+
+class MissingBlocksError(RuntimeError):
+    def __init__(self, blocks):
+        super().__init__(
+            f"no online server covers block(s) {blocks}; swarm incomplete"
+        )
+        self.blocks = blocks
+
+
+class RemoteSequenceManager:
+    def __init__(
+        self,
+        registry,
+        model_uid: str,
+        num_blocks: int,
+        update_period: float = 5.0,
+        ban_timeout: float = 15.0,
+        rng: random.Random | None = None,
+    ):
+        self.registry = registry
+        self.model_uid = model_uid
+        self.num_blocks = num_blocks
+        self.update_period = update_period
+        self.ban_timeout = ban_timeout
+        self.spans: dict[str, RemoteSpanInfo] = {}
+        self._banned_until: dict[str, float] = {}
+        self._last_update = 0.0
+        self._rng = rng or random.Random()
+
+    # ---------------------------------------------------------------- updates
+    async def update(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_update < self.update_period:
+            return
+        infos = await self.registry.get_module_infos(
+            self.model_uid, range(self.num_blocks)
+        )
+        self.spans = compute_spans(infos)
+        self._last_update = now
+
+    def ban_peer(self, peer_id: str) -> None:
+        """reference: on_request_failure + ban_timeout backoff."""
+        self._banned_until[peer_id] = time.monotonic() + self.ban_timeout
+        logger.info("banned peer %s for %.0fs", peer_id, self.ban_timeout)
+
+    def _active_spans(self) -> list[RemoteSpanInfo]:
+        now = time.monotonic()
+        return [
+            s
+            for s in self.spans.values()
+            if self._banned_until.get(s.peer_id, 0.0) <= now
+        ]
+
+    # ---------------------------------------------------------------- routing
+    def make_sequence(
+        self,
+        start: int = 0,
+        end: int | None = None,
+        mode: str = "min_latency",
+        cache_tokens_needed: int | None = None,
+    ) -> list[RemoteSpanInfo]:
+        end = self.num_blocks if end is None else end
+        spans = self._active_spans()
+        if mode == "max_throughput":
+            return self._random_route(spans, start, end)
+        return self._dijkstra_route(spans, start, end, cache_tokens_needed)
+
+    def _span_cost(
+        self, span: RemoteSpanInfo, blocks: int, cache_tokens_needed
+    ) -> float:
+        rps = span.server_info.inference_rps or span.server_info.throughput or 1.0
+        cost = DEFAULT_HOP_COST_S + blocks / max(rps, 1e-6)
+        left = span.server_info.cache_tokens_left
+        if (
+            cache_tokens_needed is not None
+            and left is not None
+            and left < cache_tokens_needed
+        ):
+            cost += CACHE_MISSING_PENALTY_S
+        return cost
+
+    def _dijkstra_route(
+        self, spans, start: int, end: int, cache_tokens_needed
+    ) -> list[RemoteSpanInfo]:
+        # nodes = block boundaries; a span [s, e) contributes edges b -> e for
+        # every b in [s, e) (a server can serve a suffix of its span)
+        edges: dict[int, list[tuple[int, float, RemoteSpanInfo]]] = {}
+        for span in spans:
+            s, e = max(span.start, start), min(span.end, end)
+            for b in range(s, e):
+                edges.setdefault(b, []).append(
+                    (e, self._span_cost(span, e - b, cache_tokens_needed), span)
+                )
+        dist = {start: 0.0}
+        prev: dict[int, tuple[int, RemoteSpanInfo]] = {}
+        heap = [(0.0, start)]
+        while heap:
+            d, node = heapq.heappop(heap)
+            if node == end:
+                break
+            if d > dist.get(node, float("inf")):
+                continue
+            for nxt, cost, span in edges.get(node, []):
+                nd = d + cost
+                if nd < dist.get(nxt, float("inf")):
+                    dist[nxt] = nd
+                    prev[nxt] = (node, span)
+                    heapq.heappush(heap, (nd, nxt))
+        if end not in prev and start != end:
+            covered = {b for s in spans for b in range(s.start, s.end)}
+            missing = [b for b in range(start, end) if b not in covered]
+            raise MissingBlocksError(missing or list(range(start, end)))
+        # walk back
+        route: list[RemoteSpanInfo] = []
+        node = end
+        while node != start:
+            pnode, span = prev[node]
+            route.append(
+                RemoteSpanInfo(span.peer_id, pnode, node, span.server_info)
+            )
+            node = pnode
+        return list(reversed(route))
+
+    def _random_route(self, spans, start: int, end: int):
+        """Length-weighted random chaining (reference :320-342)."""
+        route = []
+        cur = start
+        while cur < end:
+            options = [s for s in spans if s.start <= cur < s.end]
+            if not options:
+                raise MissingBlocksError([cur])
+            weights = [s.end - cur for s in options]
+            chosen = self._rng.choices(options, weights=weights)[0]
+            stop = min(chosen.end, end)
+            route.append(
+                RemoteSpanInfo(chosen.peer_id, cur, stop, chosen.server_info)
+            )
+            cur = stop
+        return route

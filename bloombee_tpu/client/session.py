@@ -1,0 +1,214 @@
+"""InferenceSession: client-side stateful decode across the span chain.
+
+Port of /root/reference/src/bloombee/client/inference_session.py:438-855:
+owns one rpc_inference stream per span, steps hidden states through the chain,
+and on a span failure re-routes that suffix of the chain and replays the input
+history into the replacement servers to rebuild their KV caches
+(`_update_sequence`, :802-831). Supports server-to-server push-only decode:
+the client sends only to span 0 and each hop forwards activations directly
+(reference ClientConfig.push_only_downstream_decode, config.py:19-42).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+
+import numpy as np
+
+from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+from bloombee_tpu.swarm.data import RemoteSpanInfo
+from bloombee_tpu.wire.rpc import Connection, RpcError, Stream, connect
+
+logger = logging.getLogger(__name__)
+
+
+class _SpanSession:
+    """One open rpc_inference stream to one server
+    (reference _ServerInferenceSession)."""
+
+    def __init__(self, span: RemoteSpanInfo, conn: Connection, stream: Stream,
+                 session_id: str):
+        self.span = span
+        self.conn = conn
+        self.stream = stream
+        self.session_id = session_id
+
+    async def close(self):
+        try:
+            await self.stream.close()
+        except Exception:
+            pass
+        try:
+            await self.conn.close()
+        except Exception:
+            pass
+
+
+class InferenceSession:
+    def __init__(
+        self,
+        manager: RemoteSequenceManager,
+        max_length: int,
+        batch_size: int = 1,
+        use_push: bool = True,
+        max_retries: int = 3,
+        step_timeout: float = 120.0,
+    ):
+        self.manager = manager
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.use_push = use_push
+        self.max_retries = max_retries
+        self.step_timeout = step_timeout
+        self._spans: list[_SpanSession] = []
+        self._history: list[np.ndarray] = []  # chain inputs, for replay
+        self._step_counter = 0
+        self.position = 0
+
+    # ------------------------------------------------------------- lifecycle
+    async def __aenter__(self) -> "InferenceSession":
+        await self.manager.update(force=True)
+        route = self.manager.make_sequence(
+            cache_tokens_needed=self.batch_size * self.max_length
+        )
+        self._spans = [await self._open_span(s) for s in route]
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def close(self) -> None:
+        for s in self._spans:
+            await s.close()
+        self._spans = []
+
+    async def _open_span(self, span: RemoteSpanInfo) -> _SpanSession:
+        session_id = f"sess-{uuid.uuid4().hex[:12]}"
+        conn = await connect(span.server_info.host, span.server_info.port)
+        stream = await conn.open_stream(
+            "rpc_inference",
+            {
+                "session_id": session_id,
+                "batch_size": self.batch_size,
+                "max_length": self.max_length,
+                "start": span.start,
+                "end": span.end,
+            },
+        )
+        return _SpanSession(span, conn, stream, session_id)
+
+    # ------------------------------------------------------------------ steps
+    async def step(
+        self,
+        hidden: np.ndarray,  # [B, T, D]
+        commit: bool = True,
+        tree_mask: np.ndarray | None = None,
+    ) -> np.ndarray:
+        """Push hidden through the whole chain; returns last span's output."""
+        attempt = 0
+        while True:
+            try:
+                out = await self._step_once(hidden, commit, tree_mask)
+                if commit and tree_mask is None:
+                    self._history.append(hidden)
+                    self.position += hidden.shape[1]
+                return out
+            except (RpcError, OSError, asyncio.TimeoutError) as e:
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise
+                logger.warning(
+                    "step failed (%s); re-routing (attempt %d)", e, attempt
+                )
+                try:
+                    await self._recover()
+                except (RpcError, OSError, asyncio.TimeoutError) as e2:
+                    logger.warning("recovery attempt failed: %s", e2)
+                    await asyncio.sleep(min(0.2 * attempt, 2.0))
+
+    async def _step_once(self, hidden, commit, tree_mask):
+        step_id = self._step_counter
+        self._step_counter += 1
+        meta_base = {
+            "step": step_id,
+            "commit": commit,
+            "tree": tree_mask is not None,
+        }
+        tensors = [hidden.astype(np.float32)]
+        if tree_mask is not None:
+            tensors.append(tree_mask.astype(np.uint8))
+
+        if self.use_push and len(self._spans) > 1:
+            route = [
+                {
+                    "host": s.span.server_info.host,
+                    "port": s.span.server_info.port,
+                    "session_id": s.session_id,
+                }
+                for s in self._spans[1:]
+            ]
+            meta = {**meta_base, "route": route, "reply": "tensor"}
+            await self._spans[0].stream.send(meta, tensors)
+        else:
+            meta = {**meta_base, "reply": "tensor"}
+            await self._spans[0].stream.send(meta, tensors)
+
+        out = None
+        for i, span_sess in enumerate(self._spans):
+            try:
+                item = await asyncio.wait_for(
+                    span_sess.stream.recv(), self.step_timeout
+                )
+            except (RpcError, OSError, asyncio.TimeoutError):
+                self.manager.ban_peer(span_sess.span.peer_id)
+                raise
+            if item is None:
+                self.manager.ban_peer(span_sess.span.peer_id)
+                raise RpcError(f"span {i} closed mid-session")
+            resp_meta, resp_tensors = item
+            if resp_meta.get("ack"):
+                continue
+            out = resp_tensors[0]
+            if not self.use_push and i + 1 < len(self._spans):
+                await self._spans[i + 1].stream.send(
+                    {**meta_base, "reply": "tensor"},
+                    [out] + tensors[1:],
+                )
+        assert out is not None, "no span returned a tensor"
+        return np.asarray(out, dtype=np.float32)
+
+    # -------------------------------------------------------------- recovery
+    async def _recover(self) -> None:
+        """Rebuild the entire chain and replay history
+        (v1 of reference `_update_sequence`: suffix-only rebuild is an
+        optimization; full rebuild is correct because servers key KV caches by
+        session, and new sessions start empty)."""
+        await self.close()
+        await self.manager.update(force=True)
+        route = self.manager.make_sequence(
+            cache_tokens_needed=self.batch_size * self.max_length
+        )
+        spans: list[_SpanSession] = []
+        try:
+            for s in route:
+                try:
+                    spans.append(await self._open_span(s))
+                except (OSError, RpcError, asyncio.TimeoutError):
+                    self.manager.ban_peer(s.peer_id)
+                    raise
+        except Exception:
+            for sp in spans:
+                await sp.close()
+            raise
+        self._spans = spans
+        if self._history:
+            replay = np.concatenate(self._history, axis=1)
+            try:
+                await self._step_once(replay, commit=True, tree_mask=None)
+            except Exception:
+                # a half-replayed chain must not be reused: its KV caches are
+                # incomplete and a later "successful" step would be garbage
+                await self.close()
+                raise

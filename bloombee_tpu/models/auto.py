@@ -1,0 +1,95 @@
+"""Model family registry.
+
+Port of /root/reference/src/bloombee/utils/auto_config.py:82-100: a registry
+keyed by HF `model_type` dispatching config mapping, block param loading, and
+client param names per family.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Any, Callable
+
+from bloombee_tpu.models.spec import ModelSpec
+
+_REGISTRY: dict[str, "Family"] = {}
+
+
+class Family:
+    def __init__(
+        self,
+        name: str,
+        spec_fn: Callable[[Any], ModelSpec],
+        block_keys: dict[str, tuple[str, bool]],
+        layer_prefix: str = "model.layers",
+        client_names: dict[str, str] | None = None,
+        convert_block: Callable | None = None,
+    ):
+        self.name = name
+        self._spec_fn = spec_fn
+        self.block_keys = block_keys
+        self.layer_prefix = layer_prefix
+        self._client_names = client_names or {
+            "embed": "model.embed_tokens.weight",
+            "norm": "model.norm.weight",
+            "lm_head": "lm_head.weight",
+        }
+        self._convert_block = convert_block
+
+    def spec_from_config_dict(self, config: dict) -> ModelSpec:
+        return self._spec_fn(SimpleNamespace(**config))
+
+    def client_param_names(self) -> dict[str, str]:
+        return self._client_names
+
+    def load_block_params(self, reader, layer_idx: int, dtype=None) -> dict:
+        tensors = {}
+        for hf_key in self.block_keys:
+            full = f"{self.layer_prefix}.{layer_idx}.{hf_key}"
+            tensors[hf_key] = reader.tensor(full)
+        if self._convert_block is not None:
+            return self._convert_block(tensors, dtype=dtype)
+        raise NotImplementedError(self.name)
+
+
+def register_family(family: Family) -> None:
+    _REGISTRY[family.name] = family
+
+
+def get_family(model_type: str) -> Family:
+    if model_type not in _REGISTRY:
+        raise KeyError(
+            f"unknown model family {model_type!r}; known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[model_type]
+
+
+def spec_from_hf_config(config: Any) -> ModelSpec:
+    return get_family(config.model_type)._spec_fn(config)
+
+
+def spec_from_config_dict(config: dict) -> ModelSpec:
+    return get_family(config.get("model_type", "llama")).spec_from_config_dict(
+        config
+    )
+
+
+# ---------------------------------------------------------------- built-ins
+def _register_builtins() -> None:
+    from bloombee_tpu.models.llama.block import (
+        HF_BLOCK_KEYS as LLAMA_KEYS,
+        convert_hf_block_params as llama_convert,
+    )
+    from bloombee_tpu.models.llama.config import llama_spec_from_hf
+
+    register_family(
+        Family(
+            "llama",
+            llama_spec_from_hf,
+            LLAMA_KEYS,
+            convert_block=llama_convert,
+        )
+    )
+
+
+_register_builtins()

@@ -1,0 +1,108 @@
+"""HF checkpoint reading (safetensors, torch-free).
+
+Replaces the reference's per-block HF-hub state-dict loading and .npy weight
+conversion (/root/reference/src/bloombee/server/from_pretrained.py:58-548,
+models/llama/block.py:329-384): server loads only its span's layers; client
+loads only embeddings + final norm + lm head (reference
+client/from_pretrained.py:17-70 skips `model.layers.*`).
+
+Zero-egress note: model directories are local paths (config.json +
+*.safetensors [+ index]); hub download plumbing can wrap this later.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import numpy as np
+from safetensors import safe_open
+
+from bloombee_tpu.models.spec import ModelSpec
+
+
+class CheckpointReader:
+    """Lazy tensor reader over a local HF model directory."""
+
+    def __init__(self, model_dir: str | pathlib.Path):
+        self.dir = pathlib.Path(model_dir)
+        with open(self.dir / "config.json") as f:
+            self.config = json.load(f)
+        index_path = self.dir / "model.safetensors.index.json"
+        if index_path.exists():
+            with open(index_path) as f:
+                index = json.load(f)
+            self._weight_map = index["weight_map"]
+        else:
+            files = sorted(self.dir.glob("*.safetensors"))
+            if not files:
+                raise FileNotFoundError(f"no safetensors in {self.dir}")
+            self._weight_map = {}
+            for fp in files:
+                with safe_open(fp, framework="numpy") as f:
+                    for k in f.keys():
+                        self._weight_map[k] = fp.name
+        self._handles: dict[str, object] = {}
+
+    def keys(self):
+        return self._weight_map.keys()
+
+    def has(self, name: str) -> bool:
+        return name in self._weight_map
+
+    def tensor(self, name: str) -> np.ndarray:
+        fname = self._weight_map[name]
+        h = self._handles.get(fname)
+        if h is None:
+            h = safe_open(self.dir / fname, framework="numpy")
+            self._handles[fname] = h
+        return h.get_tensor(name)
+
+    def model_type(self) -> str:
+        return self.config.get("model_type", "llama")
+
+
+def load_spec(model_dir: str) -> ModelSpec:
+    """ModelSpec from a local model dir via the family registry."""
+    from bloombee_tpu.models.auto import spec_from_config_dict
+
+    reader = CheckpointReader(model_dir)
+    return spec_from_config_dict(reader.config)
+
+
+def load_span_params(
+    model_dir: str, start: int, end: int, dtype=None
+):
+    """Stacked per-layer params for blocks [start, end)."""
+    from bloombee_tpu.models.auto import get_family
+    from bloombee_tpu.utils.tree import stack_params
+
+    reader = CheckpointReader(model_dir)
+    family = get_family(reader.model_type())
+    layers = [
+        family.load_block_params(reader, i, dtype=dtype)
+        for i in range(start, end)
+    ]
+    return stack_params(layers), family.spec_from_config_dict(reader.config)
+
+
+def load_client_params(model_dir: str, dtype=None) -> dict:
+    """Embeddings + final norm + LM head (the client-side trio)."""
+    import jax.numpy as jnp
+
+    from bloombee_tpu.models.auto import get_family
+
+    reader = CheckpointReader(model_dir)
+    family = get_family(reader.model_type())
+    names = family.client_param_names()
+    embed = jnp.asarray(reader.tensor(names["embed"]))
+    norm = jnp.asarray(reader.tensor(names["norm"]))
+    if reader.has(names["lm_head"]):
+        head = jnp.asarray(reader.tensor(names["lm_head"])).T
+    else:  # tied embeddings
+        head = embed.T
+    if dtype is not None:
+        embed, norm, head = (
+            embed.astype(dtype), norm.astype(dtype), head.astype(dtype)
+        )
+    return {"embed": embed, "norm": norm, "lm_head": head}

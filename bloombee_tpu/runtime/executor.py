@@ -53,7 +53,11 @@ class SpanExecutor:
 
     # ------------------------------------------------------------------ steps
     def prefill(
-        self, handle: CacheHandle, hidden: np.ndarray, commit: bool = True
+        self,
+        handle: CacheHandle,
+        hidden: np.ndarray,
+        commit: bool = True,
+        layers: tuple[int, int] | None = None,
     ) -> np.ndarray:
         """Run full-sequence prefill, chunked to bound attention logits memory
         (reference: backend.py:525-531 chunked inference)."""
@@ -61,7 +65,7 @@ class SpanExecutor:
         t = hidden.shape[1]
         for start in range(0, t, self.max_chunk_tokens):
             chunk = hidden[:, start : start + self.max_chunk_tokens]
-            outs.append(self._step(handle, chunk, commit=commit))
+            outs.append(self._step(handle, chunk, commit=commit, layers=layers))
         return np.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
 
     def decode(
@@ -70,8 +74,11 @@ class SpanExecutor:
         hidden: np.ndarray,
         commit: bool = True,
         tree_mask: np.ndarray | None = None,
+        layers: tuple[int, int] | None = None,
     ) -> np.ndarray:
-        return self._step(handle, hidden, commit=commit, tree_mask=tree_mask)
+        return self._step(
+            handle, hidden, commit=commit, tree_mask=tree_mask, layers=layers
+        )
 
     # --------------------------------------------------------------- internals
     def _step(
@@ -80,6 +87,7 @@ class SpanExecutor:
         hidden: np.ndarray,
         commit: bool,
         tree_mask: np.ndarray | None = None,
+        layers: tuple[int, int] | None = None,
     ) -> np.ndarray:
         spec = self.spec
         b, t, d = hidden.shape
@@ -115,7 +123,12 @@ class SpanExecutor:
         pt_pad[:b] = self.manager.page_table(handle, pb)
         lens_pad = np.zeros((bb,), dtype=np.int32)
         lens_pad[:b] = total_lens
-        plan = pack_plan(slots_pad, pt_pad, positions, lens_pad)
+        num_layers = self.manager.num_layers
+        layer_active = np.ones((num_layers,), dtype=np.int32)
+        if layers is not None:
+            layer_active[:] = 0
+            layer_active[layers[0] : layers[1]] = 1
+        plan = pack_plan(slots_pad, pt_pad, positions, lens_pad, layer_active)
         tm_pad = None
         if tree_mask is not None:
             tm_pad = np.zeros((bb, tb, tb), dtype=bool)

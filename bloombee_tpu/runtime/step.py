@@ -127,26 +127,30 @@ def _layer_body(
     return hidden, k_slab, v_slab
 
 
-def unpack_plan(plan: jax.Array, b: int, t: int, max_pages: int):
+def unpack_plan(plan: jax.Array, b: int, t: int, max_pages: int, num_layers: int):
     """Split the packed int32 plan array back into its parts.
 
     The plan packs [slots(B*T) | page_table(B*max_pages) | positions(B*T) |
-    total_lens(B)] into one int32 vector so a step costs ONE host->device
-    transfer for all control data (transfer latency dominates on DCN-attached
-    hosts; cf. the reference's single metadata sidecar per request,
-    handler.py rpc metadata).
+    total_lens(B) | layer_active(L)] into one int32 vector so a step costs ONE
+    host->device transfer for all control data (transfer latency dominates on
+    DCN-attached hosts; cf. the reference's single metadata sidecar per
+    request, handler.py rpc metadata). `layer_active` gates which of the
+    server's layers run — a session entering mid-span (suffix sub-span
+    routing, reference `spans_containing_block`) skips the leading layers.
     """
     o1 = b * t
     o2 = o1 + b * max_pages
     o3 = o2 + b * t
+    o4 = o3 + b
     slots = plan[:o1]
     page_table = plan[o1:o2].reshape(b, max_pages)
     q_positions = plan[o2:o3].reshape(b, t)
-    total_lens = plan[o3 : o3 + b]
-    return slots, page_table, q_positions, total_lens
+    total_lens = plan[o3:o4]
+    layer_active = plan[o4 : o4 + num_layers]
+    return slots, page_table, q_positions, total_lens, layer_active
 
 
-def pack_plan(slots, page_table, q_positions, total_lens):
+def pack_plan(slots, page_table, q_positions, total_lens, layer_active):
     import numpy as np
 
     return np.concatenate(
@@ -155,6 +159,7 @@ def pack_plan(slots, page_table, q_positions, total_lens):
             np.ravel(page_table).astype(np.int32),
             np.ravel(q_positions).astype(np.int32),
             np.ravel(total_lens).astype(np.int32),
+            np.ravel(layer_active).astype(np.int32),
         ]
     )
 
@@ -184,8 +189,9 @@ def span_step(
     per-step host tables), in fp32 like HF.
     """
     b, t, _ = hidden.shape
-    slots, page_table, q_positions, total_lens = unpack_plan(
-        plan, b, t, max_pages
+    num_layers = arena_k.shape[0]
+    slots, page_table, q_positions, total_lens, layer_active = unpack_plan(
+        plan, b, t, max_pages, num_layers
     )
     cos, sin = rotary_cos_sin(q_positions, spec.head_dim, spec.rope_theta)
     cos = cos.astype(hidden.dtype)
@@ -194,14 +200,21 @@ def span_step(
     tm = tree_mask if use_tree_mask else None
 
     def body(h, xs):
-        params_l, k_l, v_l = xs
-        h, k_l, v_l = _layer_body(
-            spec, page_size, h, params_l, k_l, v_l, cos, sin, slots,
-            page_table, q_positions, total_lens, tm, window,
-        )
+        params_l, k_l, v_l, active = xs
+
+        def run(h, k_l, v_l):
+            return _layer_body(
+                spec, page_size, h, params_l, k_l, v_l, cos, sin, slots,
+                page_table, q_positions, total_lens, tm, window,
+            )
+
+        def skip(h, k_l, v_l):
+            return h, k_l, v_l
+
+        h, k_l, v_l = lax.cond(active > 0, run, skip, h, k_l, v_l)
         return h, (k_l, v_l)
 
     hidden, (arena_k, arena_v) = lax.scan(
-        body, hidden, (stacked_params, arena_k, arena_v)
+        body, hidden, (stacked_params, arena_k, arena_v, layer_active)
     )
     return hidden, arena_k, arena_v

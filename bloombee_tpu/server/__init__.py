@@ -1,0 +1,11 @@
+"""Worker server: hosts a contiguous span of transformer blocks.
+
+Replaces the reference's Server/ModuleContainer/TransformerConnectionHandler/
+hivemind-Runtime stack (/root/reference/src/bloombee/server/server.py:97-911,
+handler.py:373-3273) with one asyncio process per TPU host: RPC handlers feed
+a single prioritized compute queue in front of the jitted span executor.
+"""
+
+from bloombee_tpu.server.block_server import BlockServer
+
+__all__ = ["BlockServer"]

@@ -1,0 +1,369 @@
+"""BlockServer: one worker hosting blocks [start, end) of a model.
+
+Maps the reference worker topology (SURVEY.md sections 3.1/3.3) onto one
+asyncio process:
+
+- `rpc_inference` stream == the per-session decode loop
+  (reference handler.py:798-1257 + block_functions.py:629). Each step arrives
+  either from the client stream or from an upstream server's `rpc_push`
+  (server-to-server pipeline, handler.py:1850-2151); the session races both
+  sources like the reference's `_iterate_inference_steps`.
+- `rpc_push` == upstream activation push; the step metadata carries the
+  remaining route so each hop forwards to the next
+  (reference `_collect_next_servers`, client/inference_session.py:388-396).
+- `rpc_forward` == training-style span forward without a decode session.
+- `rpc_info` == ServerInfo snapshot (handler.py:3256 rpc_info).
+- A background announcer re-declares the span in the registry every
+  `announce_period` with expiration as the liveness signal
+  (reference ModuleAnnouncerThread, server.py:914-1007).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from bloombee_tpu.kv.cache_manager import CacheManager
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.runtime.executor import SpanExecutor
+from bloombee_tpu.server.compute_queue import (
+    PRIORITY_INFERENCE,
+    PRIORITY_TRAINING,
+    ComputeQueue,
+)
+from bloombee_tpu.swarm.data import ServerInfo, ServerState
+from bloombee_tpu.wire.rpc import Connection, RpcServer, Stream, connect
+
+logger = logging.getLogger(__name__)
+
+
+class _Session:
+    def __init__(self, session_id: str, handle, batch_size: int,
+                 layers: tuple[int, int] | None = None):
+        self.id = session_id
+        self.handle = handle
+        self.batch_size = batch_size
+        self.layers = layers  # relative (l0, l1) within this server's span
+        self.push_inbox: asyncio.Queue = asyncio.Queue()
+
+
+class _PeerPool:
+    """Cached outbound connections for server-to-server push.
+
+    Locking is per-peer so one unreachable peer's connect timeout cannot
+    stall pushes to healthy peers."""
+
+    def __init__(self):
+        self._conns: dict[tuple[str, int], Connection] = {}
+        self._locks: dict[tuple[str, int], asyncio.Lock] = {}
+
+    async def get(self, host: str, port: int) -> Connection:
+        key = (host, port)
+        lock = self._locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(key)
+            if conn is None or conn.is_closing():
+                conn = await connect(host, port)
+                self._conns[key] = conn
+            return conn
+
+    async def close(self):
+        for c in self._conns.values():
+            await c.close()
+        self._conns.clear()
+
+
+class BlockServer:
+    def __init__(
+        self,
+        *,
+        model_uid: str,
+        start: int,
+        end: int,
+        params=None,
+        spec: ModelSpec | None = None,
+        model_dir: str | None = None,
+        registry=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        public_host: str | None = None,
+        num_pages: int = 256,
+        page_size: int = 16,
+        compute_dtype=jnp.bfloat16,
+        max_chunk_tokens: int = 512,
+        announce_period: float = 5.0,
+        alloc_timeout: float = 60.0,
+        throughput: float = 1.0,
+    ):
+        if params is None:
+            from bloombee_tpu.models.checkpoint import load_span_params
+
+            params, spec = load_span_params(
+                model_dir, start, end, dtype=compute_dtype
+            )
+        assert spec is not None
+        self.model_uid = model_uid
+        self.start_block = start
+        self.end_block = end
+        self.spec = spec
+        self.server_id = f"srv-{uuid.uuid4().hex[:8]}"
+        self.registry = registry
+        self.announce_period = announce_period
+        self.alloc_timeout = alloc_timeout
+        self.public_host = public_host or host
+        self.throughput = throughput
+
+        self.manager = CacheManager(
+            num_layers=end - start,
+            num_pages=num_pages,
+            page_size=page_size,
+            n_kv_heads=spec.num_key_value_heads,
+            head_dim=spec.head_dim,
+            dtype=compute_dtype,
+        )
+        self.executor = SpanExecutor(
+            params, spec, self.manager,
+            max_chunk_tokens=max_chunk_tokens,
+            compute_dtype=compute_dtype,
+        )
+        self.compute = ComputeQueue()
+        self.peers = _PeerPool()
+        self._sessions: dict[str, _Session] = {}
+        self._pending_pushes: dict[str, list] = {}
+        self.pending_push_ttl = 30.0
+        self._announce_task: asyncio.Task | None = None
+        self.rpc = RpcServer(
+            unary_handlers={
+                "rpc_info": self._rpc_info,
+                "rpc_forward": self._rpc_forward,
+            },
+            stream_handlers={"rpc_inference": self._rpc_inference},
+            push_handlers={"rpc_push": self._rpc_push},
+            host=host,
+            port=port,
+        )
+
+    # ---------------------------------------------------------------- lifecycle
+    @property
+    def port(self) -> int:
+        return self.rpc.port
+
+    async def start(self) -> None:
+        await self.rpc.start()
+        self.compute.start()
+        if self.registry is not None:
+            await self._announce(ServerState.ONLINE)
+            self._announce_task = asyncio.create_task(self._announce_loop())
+        logger.info(
+            "server %s serving %s[%d:%d] on port %d",
+            self.server_id, self.model_uid, self.start_block, self.end_block, self.port,
+        )
+
+    async def stop(self) -> None:
+        if self._announce_task is not None:
+            self._announce_task.cancel()
+        if self.registry is not None:
+            try:
+                await self.registry.revoke_blocks(
+                    self.model_uid, self.server_id, range(self.start_block, self.end_block)
+                )
+            except Exception:
+                pass
+        await self.compute.stop()
+        await self.peers.close()
+        await self.rpc.stop()
+
+    def server_info(self) -> ServerInfo:
+        return ServerInfo(
+            state=ServerState.ONLINE,
+            host=self.public_host,
+            port=self.port,
+            throughput=self.throughput,
+            inference_rps=None,
+            cache_tokens_left=self.manager.tokens_left,
+            start_block=self.start_block,
+            end_block=self.end_block,
+        )
+
+    async def _announce(self, state: ServerState) -> None:
+        info = self.server_info()
+        info.state = state
+        await self.registry.declare_blocks(
+            self.model_uid,
+            self.server_id,
+            range(self.start_block, self.end_block),
+            info,
+            expiration=self.announce_period * 2.5,
+        )
+
+    async def _announce_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.announce_period)
+            try:
+                await self._announce(ServerState.ONLINE)
+            except Exception as e:
+                logger.warning("announce failed: %s", e)
+
+    # ------------------------------------------------------------------- RPCs
+    async def _rpc_info(self, meta: dict, tensors):
+        return {"server_id": self.server_id, **self.server_info().to_wire()}, []
+
+    async def _rpc_inference(self, stream: Stream) -> None:
+        """One decode session. Open meta: {session_id, batch_size, max_length,
+        start?, end?}; items: {step, commit, reply, route} + [hidden (B,T,D)]
+        (+ tree mask u8 [B,T,T] when meta['tree'])."""
+        meta = stream.open_meta
+        session_id = meta["session_id"]
+        batch = int(meta["batch_size"])
+        max_length = int(meta["max_length"])
+        layers = self._resolve_layers(meta)
+        async with self.manager.allocate(
+            batch, max_length, timeout=self.alloc_timeout
+        ) as handle:
+            session = _Session(session_id, handle, batch, layers)
+            self._sessions[session_id] = session
+            self._drain_pending_pushes(session)
+            try:
+                await self._session_loop(session, stream)
+            finally:
+                self._sessions.pop(session_id, None)
+
+    def _resolve_layers(self, meta: dict) -> tuple[int, int] | None:
+        """Honor a requested sub-span (the router may enter this server's span
+        mid-way: suffix sub-spans, reference spans_containing_block)."""
+        start = int(meta.get("start", self.start_block))
+        end = int(meta.get("end", self.end_block))
+        if not (self.start_block <= start < end <= self.end_block):
+            raise ValueError(
+                f"requested blocks [{start},{end}) outside served span "
+                f"[{self.start_block},{self.end_block})"
+            )
+        if (start, end) == (self.start_block, self.end_block):
+            return None
+        return (start - self.start_block, end - self.start_block)
+
+    async def _session_loop(self, session: _Session, stream: Stream) -> None:
+        """Race client-stream items against pushed items
+        (reference handler.py:1677-1847)."""
+        stream_next = asyncio.ensure_future(stream.recv())
+        push_next = asyncio.ensure_future(session.push_inbox.get())
+        try:
+            while True:
+                done, _ = await asyncio.wait(
+                    {stream_next, push_next},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if stream_next in done:
+                    item = stream_next.result()
+                    if item is None:
+                        break  # client closed the session
+                    await self._run_step(session, stream, *item)
+                    stream_next = asyncio.ensure_future(stream.recv())
+                if push_next in done:
+                    meta, tensors = push_next.result()
+                    await self._run_step(session, stream, meta, tensors)
+                    push_next = asyncio.ensure_future(session.push_inbox.get())
+        finally:
+            stream_next.cancel()
+            push_next.cancel()
+
+    async def _run_step(
+        self, session: _Session, stream: Stream, meta: dict, tensors: list
+    ) -> None:
+        hidden = np.asarray(tensors[0], dtype=np.float32)
+        tree_mask = None
+        if meta.get("tree"):
+            tree_mask = np.asarray(tensors[1], dtype=bool)
+        commit = bool(meta.get("commit", True))
+
+        out = await self.compute.submit(
+            PRIORITY_INFERENCE,
+            self._compute_step,
+            session,
+            hidden,
+            commit,
+            tree_mask,
+        )
+
+        route = meta.get("route") or []
+        reply = meta.get("reply", "tensor")
+        if route:
+            nxt = route[0]
+            push_meta = {
+                "session_id": nxt["session_id"],
+                "step": meta.get("step"),
+                "commit": commit,
+                "tree": meta.get("tree", False),
+                "reply": reply,
+                "route": route[1:],
+            }
+            push_tensors = [out.astype(np.float32)]
+            if tree_mask is not None:
+                push_tensors.append(tree_mask.astype(np.uint8))
+            conn = await self.peers.get(nxt["host"], nxt["port"])
+            await conn.push("rpc_push", push_meta, push_tensors)
+            # ack our own client stream so it can detect this hop succeeded
+            await stream.send({"step": meta.get("step"), "ack": True})
+        elif reply == "ack":
+            await stream.send({"step": meta.get("step"), "ack": True})
+        else:
+            await stream.send({"step": meta.get("step")}, [out])
+
+    def _compute_step(self, session: _Session, hidden, commit, tree_mask):
+        if hidden.shape[1] > 1 and tree_mask is None:
+            return self.executor.prefill(
+                session.handle, hidden, commit=commit, layers=session.layers
+            )
+        return self.executor.decode(
+            session.handle, hidden, commit=commit, tree_mask=tree_mask,
+            layers=session.layers,
+        )
+
+    async def _rpc_push(self, meta: dict, tensors) -> None:
+        session = self._sessions.get(meta["session_id"])
+        if session is None:
+            # A push can race ahead of the session's stream-open (allocation
+            # may be waiting on cache budget); buffer it briefly — the
+            # reference accumulates early micro-batch pushes the same way
+            # (handler.py:1850-2151 accumulate/immediate queues).
+            self._buffer_pending_push(meta, tensors)
+            return
+        session.push_inbox.put_nowait((meta, tensors))
+
+    def _buffer_pending_push(self, meta: dict, tensors) -> None:
+        import time
+
+        now = time.monotonic()
+        sid = meta["session_id"]
+        self._pending_pushes.setdefault(sid, []).append((now, meta, tensors))
+        # drop stale buffers
+        for key in list(self._pending_pushes):
+            self._pending_pushes[key] = [
+                e
+                for e in self._pending_pushes[key]
+                if now - e[0] < self.pending_push_ttl
+            ]
+            if not self._pending_pushes[key]:
+                del self._pending_pushes[key]
+
+    def _drain_pending_pushes(self, session: _Session) -> None:
+        for _, meta, tensors in self._pending_pushes.pop(session.id, []):
+            session.push_inbox.put_nowait((meta, tensors))
+
+    async def _rpc_forward(self, meta: dict, tensors):
+        """Span forward without a session (training / one-shot),
+        reference block_functions.py:247 run_rpc_forward."""
+        hidden = np.asarray(tensors[0], dtype=np.float32)
+        b, t, _ = hidden.shape
+        layers = self._resolve_layers(meta)
+        async with self.manager.allocate(b, t, timeout=self.alloc_timeout) as h:
+            out = await self.compute.submit(
+                PRIORITY_TRAINING, self.executor.prefill, h, hidden,
+                True, layers,
+            )
+        return {"ok": True}, [out]

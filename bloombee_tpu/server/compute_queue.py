@@ -1,0 +1,61 @@
+"""Prioritized single-worker compute queue.
+
+Role of the reference's PrioritizedTaskPool + hivemind Runtime
+(/root/reference/src/bloombee/server/task_pool.py:30-236, task_prioritizer.py):
+all device work funnels through one worker so steps execute one at a time
+(the TPU is a serial resource), inference outranks forward/backward, and the
+asyncio event loop never blocks on device compute.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable
+
+PRIORITY_INFERENCE = 0.0  # reference DummyTaskPrioritizer: inference=1.0
+PRIORITY_TRAINING = 1.0  # beats forward/backward=2.0 — same ordering
+
+
+class ComputeQueue:
+    def __init__(self) -> None:
+        self._queue: asyncio.PriorityQueue = asyncio.PriorityQueue()
+        self._seq = itertools.count()
+        self._thread = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="compute"
+        )
+        self._worker_task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._worker_task = asyncio.create_task(self._worker())
+
+    async def stop(self) -> None:
+        if self._worker_task is not None:
+            self._worker_task.cancel()
+        self._thread.shutdown(wait=False, cancel_futures=True)
+
+    async def submit(
+        self, priority: float, fn: Callable[..., Any], *args, **kwargs
+    ) -> Any:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._queue.put_nowait(
+            (priority, next(self._seq), fn, args, kwargs, fut)
+        )
+        return await fut
+
+    async def _worker(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            _, _, fn, args, kwargs, fut = await self._queue.get()
+            if fut.cancelled():
+                continue
+            try:
+                result = await loop.run_in_executor(
+                    self._thread, lambda: fn(*args, **kwargs)
+                )
+                if not fut.done():
+                    fut.set_result(result)
+            except Exception as e:
+                if not fut.done():
+                    fut.set_exception(e)

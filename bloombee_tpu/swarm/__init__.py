@@ -1,0 +1,23 @@
+"""Swarm discovery: registry service, server records, span computation.
+
+Replaces the reference's hivemind Kademlia DHT layer
+(/root/reference/src/bloombee/utils/dht.py:28-153, data_structures.py) with a
+registry service speaking the same record semantics: per-block uid keys,
+per-server subkeys, record expiration as the liveness signal, and
+`compute_spans` turning block records into contiguous server spans.
+"""
+
+from bloombee_tpu.swarm.data import ServerInfo, ServerState, RemoteSpanInfo, ModuleInfo
+from bloombee_tpu.swarm.registry import RegistryServer, RegistryClient, InProcessRegistry
+from bloombee_tpu.swarm.spans import compute_spans
+
+__all__ = [
+    "ServerInfo",
+    "ServerState",
+    "RemoteSpanInfo",
+    "ModuleInfo",
+    "RegistryServer",
+    "RegistryClient",
+    "InProcessRegistry",
+    "compute_spans",
+]

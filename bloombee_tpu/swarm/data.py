@@ -1,0 +1,67 @@
+"""Swarm data structures.
+
+Mirrors /root/reference/src/bloombee/data_structures.py:51-83 (ServerInfo is
+the DHT-visible metrics surface) and RemoteSpanInfo (client routing unit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class ServerState(enum.IntEnum):
+    OFFLINE = 0
+    JOINING = 1
+    ONLINE = 2
+
+
+@dataclasses.dataclass
+class ServerInfo:
+    state: ServerState = ServerState.ONLINE
+    host: str = ""
+    port: int = 0
+    version: str = "0.1.0"
+    throughput: float = 1.0  # overall rps used by routing / balancing
+    network_rps: float | None = None
+    inference_rps: float | None = None
+    forward_rps: float | None = None
+    cache_tokens_left: int | None = None
+    next_pings: dict[str, float] | None = None  # server_id -> rtt seconds
+    start_block: int | None = None
+    end_block: int | None = None
+
+    def to_wire(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["state"] = int(self.state)
+        return d
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "ServerInfo":
+        d = dict(d)
+        d["state"] = ServerState(d.get("state", 2))
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """One block uid's view: which servers serve it."""
+
+    uid: str
+    servers: dict[str, ServerInfo]
+
+
+@dataclasses.dataclass
+class RemoteSpanInfo:
+    """A contiguous block range on one server (routing unit,
+    reference data_structures.py RemoteSpanInfo)."""
+
+    peer_id: str
+    start: int
+    end: int
+    server_info: ServerInfo
+
+    @property
+    def length(self) -> int:
+        return self.end - self.start

@@ -1,0 +1,194 @@
+"""Registry service: the swarm's discovery plane.
+
+Role of the reference's hivemind DHT + declare_active_modules /
+get_remote_module_infos (/root/reference/src/bloombee/utils/dht.py:28-117):
+servers periodically store `{uid}.{block}` -> {server_id: (info, expiry)};
+records expire, and expiry IS the failure detector (a dead server's records
+vanish after `expiration` seconds — reference server.py:957-992). Clients
+fetch many uids at once to build the routing table.
+
+Deployment: one `RegistryServer` runs as the bootstrap node (the reference's
+`run_dht` role, cli/run_dht.py). `InProcessRegistry` backs single-process
+tests. The registry speaks the normal wire RPC so any peer can also proxy it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from bloombee_tpu.swarm.data import ModuleInfo, ServerInfo
+from bloombee_tpu.wire.rpc import Connection, RpcServer, connect
+
+
+class _Store:
+    def __init__(self):
+        # key -> subkey -> (value dict, expiration unix time)
+        self._data: dict[str, dict[str, tuple[dict, float]]] = {}
+
+    def store(self, key: str, subkey: str, value: dict, expiration: float):
+        self._data.setdefault(key, {})[subkey] = (value, expiration)
+
+    def get(self, key: str) -> dict[str, dict]:
+        now = time.time()
+        out = {}
+        sub = self._data.get(key)
+        if not sub:
+            return out
+        dead = []
+        for sk, (v, exp) in sub.items():
+            if exp < now:
+                dead.append(sk)
+            else:
+                out[sk] = v
+        for sk in dead:
+            del sub[sk]
+        return out
+
+    def delete(self, key: str, subkey: str):
+        sub = self._data.get(key)
+        if sub:
+            sub.pop(subkey, None)
+
+
+class RegistryServer:
+    """Standalone registry node (bootstrap peer)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._store = _Store()
+        self.rpc = RpcServer(
+            unary_handlers={
+                "registry_store": self._rpc_store,
+                "registry_get": self._rpc_get,
+                "registry_delete": self._rpc_delete,
+            },
+            host=host,
+            port=port,
+        )
+
+    @property
+    def port(self) -> int:
+        return self.rpc.port
+
+    async def start(self):
+        await self.rpc.start()
+
+    async def stop(self):
+        await self.rpc.stop()
+
+    async def _rpc_store(self, meta: dict, tensors):
+        now = time.time()
+        for rec in meta["records"]:
+            self._store.store(
+                rec["key"], rec["subkey"], rec["value"],
+                now + rec["expiration"],
+            )
+        return {"ok": True}, []
+
+    async def _rpc_get(self, meta: dict, tensors):
+        return {"results": {k: self._store.get(k) for k in meta["keys"]}}, []
+
+    async def _rpc_delete(self, meta: dict, tensors):
+        for rec in meta["records"]:
+            self._store.delete(rec["key"], rec["subkey"])
+        return {"ok": True}, []
+
+
+class RegistryClient:
+    """Client handle to the registry (used by servers and model clients)."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._conn: Connection | None = None
+        self._lock = asyncio.Lock()
+
+    async def _connection(self) -> Connection:
+        async with self._lock:
+            if self._conn is None or self._conn.is_closing():
+                self._conn = await connect(self.host, self.port)
+            return self._conn
+
+    async def close(self):
+        if self._conn is not None:
+            await self._conn.close()
+            self._conn = None
+
+    async def declare_blocks(
+        self,
+        model_uid: str,
+        server_id: str,
+        blocks: range,
+        info: ServerInfo,
+        expiration: float = 30.0,
+    ) -> None:
+        """reference: declare_active_modules (utils/dht.py:28-73)."""
+        conn = await self._connection()
+        records = [
+            {
+                "key": f"{model_uid}.{i}",
+                "subkey": server_id,
+                "value": info.to_wire(),
+                "expiration": expiration,
+            }
+            for i in blocks
+        ]
+        await conn.call("registry_store", {"records": records})
+
+    async def revoke_blocks(
+        self, model_uid: str, server_id: str, blocks: range
+    ) -> None:
+        conn = await self._connection()
+        records = [
+            {"key": f"{model_uid}.{i}", "subkey": server_id} for i in blocks
+        ]
+        await conn.call("registry_delete", {"records": records})
+
+    async def get_module_infos(
+        self, model_uid: str, blocks: range
+    ) -> list[ModuleInfo]:
+        """reference: get_remote_module_infos (utils/dht.py:74-117)."""
+        conn = await self._connection()
+        keys = [f"{model_uid}.{i}" for i in blocks]
+        meta, _ = await conn.call("registry_get", {"keys": keys})
+        out = []
+        for i, key in zip(blocks, keys):
+            servers = {
+                sid: ServerInfo.from_wire(v)
+                for sid, v in meta["results"].get(key, {}).items()
+            }
+            out.append(ModuleInfo(uid=key, servers=servers))
+        return out
+
+
+class InProcessRegistry:
+    """Registry + client fused for single-process tests."""
+
+    def __init__(self):
+        self._store = _Store()
+
+    async def declare_blocks(self, model_uid, server_id, blocks, info,
+                             expiration: float = 30.0):
+        now = time.time()
+        for i in blocks:
+            self._store.store(
+                f"{model_uid}.{i}", server_id, info.to_wire(), now + expiration
+            )
+
+    async def revoke_blocks(self, model_uid, server_id, blocks):
+        for i in blocks:
+            self._store.delete(f"{model_uid}.{i}", server_id)
+
+    async def get_module_infos(self, model_uid, blocks):
+        out = []
+        for i in blocks:
+            key = f"{model_uid}.{i}"
+            servers = {
+                sid: ServerInfo.from_wire(v)
+                for sid, v in self._store.get(key).items()
+            }
+            out.append(ModuleInfo(uid=key, servers=servers))
+        return out
+
+    async def close(self):
+        pass

@@ -1,0 +1,29 @@
+"""Wire plane: tensor serialization, lossless compression, async RPC.
+
+Replaces the reference's transport stack — hivemind libp2p streams + protobuf
+ExpertRequest/Response + the lossless_transport wrapper
+(/root/reference/src/bloombee/utils/lossless_transport.py, SURVEY.md section
+2.7). The capability seams are kept (unary + bidirectional streaming RPC,
+server->server push, compressed tensor frames with MSGPack metadata); the
+implementation is a length-prefixed msgpack framing over asyncio TCP, which a
+TPU-VM swarm reaches over DCN.
+"""
+
+from bloombee_tpu.wire.tensor_codec import (
+    serialize_tensor,
+    deserialize_tensor,
+    serialize_tensors,
+    deserialize_tensors,
+)
+from bloombee_tpu.wire.rpc import Connection, RpcServer, RpcError, connect
+
+__all__ = [
+    "serialize_tensor",
+    "deserialize_tensor",
+    "serialize_tensors",
+    "deserialize_tensors",
+    "Connection",
+    "RpcServer",
+    "RpcError",
+    "connect",
+]

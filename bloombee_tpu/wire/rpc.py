@@ -1,0 +1,405 @@
+"""Async RPC over length-prefixed msgpack frames on TCP.
+
+Provides the reference's RPC surface (SURVEY.md section 2.7 / 5): unary calls
+(`rpc_info`, `rpc_forward`, `rpc_backward`), one-way pushes (`rpc_push`), and
+bidirectional streams (`rpc_inference`) — the semantics of hivemind's
+libp2p/protobuf transport re-provided natively. One TCP connection multiplexes
+any number of concurrent calls and streams by frame id.
+
+Frame layout: [u32 frame_len][u32 header_len][msgpack header][tensor blobs].
+The header carries method, metadata (msgpack dict — the reference's MSGPack
+sidecar), and per-tensor codec metas (see tensor_codec).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import struct
+from typing import Awaitable, Callable
+
+import msgpack
+import numpy as np
+
+from bloombee_tpu.wire.tensor_codec import (
+    deserialize_tensors,
+    serialize_tensors,
+)
+
+logger = logging.getLogger(__name__)
+
+MAX_FRAME = 1 << 31  # 2 GiB
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+class ConnectionClosed(RpcError):
+    pass
+
+
+def _encode_frame(header: dict, blobs: list[bytes]) -> bytes:
+    header = dict(header)
+    header["bl"] = [len(b) for b in blobs]
+    h = msgpack.packb(header, use_bin_type=True)
+    total = 4 + len(h) + sum(len(b) for b in blobs)
+    out = bytearray()
+    out += struct.pack("<II", total, len(h))
+    out += h
+    for b in blobs:
+        out += b
+    return bytes(out)
+
+
+class Stream:
+    """One side of a bidirectional stream (the rpc_inference session carrier,
+    reference: handler.py:798-1257)."""
+
+    def __init__(self, conn: "Connection", stream_id: int, meta: dict,
+                 tensors: list[np.ndarray]):
+        self.conn = conn
+        self.id = stream_id
+        self.open_meta = meta
+        self.open_tensors = tensors
+        self._inbox: asyncio.Queue = asyncio.Queue()
+        self._closed_local = False
+        self._closed_remote = False
+
+    async def send(self, meta: dict, tensors: list[np.ndarray] | None = None,
+                   compression: bool = True) -> None:
+        if self._closed_local:
+            raise RpcError("stream closed")
+        tm, blobs = serialize_tensors(tensors or [], compression)
+        await self.conn._send(
+            {"t": "sitem", "id": self.id, "meta": meta, "tm": tm}, blobs
+        )
+
+    async def recv(self) -> tuple[dict, list[np.ndarray]] | None:
+        """Next item, or None once the peer half-closed."""
+        if self._closed_remote and self._inbox.empty():
+            return None
+        item = await self._inbox.get()
+        if item is None:
+            self._closed_remote = True
+            return None
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    async def close(self, meta: dict | None = None) -> None:
+        """Half-close: tells the peer no more items will be sent."""
+        if not self._closed_local:
+            self._closed_local = True
+            if not self.conn.is_closing():
+                await self.conn._send(
+                    {"t": "send", "id": self.id, "meta": meta or {}}, []
+                )
+
+    def _push_inbound(self, item) -> None:
+        self._inbox.put_nowait(item)
+
+
+UnaryHandler = Callable[[dict, list[np.ndarray]], Awaitable[tuple[dict, list[np.ndarray]]]]
+StreamHandler = Callable[[Stream], Awaitable[None]]
+PushHandler = Callable[[dict, list[np.ndarray]], Awaitable[None]]
+
+
+class Connection:
+    """A multiplexed RPC connection (either direction)."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        unary_handlers: dict[str, UnaryHandler] | None = None,
+        stream_handlers: dict[str, StreamHandler] | None = None,
+        push_handlers: dict[str, PushHandler] | None = None,
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.unary_handlers = unary_handlers or {}
+        self.stream_handlers = stream_handlers or {}
+        self.push_handlers = push_handlers or {}
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._streams: dict[int, Stream] = {}
+        self._tasks: set[asyncio.Task] = set()
+        self._send_lock = asyncio.Lock()
+        self._reader_task: asyncio.Task | None = None
+        self._closed = asyncio.Event()
+        self.on_close: Callable[["Connection"], None] | None = None
+
+    # ------------------------------------------------------------------ setup
+    def start(self) -> None:
+        self._reader_task = asyncio.create_task(self._read_loop())
+
+    def is_closing(self) -> bool:
+        return self._closed.is_set() or self.writer.is_closing()
+
+    async def close(self) -> None:
+        self._closed.set()
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        for t in list(self._tasks):
+            t.cancel()
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+        self._fail_all(ConnectionClosed("connection closed"))
+
+    def _fail_all(self, exc: Exception) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+        for s in self._streams.values():
+            s._push_inbound(exc)
+        self._streams.clear()
+
+    # -------------------------------------------------------------- client API
+    async def call(
+        self,
+        method: str,
+        meta: dict | None = None,
+        tensors: list[np.ndarray] | None = None,
+        timeout: float | None = None,
+        compression: bool = True,
+    ) -> tuple[dict, list[np.ndarray]]:
+        rid = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        tm, blobs = serialize_tensors(tensors or [], compression)
+        await self._send(
+            {"t": "req", "id": rid, "m": method, "meta": meta or {}, "tm": tm},
+            blobs,
+        )
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(rid, None)
+
+    async def push(
+        self,
+        method: str,
+        meta: dict | None = None,
+        tensors: list[np.ndarray] | None = None,
+        compression: bool = True,
+    ) -> None:
+        """Fire-and-forget (the reference's rpc_push plane)."""
+        tm, blobs = serialize_tensors(tensors or [], compression)
+        await self._send(
+            {"t": "push", "id": 0, "m": method, "meta": meta or {}, "tm": tm},
+            blobs,
+        )
+
+    async def open_stream(
+        self,
+        method: str,
+        meta: dict | None = None,
+        tensors: list[np.ndarray] | None = None,
+        compression: bool = True,
+    ) -> Stream:
+        rid = next(self._ids)
+        stream = Stream(self, rid, meta or {}, tensors or [])
+        self._streams[rid] = stream
+        tm, blobs = serialize_tensors(tensors or [], compression)
+        await self._send(
+            {"t": "sopen", "id": rid, "m": method, "meta": meta or {}, "tm": tm},
+            blobs,
+        )
+        return stream
+
+    # --------------------------------------------------------------- internals
+    async def _send(self, header: dict, blobs: list[bytes]) -> None:
+        frame = _encode_frame(header, blobs)
+        async with self._send_lock:
+            self.writer.write(frame)
+            await self.writer.drain()
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                head = await self.reader.readexactly(8)
+                total, hlen = struct.unpack("<II", head)
+                if total > MAX_FRAME:
+                    raise RpcError(f"frame too large: {total}")
+                body = await self.reader.readexactly(total - 4)
+                header = msgpack.unpackb(body[:hlen], raw=False)
+                blobs = []
+                off = hlen
+                for blen in header.get("bl", []):
+                    blobs.append(body[off : off + blen])
+                    off += blen
+                self._dispatch(header, blobs)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except asyncio.CancelledError:
+            return
+        except Exception as e:  # pragma: no cover
+            logger.exception("rpc read loop error: %s", e)
+        finally:
+            self._closed.set()
+            self._fail_all(ConnectionClosed("peer disconnected"))
+            # close our side of the transport too: asyncio.Server.wait_closed
+            # blocks until every accepted connection's transport is closed
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+            if self.on_close is not None:
+                self.on_close(self)
+
+    def _dispatch(self, header: dict, blobs: list[bytes]) -> None:
+        t = header["t"]
+        rid = header["id"]
+        if t == "req":
+            self._spawn(self._handle_unary(header, blobs))
+        elif t == "push":
+            self._spawn(self._handle_push(header, blobs))
+        elif t == "sopen":
+            tensors = deserialize_tensors(header.get("tm", []), blobs)
+            stream = Stream(self, rid, header.get("meta", {}), tensors)
+            self._streams[rid] = stream
+            self._spawn(self._handle_stream(header["m"], stream))
+        elif t == "sitem":
+            stream = self._streams.get(rid)
+            if stream is not None:
+                tensors = deserialize_tensors(header.get("tm", []), blobs)
+                stream._push_inbound((header.get("meta", {}), tensors))
+        elif t == "send":
+            stream = self._streams.get(rid)
+            if stream is not None:
+                stream._push_inbound(None)
+        elif t == "res":
+            fut = self._pending.get(rid)
+            if fut is not None and not fut.done():
+                tensors = deserialize_tensors(header.get("tm", []), blobs)
+                fut.set_result((header.get("meta", {}), tensors))
+        elif t == "err":
+            fut = self._pending.get(rid)
+            if fut is not None and not fut.done():
+                fut.set_exception(RpcError(header.get("meta", {}).get("error", "remote error")))
+            stream = self._streams.get(rid)
+            if stream is not None:
+                stream._push_inbound(RpcError(header.get("meta", {}).get("error", "remote error")))
+        else:
+            logger.warning("unknown frame type %r", t)
+
+    def _spawn(self, coro) -> None:
+        task = asyncio.create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _handle_unary(self, header: dict, blobs: list[bytes]) -> None:
+        rid = header["id"]
+        method = header["m"]
+        try:
+            handler = self.unary_handlers.get(method)
+            if handler is None:
+                raise RpcError(f"no such method: {method}")
+            tensors = deserialize_tensors(header.get("tm", []), blobs)
+            meta, out = await handler(header.get("meta", {}), tensors)
+            tm, oblobs = serialize_tensors(out)
+            await self._send({"t": "res", "id": rid, "meta": meta, "tm": tm}, oblobs)
+        except Exception as e:
+            logger.debug("unary handler %s failed: %s", method, e)
+            if not self.is_closing():
+                await self._send(
+                    {"t": "err", "id": rid, "meta": {"error": f"{type(e).__name__}: {e}"}},
+                    [],
+                )
+
+    async def _handle_push(self, header: dict, blobs: list[bytes]) -> None:
+        method = header["m"]
+        handler = self.push_handlers.get(method)
+        if handler is None:
+            logger.warning("no push handler for %s", method)
+            return
+        tensors = deserialize_tensors(header.get("tm", []), blobs)
+        try:
+            await handler(header.get("meta", {}), tensors)
+        except Exception as e:
+            logger.exception("push handler %s failed: %s", method, e)
+
+    async def _handle_stream(self, method: str, stream: Stream) -> None:
+        handler = self.stream_handlers.get(method)
+        if handler is None:
+            await self._send(
+                {"t": "err", "id": stream.id,
+                 "meta": {"error": f"no such stream method: {method}"}},
+                [],
+            )
+            return
+        try:
+            await handler(stream)
+        except Exception as e:
+            logger.exception("stream handler %s failed: %s", method, e)
+            if not self.is_closing():
+                await self._send(
+                    {"t": "err", "id": stream.id,
+                     "meta": {"error": f"{type(e).__name__}: {e}"}},
+                    [],
+                )
+        finally:
+            self._streams.pop(stream.id, None)
+
+
+class RpcServer:
+    """Listening side: accepts connections, one Connection per peer."""
+
+    def __init__(
+        self,
+        unary_handlers: dict[str, UnaryHandler] | None = None,
+        stream_handlers: dict[str, StreamHandler] | None = None,
+        push_handlers: dict[str, PushHandler] | None = None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        self.unary_handlers = unary_handlers or {}
+        self.stream_handlers = stream_handlers or {}
+        self.push_handlers = push_handlers or {}
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set[Connection] = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connect, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def _on_connect(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        conn = Connection(
+            reader, writer,
+            self.unary_handlers, self.stream_handlers, self.push_handlers,
+        )
+        conn.on_close = self._conns.discard
+        self._conns.add(conn)
+        conn.start()
+
+    async def stop(self) -> None:
+        for c in list(self._conns):
+            await c.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+async def connect(
+    host: str,
+    port: int,
+    unary_handlers: dict[str, UnaryHandler] | None = None,
+    stream_handlers: dict[str, StreamHandler] | None = None,
+    push_handlers: dict[str, PushHandler] | None = None,
+) -> Connection:
+    reader, writer = await asyncio.open_connection(host, port)
+    conn = Connection(reader, writer, unary_handlers, stream_handlers, push_handlers)
+    conn.start()
+    return conn

@@ -1,0 +1,206 @@
+"""End-to-end swarm tests: registry + block servers + client generate.
+
+Port of the reference's live-swarm tier (/root/reference/tests/
+test_full_model.py — full logits/token parity vs a local HF model — and the
+fault-tolerance behavior of inference_session re-routing). Multi-node is
+simulated as multiple in-process servers on loopback, like the reference's
+multi-process single-host harness.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.server.block_server import BlockServer
+from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=3,
+        vocab_size=128,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = tmp_path_factory.mktemp("tiny_llama")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model, config
+
+
+def _server(model_dir, registry, start, end, **kw):
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 4)
+    return BlockServer(
+        model_uid="tiny", start=start, end=end, model_dir=model_dir,
+        registry=registry, **kw,
+    )
+
+
+def _hf_greedy(model, input_ids, max_new_tokens):
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor(input_ids), max_new_tokens=max_new_tokens,
+            do_sample=False, use_cache=True,
+        )
+    return out.numpy()
+
+
+@pytest.mark.parametrize("use_push", [False, True])
+def test_two_server_generate_matches_hf(tiny_model_dir, use_push):
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        reg_client = RegistryClient("127.0.0.1", reg.port)
+        s1 = _server(model_dir, RegistryClient("127.0.0.1", reg.port), 0, 2)
+        s2 = _server(model_dir, RegistryClient("127.0.0.1", reg.port), 2, 3)
+        await s1.start()
+        await s2.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, reg_client, model_uid="tiny", use_push=use_push
+        )
+        rng = np.random.default_rng(0)
+        input_ids = rng.integers(0, config.vocab_size, size=(2, 6))
+        ids = await model.generate(input_ids, max_new_tokens=8)
+        ref = _hf_greedy(hf_model, input_ids, 8)
+        np.testing.assert_array_equal(ids, ref)
+
+        await s1.stop()
+        await s2.stop()
+        await reg_client.close()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_logits_parity_full_chain(tiny_model_dir):
+    """Per-position logits parity vs HF full forward (reference
+    test_full_model.py atol 1e-3)."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s1 = _server(model_dir, RegistryClient("127.0.0.1", reg.port), 0, 3)
+        await s1.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, RegistryClient("127.0.0.1", reg.port), model_uid="tiny"
+        )
+        input_ids = np.arange(10)[None, :] % config.vocab_size
+        async with model.inference_session(16, 1) as sess:
+            hidden = model.embed(input_ids)
+            out = await sess.step(hidden)
+        logits = model.logits(out)
+        with torch.no_grad():
+            ref = hf_model(torch.tensor(input_ids)).logits.numpy()
+        np.testing.assert_allclose(logits, ref, atol=1e-3, rtol=1e-3)
+
+        await s1.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_overlapping_spans_suffix_entry(tiny_model_dir):
+    """Overlapping spans A=[0,2) and B=[1,3): the router enters B mid-span
+    (suffix sub-span) and the server must run only the requested layers
+    (reference: spans_containing_block partial-span usage)."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s_a = _server(model_dir, rc(), 0, 2)
+        s_b = _server(model_dir, rc(), 1, 3)
+        await s_a.start()
+        await s_b.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", use_push=False
+        )
+        input_ids = np.arange(7)[None, :] % config.vocab_size
+        session = model.inference_session(24, 1)
+        await session.__aenter__()
+        spans = [(s.span.start, s.span.end) for s in session._spans]
+        assert spans == [(0, 2), (2, 3)], spans  # B entered at its 2nd layer
+        ids = await model.generate(input_ids, max_new_tokens=6, session=session)
+        await session.__aexit__(None, None, None)
+        ref = _hf_greedy(hf_model, input_ids, 6)
+        np.testing.assert_array_equal(ids, ref)
+
+        await s_a.stop()
+        await s_b.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_failover_rereoute_and_replay(tiny_model_dir):
+    """Kill the preferred server mid-generation; the session re-routes to the
+    backup, replays history, and produces identical tokens
+    (reference inference_session._update_sequence semantics)."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s_a = _server(model_dir, rc(), 0, 2, throughput=10.0)
+        s_b = _server(model_dir, rc(), 2, 3, throughput=10.0)  # preferred
+        s_c = _server(model_dir, rc(), 2, 3, throughput=1.0)  # backup
+        for s in (s_a, s_b, s_c):
+            await s.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", use_push=False
+        )
+        input_ids = np.arange(5)[None, :] % config.vocab_size
+        ref = _hf_greedy(hf_model, input_ids, 6)
+
+        session = model.inference_session(16, 1)
+        await session.__aenter__()
+        used = {s.span.server_info.port for s in session._spans}
+        assert s_b.port in used and s_c.port not in used
+
+        ids = await model.generate(
+            input_ids, max_new_tokens=3, session=session
+        )
+        await s_b.stop()  # preferred server dies mid-session
+        more = await model.generate(
+            ids[:, -1:], max_new_tokens=2, session=session
+        )
+        final = np.concatenate([ids, more[:, 1:]], axis=1)
+        np.testing.assert_array_equal(final, ref[:, : final.shape[1]])
+
+        await session.__aexit__(None, None, None)
+        for s in (s_a, s_c):
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
