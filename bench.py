@@ -1,0 +1,194 @@
+"""Headline bench: Llama-3-8B-dimension span decode throughput on one chip.
+
+Measures the server-side decode path (paged KV arena + scan-over-blocks span
+step) on an 8-layer span with Llama-3-8B dimensions in bfloat16 — the
+per-chip unit of the north-star config (BASELINE.md: 8B served from a v5e-8
+swarm, 32 layers = 4 such spans). Decode steps run as ONE jitted lax.scan
+over per-step plans with the KV arena as carry, so the number reflects
+on-device serving throughput, not host-link latency.
+
+Prints exactly one JSON line:
+  value = full-model-equivalent decode tokens/sec (batch), i.e.
+          span_steps_per_sec * batch / 4 spans
+  vs_baseline = value / 35.0  (A100 single-stream Llama-3-8B decode tok/s,
+          the reference's north-star comparison point)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bloombee_tpu.kv.arena import make_arena
+    from bloombee_tpu.models.llama.block import init_block_params
+    from bloombee_tpu.models.spec import ModelSpec
+    from bloombee_tpu.runtime.step import pack_plan, span_step_impl
+    from bloombee_tpu.utils.tree import stack_params
+
+    # one span = 8 of Llama-3-8B's 32 layers
+    span_layers, total_layers = 8, 32
+    spec = ModelSpec(
+        family="llama",
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        head_dim=128,
+        num_hidden_layers=span_layers,
+        vocab_size=128256,
+    )
+    B, PREFILL, DECODE = 8, 128, 64
+    page_size, num_pages = 16, 128
+    max_pages = 16  # 256-token bucket
+
+    log(f"devices: {jax.devices()}")
+    params = stack_params(
+        [
+            init_block_params(jax.random.PRNGKey(i), spec, dtype=jnp.bfloat16)
+            for i in range(span_layers)
+        ]
+    )
+    arena = make_arena(
+        span_layers, num_pages, page_size, spec.num_key_value_heads,
+        spec.head_dim, jnp.bfloat16,
+    )
+
+    pages_per_seq = (PREFILL + DECODE + page_size - 1) // page_size
+    page_table = np.zeros((B, max_pages), np.int32)
+    for i in range(B):
+        page_table[i, :pages_per_seq] = np.arange(
+            i * pages_per_seq, (i + 1) * pages_per_seq
+        )
+
+    def slots_for(positions):  # positions [B, T]
+        page = page_table[
+            np.arange(B)[:, None], positions // page_size
+        ]
+        return (page * page_size + positions % page_size).reshape(-1)
+
+    # ---- prefill (one span_step call, T=PREFILL)
+    pre_pos = np.broadcast_to(np.arange(PREFILL)[None], (B, PREFILL))
+    pre_plan = pack_plan(
+        slots_for(pre_pos),
+        page_table,
+        pre_pos,
+        np.full((B,), PREFILL, np.int32),
+        np.ones((span_layers,), np.int32),
+    )
+    hidden0 = jax.random.normal(
+        jax.random.PRNGKey(42), (B, PREFILL, spec.hidden_size), jnp.bfloat16
+    ) * 0.02
+
+    def fence(x) -> float:
+        """Force full materialization: block_until_ready is unreliable on
+        tunneled PJRT backends, so fetch a scalar reduction to host."""
+        return float(jnp.sum(x.astype(jnp.float32)))
+
+    step = jax.jit(
+        lambda p, ak, av, h, plan: span_step_impl(
+            p, ak, av, h, plan, None,
+            spec=spec, page_size=page_size, max_pages=max_pages,
+        ),
+        donate_argnums=(1, 2),
+    )
+    t0 = time.time()
+    h, ak, av = step(params, arena["k"], arena["v"], hidden0, jnp.asarray(pre_plan))
+    fence(h)
+    log(f"prefill({B}x{PREFILL}) compile+run: {time.time()-t0:.1f}s")
+    # calibrate the fence cost itself (dispatch + scalar d2h latency)
+    t0 = time.time()
+    for _ in range(3):
+        fence(h)
+    fence_cost = (time.time() - t0) / 3
+    log(f"fence cost: {fence_cost*1000:.1f} ms")
+
+    # ---- fused decode: one jitted scan over per-step plans
+    plans = []
+    for s in range(DECODE):
+        pos = np.full((B, 1), PREFILL + s, np.int32)
+        plans.append(
+            pack_plan(
+                slots_for(pos), page_table, pos,
+                np.full((B,), PREFILL + s + 1, np.int32),
+                np.ones((span_layers,), np.int32),
+            )
+        )
+    plans = jnp.asarray(np.stack(plans))  # [N, plan_len]
+
+    def decode_many(params, ak, av, h_last, plans):
+        def body(carry, plan):
+            h, ak, av = carry
+            h, ak, av = span_step_impl(
+                params, ak, av, h, plan, None,
+                spec=spec, page_size=page_size, max_pages=max_pages,
+            )
+            return (h, ak, av), None
+
+        (h, ak, av), _ = lax.scan(body, (h_last, ak, av), plans)
+        return h, ak, av
+
+    decode_jit = jax.jit(decode_many, donate_argnums=(1, 2))
+
+    h_last = h[:, -1:, :]
+    t0 = time.time()
+    h2, ak, av = decode_jit(params, ak, av, h_last, plans)
+    fence(h2)
+    log(f"decode scan compile+run: {time.time()-t0:.1f}s")
+
+    # steady state: chain REPEAT scans (overwrites same cache slots; same
+    # compute), one fence at the end, fence cost subtracted
+    REPEAT = 4
+    t0 = time.time()
+    for _ in range(REPEAT):
+        h2, ak, av = decode_jit(params, ak, av, h_last, plans)
+    fence(h2)
+    elapsed = max(time.time() - t0 - fence_cost, 1e-9)
+    total_steps = DECODE * REPEAT
+
+    # timing prefill again post-compile for TTFT
+    t0 = time.time()
+    h3, ak, av = step(params, ak, av, hidden0, jnp.asarray(pre_plan))
+    fence(h3)
+    ttft = max(time.time() - t0 - fence_cost, 0.0)
+
+    steps_per_sec = total_steps / elapsed
+    batch_tok_per_sec = steps_per_sec * B
+    spans_per_model = total_layers // span_layers
+    equiv_per_seq = steps_per_sec / spans_per_model
+    equiv_batch = batch_tok_per_sec / spans_per_model
+    log(
+        f"span decode: {steps_per_sec:.1f} steps/s; 8B-equiv per-seq "
+        f"{equiv_per_seq:.1f} tok/s, batch({B}) {equiv_batch:.0f} tok/s; "
+        f"prefill(ttft proxy) {ttft*1000:.0f} ms"
+    )
+
+    # value: full-model-equivalent PER-SEQUENCE decode tok/s (while serving
+    # batch 8); baseline 35 tok/s = single-A100 single-stream HF decode on
+    # Llama-3-8B, the north-star comparison (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": "llama3_8b_equiv_decode_tok_per_s_per_seq",
+                "value": round(equiv_per_seq, 2),
+                "unit": "tokens/sec/seq",
+                "vs_baseline": round(equiv_per_seq / 35.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Device mesh construction.
+
+Axes: dp (data/batch), pp (pipeline stages), tp (tensor/heads), sp
+(sequence/context). Collectives along tp/sp are the hot ones and should map
+to ICI on real hardware; dp/pp gradients and activations tolerate DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "pp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.pp * self.tp * self.sp
+
+
+def make_mesh(config: MeshConfig, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if config.size > len(devices):
+        raise ValueError(
+            f"mesh needs {config.size} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[: config.size]).reshape(
+        config.dp, config.pp, config.tp, config.sp
+    )
+    return Mesh(arr, AXES)

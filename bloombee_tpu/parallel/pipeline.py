@@ -1,0 +1,71 @@
+"""GPipe micro-batch pipeline over the "pp" mesh axis, inside one jit.
+
+The swarm-level pipeline (client chains server spans over the wire, with
+rpc_push between stages) is the reference's core design; THIS module is the
+intra-jit equivalent for a multi-chip host: stacked span params are sharded
+over "pp" on the layer dim, each stage runs its local layers, and hidden
+states hop stage-to-stage via lax.ppermute over ICI. Micro-batches fill the
+pipe GPipe-style: M micro-batches over P stages take M + P - 1 ticks
+(reference analogue: micro-batch pipelining, SURVEY.md section 2.8 row 2).
+
+Differentiable end-to-end (scan + ppermute), so the training step backprops
+straight through the pipeline schedule.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.parallel.spmd import spmd_span_forward
+
+
+def gpipe_forward(
+    stacked_local: dict,  # this stage's local layer shards
+    micro_hidden: jax.Array,  # [M, mb, C, D] micro-batched input (all stages
+    # hold identical copies; only stage 0 injects)
+    *,
+    spec: ModelSpec,
+    pp_axis: str = "pp",
+    sp_axis: str = "sp",
+    tp_axis: str = "tp",
+) -> jax.Array:
+    """Returns [M, mb, C, D] outputs, valid (and identical) on all pp ranks."""
+    p = lax.axis_size(pp_axis)
+    rank = lax.axis_index(pp_axis)
+    m, mb, c, d = micro_hidden.shape
+    ticks = m + p - 1
+
+    fwd = [(j, (j + 1) % p) for j in range(p)]  # stage i -> i+1
+
+    def tick(carry, t):
+        h_prev, outputs = carry
+        # stage 0 injects micro-batch t (zeros once the pipe drains)
+        inject = jnp.where(
+            t < m, micro_hidden[jnp.minimum(t, m - 1)], jnp.zeros((mb, c, d), micro_hidden.dtype)
+        )
+        h_in = jnp.where(rank == 0, inject, h_prev)
+        h_out = spmd_span_forward(
+            stacked_local, h_in, spec=spec, sp_axis=sp_axis, tp_axis=tp_axis
+        )
+        # last stage finishes micro-batch t - (p - 1) at tick t
+        out_idx = t - (p - 1)
+        outputs = jnp.where(
+            (rank == p - 1) & (out_idx >= 0),
+            lax.dynamic_update_index_in_dim(
+                outputs, h_out, jnp.maximum(out_idx, 0), axis=0
+            ),
+            outputs,
+        )
+        h_next = lax.ppermute(h_out, pp_axis, fwd)
+        return (h_next, outputs), None
+
+    h0 = jnp.zeros((mb, c, d), micro_hidden.dtype)
+    out0 = jnp.zeros_like(micro_hidden)
+    (_, outputs), _ = lax.scan(
+        tick, (h0, out0), jnp.arange(ticks)
+    )
+    # broadcast the last stage's outputs to every pp rank (zeros elsewhere)
+    return lax.psum(outputs, pp_axis)

@@ -1,0 +1,84 @@
+"""Ring attention: sequence/context parallelism over the "sp" mesh axis.
+
+Each device holds one sequence chunk of Q, K, V. KV chunks rotate around the
+ring (lax.ppermute over ICI) while each device accumulates its Q block's
+attention with a numerically-stable online softmax (flash-attention style
+streaming stats). After sp steps every Q block has seen every KV block and
+no device ever materializes full-sequence attention logits.
+
+This fills the reference's explicit long-context gap (SURVEY.md section 5:
+"no ring attention / Ulysses / context parallelism" — it only chunks prefill
+and offloads the KV slab to host). Compute stays in the input dtype for the
+MXU; softmax stats are fp32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bloombee_tpu.ops.attention import NEG_INF as NEG, repeat_kv
+
+
+def ring_attention(
+    q: jax.Array,  # [B, C, H, hd] local query chunk
+    k: jax.Array,  # [B, C, Hkv, hd] local key chunk
+    v: jax.Array,  # [B, C, Hkv, hd]
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Must be called inside shard_map with `axis_name` mapped; returns the
+    local output chunk [B, C, H, hd]."""
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    b, c, h, hd = q.shape
+    n_rep = h // k.shape[2]
+    if scale is None:
+        scale = hd**-0.5
+
+    q_pos = rank * c + jnp.arange(c)  # global positions of local queries
+    qf = q  # [B, C, H, hd]
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        src = (rank - i) % n  # who produced the block currently held
+        kv_pos = src * c + jnp.arange(c)
+
+        k_r = repeat_kv(k_cur, n_rep)
+        v_r = repeat_kv(v_cur, n_rep)
+        logits = (
+            jnp.einsum("bqhd,bkhd->bhqk", qf, k_r).astype(jnp.float32) * scale
+        )
+        if causal:
+            mask = kv_pos[None, :] <= q_pos[:, None]  # [Cq, Ck]
+            logits = jnp.where(mask[None, None], logits, NEG)
+            pmask = mask[None, None].astype(jnp.float32)
+        else:
+            pmask = jnp.ones((1, 1, c, c), jnp.float32)
+
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None]) * pmask  # finite everywhere
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(q.dtype), v_r
+        ).astype(jnp.float32)
+
+        # rotate KV to the next rank on the ring
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    m0 = jnp.full((b, h, c), NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, c), jnp.float32)
+    acc0 = jnp.zeros((b, h, c, hd), jnp.float32)
+    # scan (not fori_loop) so the ring is reverse-differentiable for training
+    (m, l, acc, _, _), _ = lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(n)
+    )
+
+    out = acc / jnp.maximum(l, 1e-20)[..., None]  # fully-masked rows -> 0
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, C, H, hd]

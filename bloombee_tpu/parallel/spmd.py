@@ -1,0 +1,123 @@
+"""Megatron-style SPMD block compute under shard_map (tp + sp + dp).
+
+Replaces the reference's intra-host tensor parallelism
+(/root/reference/src/bloombee/server/flexgen_tensor_parallel.py:172-828:
+per-device CUDA streams, row/col weight slices, stream all-reduce) with the
+TPU idiom: weights sharded over the "tp" mesh axis, local matmuls on each
+shard, one psum over ICI after o_proj and down_proj. Attention runs as ring
+attention over the "sp" axis, so long sequences scale across the mesh instead
+of offloading to host.
+
+All functions here execute INSIDE shard_map (they use axis primitives);
+`shard_span_params` prepares the NamedSharding placement that makes shard_map
+hand each device its local shard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.ops import rms_norm, silu_mlp
+from bloombee_tpu.ops.rotary import apply_rotary, rotary_cos_sin
+from bloombee_tpu.parallel.ring_attention import ring_attention
+
+# PartitionSpecs for stacked span params [L, ...]; layer dim shards over pp
+PARAM_SPECS = {
+    "input_layernorm": P("pp", None),
+    "post_attention_layernorm": P("pp", None),
+    "q_proj": P("pp", None, "tp"),
+    "k_proj": P("pp", None, "tp"),
+    "v_proj": P("pp", None, "tp"),
+    "o_proj": P("pp", "tp", None),
+    "gate_proj": P("pp", None, "tp"),
+    "up_proj": P("pp", None, "tp"),
+    "down_proj": P("pp", "tp", None),
+    "q_norm": P("pp", None),
+    "k_norm": P("pp", None),
+}
+
+
+def param_specs(params: dict) -> dict:
+    return {k: PARAM_SPECS[k] for k in params}
+
+
+def shard_span_params(params: dict, mesh: Mesh) -> dict:
+    """Place stacked span params on the mesh (pp over layers, tp over
+    heads/ffn)."""
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, PARAM_SPECS[k]))
+        for k, v in params.items()
+    }
+
+
+def spmd_block_forward(
+    params_l: dict,  # one layer's LOCAL param shards
+    hidden: jax.Array,  # [b_local, C, D] (dp-sharded batch, sp-sharded seq)
+    *,
+    spec: ModelSpec,
+    sp_axis: str = "sp",
+    tp_axis: str = "tp",
+) -> jax.Array:
+    b, c, d = hidden.shape
+    tp = lax.axis_size(tp_axis)
+    if spec.num_attention_heads % tp or spec.num_key_value_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_attention_heads="
+            f"{spec.num_attention_heads} and num_key_value_heads="
+            f"{spec.num_key_value_heads} (KV-head replication not yet "
+            "implemented)"
+        )
+    h_local = spec.num_attention_heads // tp
+    kv_local = spec.num_key_value_heads // tp
+    hd = spec.head_dim
+
+    sp_rank = lax.axis_index(sp_axis)
+    positions = sp_rank * c + jnp.arange(c)
+    positions = jnp.broadcast_to(positions[None], (b, c))
+    cos, sin = rotary_cos_sin(positions, hd, spec.rope_theta)
+    cos = cos.astype(hidden.dtype)
+    sin = sin.astype(hidden.dtype)
+
+    x = rms_norm(hidden, params_l["input_layernorm"], spec.rms_norm_eps)
+    q = (x @ params_l["q_proj"]).reshape(b, c, h_local, hd)
+    k = (x @ params_l["k_proj"]).reshape(b, c, kv_local, hd)
+    v = (x @ params_l["v_proj"]).reshape(b, c, kv_local, hd)
+    if spec.qk_norm:
+        q = rms_norm(q, params_l["q_norm"], spec.rms_norm_eps)
+        k = rms_norm(k, params_l["k_norm"], spec.rms_norm_eps)
+    q, k = apply_rotary(q, k, cos, sin)
+
+    attn = ring_attention(q, k, v, axis_name=sp_axis, causal=True)
+    partial = attn.reshape(b, c, h_local * hd) @ params_l["o_proj"]
+    hidden = hidden + lax.psum(partial, tp_axis)
+
+    x = rms_norm(hidden, params_l["post_attention_layernorm"], spec.rms_norm_eps)
+    partial = silu_mlp(
+        x, params_l["gate_proj"], params_l["up_proj"], params_l["down_proj"]
+    )
+    hidden = hidden + lax.psum(partial, tp_axis)
+    return hidden
+
+
+def spmd_span_forward(
+    stacked_local: dict,  # local param shards with leading local-layer dim
+    hidden: jax.Array,
+    *,
+    spec: ModelSpec,
+    sp_axis: str = "sp",
+    tp_axis: str = "tp",
+) -> jax.Array:
+    def body(h, params_l):
+        return (
+            spmd_block_forward(
+                params_l, h, spec=spec, sp_axis=sp_axis, tp_axis=tp_axis
+            ),
+            None,
+        )
+
+    hidden, _ = lax.scan(body, hidden, stacked_local)
+    return hidden

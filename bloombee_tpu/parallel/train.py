@@ -1,0 +1,114 @@
+"""Distributed training step: p-tuning over frozen blocks, full mesh.
+
+The reference's training path optimizes client-held prompt embeddings and
+head against frozen remote blocks (SURVEY.md section 3.4: blocks frozen,
+gradients w.r.t. inputs and prompts only; client/ptune.py:21-80). Here the
+same objective runs as ONE jitted SPMD program over a (dp, pp, tp, sp) mesh:
+
+- dp: batch shards, loss gradients pmean'd across replicas
+- pp: layers sharded into GPipe stages (parallel.pipeline)
+- tp: head/ffn shards with psum reductions (parallel.spmd)
+- sp: ring attention over sequence chunks (parallel.ring_attention)
+
+Trainables: soft-prompt embeddings [n_prompt, D] + LM head. Frozen: all
+block params + token embeddings.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.ops import rms_norm
+from bloombee_tpu.parallel.pipeline import gpipe_forward
+from bloombee_tpu.parallel.spmd import param_specs, shard_span_params
+
+
+class Trainable(NamedTuple):
+    prompts: jax.Array  # [n_prompt, D]
+    lm_head: jax.Array  # [D, V]
+
+
+class Frozen(NamedTuple):
+    blocks: dict  # stacked span params [L, ...]
+    embed: jax.Array  # [V, D]
+    norm: jax.Array  # [D]
+
+
+def _loss_fn(
+    trainable: Trainable,
+    frozen: Frozen,
+    input_ids: jax.Array,  # [B, S]
+    target_ids: jax.Array,  # [B, S] (already shifted; -100 = ignore)
+    spec: ModelSpec,
+    mesh: Mesh,
+    num_micro: int,
+):
+    b, s = input_ids.shape
+    n_prompt = trainable.prompts.shape[0]
+    h = frozen.embed[input_ids]  # [B, S, D]
+    h = jnp.concatenate(
+        [jnp.broadcast_to(trainable.prompts[None], (b, n_prompt, h.shape[-1])), h],
+        axis=1,
+    )  # [B, P+S, D]
+
+    mb = b // num_micro
+    micro = h.reshape(num_micro, mb, n_prompt + s, -1)
+
+    pipeline = jax.shard_map(
+        functools.partial(
+            gpipe_forward, spec=spec, pp_axis="pp", sp_axis="sp", tp_axis="tp"
+        ),
+        mesh=mesh,
+        in_specs=(param_specs(frozen.blocks), P(None, "dp", "sp", None)),
+        out_specs=P(None, "dp", "sp", None),
+        check_vma=False,
+    )
+    out = pipeline(frozen.blocks, micro)  # [M, mb, P+S, D]
+    out = out.reshape(b, n_prompt + s, -1)[:, n_prompt:]  # drop prompt outs
+
+    out = rms_norm(out, frozen.norm, spec.rms_norm_eps)
+    logits = (out @ trainable.lm_head).astype(jnp.float32)  # [B, S, V]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    mask = target_ids >= 0
+    tgt = jnp.where(mask, target_ids, 0)
+    token_lp = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    loss = -(token_lp * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return loss
+
+
+def make_train_step(spec: ModelSpec, mesh: Mesh, num_micro: int, lr: float = 0.1):
+    """Returns jitted (trainable, frozen, input_ids, target_ids) ->
+    (trainable', loss). SGD keeps the example self-contained; optax drops in
+    for the optimizer state without changing the sharding story."""
+
+    def step(trainable, frozen, input_ids, target_ids):
+        loss, grads = jax.value_and_grad(_loss_fn)(
+            trainable, frozen, input_ids, target_ids, spec, mesh, num_micro
+        )
+        new_t = Trainable(
+            prompts=trainable.prompts - lr * grads.prompts,
+            lm_head=trainable.lm_head - lr * grads.lm_head,
+        )
+        return new_t, loss
+
+    # inputs arrive pre-placed (place_frozen / device_put); jit honors the
+    # committed shardings and GSPMD propagates the rest
+    return jax.jit(step)
+
+
+def place_frozen(frozen: Frozen, mesh: Mesh) -> Frozen:
+    """Shard the frozen pytree onto the mesh (blocks over pp/tp, embeddings
+    replicated)."""
+    rep = NamedSharding(mesh, P())
+    return Frozen(
+        blocks=shard_span_params(frozen.blocks, mesh),
+        embed=jax.device_put(frozen.embed, rep),
+        norm=jax.device_put(frozen.norm, rep),
+    )

@@ -164,12 +164,7 @@ def pack_plan(slots, page_table, q_positions, total_lens, layer_active):
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("spec", "page_size", "max_pages", "use_tree_mask", "window"),
-    donate_argnames=("arena_k", "arena_v"),
-)
-def span_step(
+def span_step_impl(
     stacked_params: dict,  # pytree, leading dim L on every leaf
     arena_k: jax.Array,  # [L, S_tot, Hkv, hd] (donated)
     arena_v: jax.Array,  # [L, S_tot, Hkv, hd] (donated)
@@ -218,3 +213,10 @@ def span_step(
         body, hidden, (stacked_params, arena_k, arena_v, layer_active)
     )
     return hidden, arena_k, arena_v
+
+
+span_step = functools.partial(
+    jax.jit,
+    static_argnames=("spec", "page_size", "max_pages", "use_tree_mask", "window"),
+    donate_argnames=("arena_k", "arena_v"),
+)(span_step_impl)
