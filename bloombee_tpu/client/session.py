@@ -105,12 +105,16 @@ class InferenceSession:
         hidden: np.ndarray,  # [B, T, D]
         commit: bool = True,
         tree_mask: np.ndarray | None = None,
+        depths: np.ndarray | None = None,
+        accept: list | None = None,
     ) -> np.ndarray:
         """Push hidden through the whole chain; returns last span's output."""
         attempt = 0
         while True:
             try:
-                out = await self._step_once(hidden, commit, tree_mask)
+                out = await self._step_once(
+                    hidden, commit, tree_mask, depths, accept
+                )
                 if commit and tree_mask is None:
                     self._history.append(hidden)
                     self.position += hidden.shape[1]
@@ -124,11 +128,17 @@ class InferenceSession:
                 )
                 try:
                     await self._recover()
+                    # history replay already committed every accepted token
+                    # on the fresh chain; the rebuilt servers have an empty
+                    # speculative window, so a carried accept is stale
+                    accept = None
                 except (RpcError, OSError, asyncio.TimeoutError) as e2:
                     logger.warning("recovery attempt failed: %s", e2)
                     await asyncio.sleep(min(0.2 * attempt, 2.0))
 
-    async def _step_once(self, hidden, commit, tree_mask):
+    async def _step_once(
+        self, hidden, commit, tree_mask, depths=None, accept=None
+    ):
         step_id = self._step_counter
         self._step_counter += 1
         meta_base = {
@@ -136,6 +146,10 @@ class InferenceSession:
             "commit": commit,
             "tree": tree_mask is not None,
         }
+        if depths is not None:
+            meta_base["depths"] = np.asarray(depths).tolist()
+        if accept is not None:
+            meta_base["accept"] = [np.asarray(a).tolist() for a in accept]
         tensors = [hidden.astype(np.float32)]
         if tree_mask is not None:
             tensors.append(tree_mask.astype(np.uint8))
@@ -178,6 +192,32 @@ class InferenceSession:
                 )
         assert out is not None, "no span returned a tensor"
         return np.asarray(out, dtype=np.float32)
+
+    async def send_accept(self, accept: list) -> None:
+        """Apply a speculative accept on every span without running compute
+        (the final accept of a generation, or an accept with no next tree)."""
+        step_id = self._step_counter
+        self._step_counter += 1
+        meta = {
+            "step": step_id,
+            "accept": [np.asarray(a).tolist() for a in accept],
+            "accept_only": True,
+            "reply": "ack",
+        }
+        for span_sess in self._spans:
+            await span_sess.stream.send(meta, [])
+        for i, span_sess in enumerate(self._spans):
+            item = await asyncio.wait_for(
+                span_sess.stream.recv(), self.step_timeout
+            )
+            if item is None:
+                raise RpcError(f"span {i} closed during accept")
+
+    def record_history(self, hidden: np.ndarray) -> None:
+        """Register committed tokens' inputs for failure replay (speculative
+        rounds bypass step()'s automatic history)."""
+        self._history.append(hidden)
+        self.position += hidden.shape[1]
 
     # -------------------------------------------------------------- recovery
     async def _recover(self) -> None:

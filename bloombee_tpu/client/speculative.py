@@ -1,0 +1,103 @@
+"""Speculative generation over the swarm.
+
+Port of the reference's DistributedLlamaForSpeculativeGeneration.generate
+loop (/root/reference/src/bloombee/models/llama/speculative_model.py:33-117):
+draft a tree rooted at the last certain token, verify the linearized tree in
+ONE distributed step (tree mask + depth positions, KV written speculatively),
+accept a path, and tell the servers which speculative slots survive (they
+compact + commit on device). Greedy mode is token-exact with plain greedy
+decode.
+
+Round structure: every round's tree has node 0 = the bonus token from the
+previous round (certain, always accepted) with the drafter's tree hanging
+under it — so the certain token's KV is written in the same step as the
+drafts, and the accept metadata rides the NEXT round's step (no extra RTT,
+cf. the reference's set_kv_cache piggybacking).
+
+Batch size 1 per session for now (the reference pads per-sample trees to a
+common shape; that generalization is wiring, not design).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.spec.drafter import GreedyTreeDrafter
+from bloombee_tpu.spec.tree import DraftTree, tree_attention_mask
+from bloombee_tpu.spec.verify import accept_greedy
+
+
+async def generate_speculative(
+    model: DistributedModelForCausalLM,
+    drafter: GreedyTreeDrafter,
+    input_ids: np.ndarray,  # [1, S]
+    max_new_tokens: int,
+    session=None,
+) -> np.ndarray:
+    input_ids = np.asarray(input_ids)
+    assert input_ids.shape[0] == 1, "speculative path is per-sequence for now"
+    b, s = input_ids.shape
+    tree_size = 1 + sum(
+        int(np.prod(drafter.branching[: i + 1]))
+        for i in range(len(drafter.branching))
+    )
+    max_length = s + max_new_tokens + (tree_size + 1) * 2  # tree spike room
+    own = session is None
+    if own:
+        session = model.inference_session(max_length, b)
+        await session.__aenter__()
+    try:
+        ids = list(input_ids[0])
+        # prefill -> logits at the last prompt token
+        hidden = model.embed(np.asarray([ids]))
+        out = await session.step(hidden)
+        root_logits = model.logits(out[:, -1:])[0, 0]
+        bonus = int(np.argmax(root_logits))
+        new_tokens = [bonus]
+        pending_accept = None
+
+        while len(new_tokens) < max_new_tokens:
+            # tree: node 0 = bonus (certain), drafter's tree under it
+            sub, _probs = drafter.build(np.asarray(ids + new_tokens))
+            tokens = np.concatenate([[new_tokens[-1]], sub.tokens])
+            parents = np.concatenate(
+                [[-1], np.where(sub.parents < 0, 0, sub.parents + 1)]
+            ).astype(np.int32)
+            tree = DraftTree(tokens=tokens, parents=parents)
+            mask = tree_attention_mask(tree)[None]  # [1, T, T]
+            depths = tree.depths()[None]  # [1, T]
+
+            h_tree = model.embed(tree.tokens[None])
+            out = await session.step(
+                h_tree,
+                commit=False,
+                tree_mask=mask,
+                depths=depths,
+                accept=pending_accept,
+            )
+            logits = model.logits(out)[0]  # [T, V]
+
+            accepted, nxt_bonus = accept_greedy(tree, root_logits, logits)
+            # node 0 is certain and always accepted first
+            assert accepted and accepted[0] == 0
+            pending_accept = [np.asarray(accepted)]
+            accepted_tokens = [int(tree.tokens[a]) for a in accepted[1:]]
+            # accepted rows of h_tree ARE the history inputs — no re-embed
+            session.record_history(np.asarray(h_tree[:, accepted]))
+            root_logits = logits[accepted[-1]]
+            new_tokens.extend(accepted_tokens)
+            new_tokens.append(nxt_bonus)
+
+        if pending_accept is not None:
+            await session.send_accept(pending_accept)
+        # every token except the final bonus is committed in server KV, so
+        # only that may be trimmed — a resumed session must see ids that
+        # match the committed cache (may overshoot max_new_tokens by up to
+        # the accepted path length, like the reference's tree spikes)
+        if len(new_tokens) > max_new_tokens:
+            new_tokens = new_tokens[:-1]
+        return np.asarray([ids + new_tokens])
+    finally:
+        if own:
+            await session.__aexit__(None, None, None)

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import dataclasses
+import functools
 import itertools
 import time
 from typing import Iterator
@@ -35,6 +36,18 @@ from bloombee_tpu.kv.paged import PagedKVTable
 
 class AllocationTimeout(RuntimeError):
     pass
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _reorder_all_layers(ak, av, src, dst):
+    """Compact surviving speculative rows across all layers in one fused
+    gather+scatter (module-level jit: compiles once per slot-count bucket)."""
+    k_rows = ak[:, src]
+    v_rows = av[:, src]
+    return (
+        ak.at[:, dst].set(k_rows, mode="drop"),
+        av.at[:, dst].set(v_rows, mode="drop"),
+    )
 
 
 @dataclasses.dataclass
@@ -189,6 +202,46 @@ class CacheManager:
     def rollback(self, handle: CacheHandle):
         for sid in handle.seq_ids:
             self.table.rollback(sid)
+
+    def accept_speculative(
+        self, handle: CacheHandle, accepted_indices: list
+    ) -> None:
+        """Compact surviving speculative KV rows onto the committed prefix
+        and commit them (the on-device replacement for the reference's async
+        reorder thread, memory_cache_manager.py:2011-2160).
+
+        `accepted_indices[i]` lists row i's surviving tree-relative indices
+        in path order (depth 0, 1, ...).
+        """
+        import jax.numpy as jnp
+
+        src_all, dst_all = [], []
+        for sid, idx in zip(handle.seq_ids, accepted_indices):
+            st = self.table.seq(sid)
+            idx = np.asarray(idx, dtype=np.int64)
+            spec_slots = self.table.range_slots(sid, st.l_acc, st.l_seq)
+            src_all.append(spec_slots[idx])
+            dst_all.append(spec_slots[: len(idx)])
+            self.table.accept(sid, len(idx))
+        src = np.concatenate(src_all) if src_all else np.zeros(0, np.int32)
+        dst = np.concatenate(dst_all) if dst_all else np.zeros(0, np.int32)
+        keep = src != dst  # in-place rows need no move
+        src, dst = src[keep], dst[keep]
+        if len(src) == 0:
+            return
+        # pad to a small bucket so reorder compiles once per bucket
+        from bloombee_tpu.runtime.executor import next_pow2
+
+        n = next_pow2(len(src), floor=4)
+        oob = self.arena["k"].shape[1]
+        src_p = np.zeros((n,), np.int32)  # padded gathers read slot 0
+        dst_p = np.full((n,), oob, np.int32)  # padded scatters are dropped
+        src_p[: len(src)] = src
+        dst_p[: len(dst)] = dst
+        self.arena["k"], self.arena["v"] = _reorder_all_layers(
+            self.arena["k"], self.arena["v"],
+            jnp.asarray(src_p), jnp.asarray(dst_p),
+        )
 
     # ------------------------------------------------------- host tiering
     def park_sequence(self, seq_id: int) -> None:

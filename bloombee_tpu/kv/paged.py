@@ -133,6 +133,32 @@ class PagedKVTable:
         state.l_seq = length
         self._trim(state)
 
+    def accept(self, seq_id: int, num_accepted: int) -> None:
+        """Keep the first `num_accepted` speculative tokens (after the caller
+        compacted the arena rows onto them) and discard the rest."""
+        state = self._seqs[seq_id]
+        if not 0 <= num_accepted <= state.l_seq - state.l_acc:
+            raise ValueError(
+                f"accept {num_accepted} outside speculative window "
+                f"[0, {state.l_seq - state.l_acc}]"
+            )
+        state.l_acc += num_accepted
+        state.l_seq = state.l_acc
+        self._trim(state)
+
+    def range_slots(self, seq_id: int, start: int, end: int) -> np.ndarray:
+        """Flat slot ids for positions [start, end) (must be materialized)."""
+        state = self._seqs[seq_id]
+        if end > len(state.pages) * self.page_size:
+            raise ValueError("range beyond allocated pages")
+        positions = np.arange(start, end)
+        pages = np.asarray(state.pages, dtype=np.int64)[
+            positions // self.page_size
+        ]
+        return (pages * self.page_size + positions % self.page_size).astype(
+            np.int32
+        )
+
     def rollback(self, seq_id: int) -> None:
         """Discard speculative tokens; free orphaned pages
         (paged_kv.py:247-261)."""

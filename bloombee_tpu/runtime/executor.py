@@ -75,9 +75,11 @@ class SpanExecutor:
         commit: bool = True,
         tree_mask: np.ndarray | None = None,
         layers: tuple[int, int] | None = None,
+        depths: np.ndarray | None = None,
     ) -> np.ndarray:
         return self._step(
-            handle, hidden, commit=commit, tree_mask=tree_mask, layers=layers
+            handle, hidden, commit=commit, tree_mask=tree_mask, layers=layers,
+            depths=depths,
         )
 
     # --------------------------------------------------------------- internals
@@ -88,6 +90,7 @@ class SpanExecutor:
         commit: bool,
         tree_mask: np.ndarray | None = None,
         layers: tuple[int, int] | None = None,
+        depths: np.ndarray | None = None,
     ) -> np.ndarray:
         spec = self.spec
         b, t, d = hidden.shape
@@ -116,9 +119,14 @@ class SpanExecutor:
         h_pad[:b, :t] = hidden
         slots_pad = np.full((bb, tb), oob, dtype=np.int32)
         slots_pad[:b, :t] = slots.reshape(b, t)
+        # rotary positions: sequential for plain steps; start + per-node tree
+        # depth for tree steps (reference: tree rotary ids, backend.py:944)
         positions = np.zeros((bb, tb), dtype=np.int32)
         for i in range(b):
-            positions[i, :t] = np.arange(starts[i], starts[i] + t)
+            if depths is not None:
+                positions[i, :t] = starts[i] + depths[i]
+            else:
+                positions[i, :t] = np.arange(starts[i], starts[i] + t)
         pt_pad = np.zeros((bb, pb), dtype=np.int32)
         pt_pad[:b] = self.manager.page_table(handle, pb)
         lens_pad = np.zeros((bb,), dtype=np.int32)

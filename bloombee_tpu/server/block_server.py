@@ -275,10 +275,27 @@ class BlockServer:
     async def _run_step(
         self, session: _Session, stream: Stream, meta: dict, tensors: list
     ) -> None:
+        # speculative accept from the previous round: compact surviving KV
+        # rows onto the committed prefix before this step's compute
+        accept = meta.get("accept")
+        if accept is not None:
+            await self.compute.submit(
+                PRIORITY_INFERENCE,
+                self.manager.accept_speculative,
+                session.handle,
+                [np.asarray(a, dtype=np.int64) for a in accept],
+            )
+        if meta.get("accept_only"):
+            await stream.send({"step": meta.get("step"), "ack": True})
+            return
+
         hidden = np.asarray(tensors[0], dtype=np.float32)
         tree_mask = None
+        depths = None
         if meta.get("tree"):
             tree_mask = np.asarray(tensors[1], dtype=bool)
+            if meta.get("depths") is not None:
+                depths = np.asarray(meta["depths"], dtype=np.int32)
         commit = bool(meta.get("commit", True))
 
         out = await self.compute.submit(
@@ -288,6 +305,7 @@ class BlockServer:
             hidden,
             commit,
             tree_mask,
+            depths,
         )
 
         route = meta.get("route") or []
@@ -302,6 +320,10 @@ class BlockServer:
                 "reply": reply,
                 "route": route[1:],
             }
+            if meta.get("tree"):
+                push_meta["depths"] = meta["depths"]
+            if accept is not None:
+                push_meta["accept"] = accept
             push_tensors = [out.astype(np.float32)]
             if tree_mask is not None:
                 push_tensors.append(tree_mask.astype(np.uint8))
@@ -314,14 +336,16 @@ class BlockServer:
         else:
             await stream.send({"step": meta.get("step")}, [out])
 
-    def _compute_step(self, session: _Session, hidden, commit, tree_mask):
+    def _compute_step(
+        self, session: _Session, hidden, commit, tree_mask, depths=None
+    ):
         if hidden.shape[1] > 1 and tree_mask is None:
             return self.executor.prefill(
                 session.handle, hidden, commit=commit, layers=session.layers
             )
         return self.executor.decode(
             session.handle, hidden, commit=commit, tree_mask=tree_mask,
-            layers=session.layers,
+            layers=session.layers, depths=depths,
         )
 
     async def _rpc_push(self, meta: dict, tensors) -> None:

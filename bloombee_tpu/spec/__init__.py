@@ -1,0 +1,24 @@
+"""Speculative decoding: client drafter + tree verify over the swarm.
+
+Mirrors the reference's Llama speculative stack
+(/root/reference/src/bloombee/models/llama/speculative_model.py,
+spe_dec_tree.py, spec_decoding_verify.py, spec_decoding_drafter.py): a
+client-side drafter builds token trees, one distributed forward verifies the
+whole linearized tree against the target model (tree attention mask +
+per-node depth positions), SpecInfer-style accept picks the surviving path,
+and servers compact the surviving KV slots onto the committed prefix
+(on-device gather instead of the reference's async reorder thread).
+"""
+
+from bloombee_tpu.spec.tree import DraftTree, tree_attention_mask
+from bloombee_tpu.spec.verify import accept_greedy, accept_sampling
+from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+
+__all__ = [
+    "DraftTree",
+    "tree_attention_mask",
+    "accept_greedy",
+    "accept_sampling",
+    "GreedyTreeDrafter",
+    "LocalJaxDraftModel",
+]

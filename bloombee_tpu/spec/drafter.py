@@ -1,0 +1,118 @@
+"""Client-side drafter: builds token trees with a small local JAX model.
+
+Role of the reference's MultiSSMDrafter (/root/reference/src/bloombee/models/
+llama/spec_decoding_drafter.py:67-110, small HF models in threads). Here the
+draft model is a dense JAX Llama run entirely client-side; tree shapes are
+STATIC branching tuples (e.g. (4, 2, 1)) so every round reuses the same
+compiled shapes — the reference's Sequoia-style dynamic shape optimization
+(spec_decoding_tree_shape.py) maps to choosing the branching tuple offline.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bloombee_tpu.models.llama.block import block_forward, dense_attend
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.ops import rms_norm
+from bloombee_tpu.ops.rotary import rotary_cos_sin
+from bloombee_tpu.spec.tree import DraftTree
+from bloombee_tpu.spec.verify import _softmax
+from bloombee_tpu.utils.tree import unstack_params
+
+
+class LocalJaxDraftModel:
+    """Small dense Llama run locally (no KV cache — recompute per level;
+    draft models are tiny so this stays cheap and shape-stable)."""
+
+    def __init__(self, spec: ModelSpec, block_params: list, client_params: dict):
+        self.spec = spec
+        self.blocks = block_params
+        self.client = client_params
+
+    @classmethod
+    def from_dir(cls, model_dir: str, dtype=None) -> "LocalJaxDraftModel":
+        from bloombee_tpu.models.checkpoint import (
+            load_client_params,
+            load_span_params,
+            load_spec,
+        )
+
+        spec = load_spec(model_dir)
+        stacked, _ = load_span_params(
+            model_dir, 0, spec.num_hidden_layers, dtype=dtype
+        )
+        blocks = unstack_params(stacked, spec.num_hidden_layers)
+        client = load_client_params(model_dir, dtype=dtype)
+        return cls(spec, blocks, client)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _last_logits(self, ids: jax.Array, last: jax.Array) -> jax.Array:
+        """ids [N, S_bucket] right-padded; last [N] = true_len - 1."""
+        spec = self.spec
+        h = self.client["embed"][ids]
+        b, s, _ = h.shape
+        positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        cos, sin = rotary_cos_sin(positions, spec.head_dim, spec.rope_theta)
+        for p in self.blocks:
+            h, _ = block_forward(p, spec, h, cos, sin, dense_attend())
+        h_last = h[jnp.arange(b), last]  # causal mask: padding is invisible
+        h_last = rms_norm(h_last, self.client["norm"], spec.rms_norm_eps)
+        return (h_last @ self.client["lm_head"]).astype(jnp.float32)
+
+    def last_logits(self, ids: np.ndarray) -> np.ndarray:
+        """Bucket the context length (pow2) so round-over-round growth reuses
+        compiled shapes instead of retracing every round."""
+        from bloombee_tpu.runtime.executor import next_pow2
+
+        n, s = ids.shape
+        sb = next_pow2(s, floor=8)
+        padded = np.zeros((n, sb), dtype=np.int64)
+        padded[:, :s] = ids
+        last = np.full((n,), s - 1, dtype=np.int32)
+        return np.asarray(
+            self._last_logits(jnp.asarray(padded), jnp.asarray(last))
+        )
+
+
+class GreedyTreeDrafter:
+    """Top-k tree expansion with static branching per depth."""
+
+    def __init__(self, model: LocalJaxDraftModel, branching=(2, 2, 1)):
+        self.model = model
+        self.branching = tuple(branching)
+
+    def build(self, context_ids: np.ndarray) -> tuple[DraftTree, np.ndarray]:
+        """context_ids [S] -> (tree, draft_probs [T, V]).
+
+        draft_probs[i] is the drafter's softmax distribution at node i's
+        position (conditioned on its path) — what accept_sampling needs.
+        """
+        tokens: list[int] = []
+        parents: list[int] = []
+        probs: list[np.ndarray] = []
+        # frontier: list of (parent_index, path_ids)
+        frontier = [(-1, list(context_ids))]
+        for width in self.branching:
+            ids = np.asarray([f[1] for f in frontier], dtype=np.int64)
+            logits = self.model.last_logits(ids)  # [n, V]
+            p = _softmax(logits)
+            top = np.argsort(-logits, axis=-1)[:, :width]
+            new_frontier = []
+            for fi, (parent, path) in enumerate(frontier):
+                for tok in top[fi]:
+                    idx = len(tokens)
+                    tokens.append(int(tok))
+                    parents.append(parent)
+                    probs.append(p[fi])
+                    new_frontier.append((idx, path + [int(tok)]))
+            frontier = new_frontier
+        tree = DraftTree(
+            tokens=np.asarray(tokens), parents=np.asarray(parents)
+        )
+        return tree, np.stack(probs)

@@ -1,0 +1,76 @@
+"""Draft-tree structures: linearization, ancestor masks, depths.
+
+Port of the invariants of /root/reference/src/bloombee/models/llama/
+spe_dec_tree.py: linearized node order, the O(n*depth) parent-walk ancestor
+matrix (:139-179 — the arch-reform replacement for the O(n^3) matmul), and
+incremental tree attention masks (:180-363). Nodes are NEW draft tokens only;
+parent == -1 means "child of the last committed token".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DraftTree:
+    tokens: np.ndarray  # [T] int64 draft token ids, linearized
+    parents: np.ndarray  # [T] int32, index into tokens; -1 = root level
+
+    def __post_init__(self):
+        self.tokens = np.asarray(self.tokens, dtype=np.int64)
+        self.parents = np.asarray(self.parents, dtype=np.int32)
+        if np.any(self.parents >= np.arange(len(self.parents))):
+            raise ValueError("parents must precede children in linear order")
+
+    @property
+    def size(self) -> int:
+        return len(self.tokens)
+
+    def depths(self) -> np.ndarray:
+        """[T] depth of each node (root level = 0); O(n*depth) parent walk."""
+        d = np.zeros(self.size, dtype=np.int32)
+        for i in range(self.size):
+            p = self.parents[i]
+            d[i] = 0 if p < 0 else d[p] + 1
+        return d
+
+    def ancestors_or_self(self) -> np.ndarray:
+        """[T, T] bool: A[i, j] = node j is an ancestor of i (or i itself)."""
+        t = self.size
+        a = np.zeros((t, t), dtype=bool)
+        for i in range(t):
+            j = i
+            while j >= 0:
+                a[i, j] = True
+                j = self.parents[j]
+        return a
+
+    def path_to(self, node: int) -> list[int]:
+        """Linear indices from root level down to `node` inclusive."""
+        path = []
+        j = node
+        while j >= 0:
+            path.append(j)
+            j = self.parents[j]
+        return path[::-1]
+
+    def children_of(self, node: int) -> np.ndarray:
+        """Linear indices of `node`'s children (-1 for the root level)."""
+        return np.nonzero(self.parents == node)[0]
+
+
+def tree_attention_mask(tree: DraftTree) -> np.ndarray:
+    """[T, T] visibility among the tree's tokens (ancestor-or-self).
+
+    The committed-prefix part of the mask is handled inside the span step
+    (runtime/step.py _attend_paged: prefix keys always visible)."""
+    return tree.ancestors_or_self()
+
+
+def chain_tree(tokens: np.ndarray) -> DraftTree:
+    """Degenerate tree: a single chain (classic draft-K speculative decode)."""
+    t = len(tokens)
+    return DraftTree(tokens=tokens, parents=np.arange(-1, t - 1, dtype=np.int32))

@@ -1,0 +1,98 @@
+"""Acceptance rules for tree speculative decoding.
+
+Port of the semantics of /root/reference/src/bloombee/models/llama/
+spec_decoding_verify.py:44-154 (SpecInfer-style): greedy path-matching, and
+multi-round rejection sampling against the draft distribution with residual
+fallback. Greedy speculative decode is exactly equivalent to plain greedy
+decode — the e2e test asserts token equality.
+
+Inputs are per-sequence: `logits` [T, V] target logits for every tree node
+(logits[i] predicts the token AFTER node i), `root_logits` [V] target logits
+at the last committed token (predicting the first tree level).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bloombee_tpu.spec.tree import DraftTree
+
+
+def accept_greedy(
+    tree: DraftTree,
+    root_logits: np.ndarray,  # [V]
+    logits: np.ndarray,  # [T, V]
+) -> tuple[list[int], int]:
+    """Returns (accepted_node_indices in path order, bonus_token).
+
+    Walk from the root level: at each step the target's argmax picks the
+    required token; descend into the child carrying it, else stop. The bonus
+    token is the target's argmax after the last accepted node (or at the
+    root if nothing was accepted).
+    """
+    accepted: list[int] = []
+    cur = -1  # -1 = root level (children of the last committed token)
+    cur_logits = root_logits
+    while True:
+        want = int(np.argmax(cur_logits))
+        children = tree.children_of(cur)
+        nxt = -1
+        for c in children:
+            if int(tree.tokens[c]) == want:
+                nxt = int(c)
+                break
+        if nxt < 0:
+            return accepted, want
+        accepted.append(nxt)
+        cur = nxt
+        cur_logits = logits[nxt]
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    x = x - x.max(axis=-1, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def accept_sampling(
+    tree: DraftTree,
+    root_logits: np.ndarray,
+    logits: np.ndarray,
+    draft_probs: np.ndarray,  # [T, V] drafter's distribution at each node
+    rng: np.random.Generator,
+    temperature: float = 1.0,
+) -> tuple[list[int], int]:
+    """Stochastic SpecInfer accept: at each level, try the children one by
+    one with probability min(1, p_target/p_draft); on rejection subtract the
+    child's mass from the residual target distribution; if all children
+    fail, sample the bonus token from the (renormalized) residual."""
+    accepted: list[int] = []
+    cur = -1
+    cur_logits = root_logits
+    while True:
+        p = _softmax(cur_logits / max(temperature, 1e-6))
+        children = list(tree.children_of(cur))
+        rng.shuffle(children)
+        nxt = -1
+        residual = p.copy()
+        for c in children:
+            tok = int(tree.tokens[c])
+            q_dist = draft_probs[c]
+            q = max(float(q_dist[tok]), 1e-20)
+            if rng.random() < min(1.0, residual[tok] / q):
+                nxt = int(c)
+                break
+            # SpecInfer residual: renormalize max(p - q, 0) after rejection
+            residual = np.maximum(residual - q_dist, 0.0)
+            s = residual.sum()
+            if s <= 0:
+                residual = p.copy()
+                residual[tok] = 0.0
+                s = residual.sum() or 1.0
+            residual = residual / s
+        if nxt < 0:
+            bonus = int(rng.choice(len(residual), p=residual))
+            return accepted, bonus
+        accepted.append(nxt)
+        cur = nxt
+        cur_logits = logits[nxt]

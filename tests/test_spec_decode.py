@@ -1,0 +1,160 @@
+"""Speculative decoding: tree math, acceptance rules, and e2e equivalence.
+
+Ports the intent of /root/reference/tests/test_spe_dec_tree.py,
+test_spec_decoding_verify.py, test_speculative_generation.py. The e2e
+invariant: greedy speculative decode produces EXACTLY the tokens of plain
+greedy decode.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bloombee_tpu.spec.tree import DraftTree, chain_tree, tree_attention_mask
+from bloombee_tpu.spec.verify import accept_greedy, accept_sampling
+
+
+def test_tree_invariants():
+    #       0   1          (roots)
+    #      2 3   4
+    #      5
+    tree = DraftTree(
+        tokens=np.asarray([10, 11, 12, 13, 14, 15]),
+        parents=np.asarray([-1, -1, 0, 0, 1, 2]),
+    )
+    assert tree.depths().tolist() == [0, 0, 1, 1, 1, 2]
+    a = tree.ancestors_or_self()
+    assert a[5].tolist() == [True, False, True, False, False, True]
+    assert tree.path_to(5) == [0, 2, 5]
+    assert tree.children_of(-1).tolist() == [0, 1]
+    assert tree.children_of(0).tolist() == [2, 3]
+    m = tree_attention_mask(tree)
+    assert m.shape == (6, 6)
+    assert not m[2, 1]  # sibling branch invisible
+
+    with pytest.raises(ValueError):
+        DraftTree(tokens=np.asarray([1, 2]), parents=np.asarray([1, -1]))
+
+    chain = chain_tree(np.asarray([5, 6, 7]))
+    assert chain.parents.tolist() == [-1, 0, 1]
+    assert np.all(chain.ancestors_or_self() == np.tril(np.ones((3, 3), bool)))
+
+
+def _logits_for(vocab, *winners):
+    """[len(winners), vocab] logits whose argmax at row i is winners[i]."""
+    out = np.zeros((len(winners), vocab), np.float32)
+    for i, w in enumerate(winners):
+        out[i, w] = 5.0
+    return out
+
+
+def test_accept_greedy_path():
+    # tree: 0(tok 3) -> 1(tok 7) -> 2(tok 9); sibling 3(tok 8) under 0
+    tree = DraftTree(
+        tokens=np.asarray([3, 7, 9, 8]),
+        parents=np.asarray([-1, 0, 1, 0]),
+    )
+    vocab = 16
+    root_logits = _logits_for(vocab, 3)[0]  # target wants 3 -> accept node 0
+    logits = _logits_for(vocab, 7, 9, 1, 0)  # node0->7, node1->9, node2->1
+    accepted, bonus = accept_greedy(tree, root_logits, logits)
+    assert accepted == [0, 1, 2]
+    assert bonus == 1  # argmax after node 2
+
+    # target disagrees at the root: nothing accepted, bonus = target's pick
+    accepted, bonus = accept_greedy(tree, _logits_for(vocab, 5)[0], logits)
+    assert accepted == [] and bonus == 5
+
+    # target accepts node 0 then picks the sibling branch (node 3, tok 8)
+    logits2 = _logits_for(vocab, 8, 9, 1, 2)  # node0 -> 8 => descend to 3
+    accepted, bonus = accept_greedy(
+        tree, _logits_for(vocab, 3)[0], logits2
+    )
+    assert accepted == [0, 3] and bonus == 2
+
+
+def test_accept_sampling_peaked_matches_greedy():
+    tree = DraftTree(
+        tokens=np.asarray([3, 7]), parents=np.asarray([-1, 0])
+    )
+    vocab = 8
+    root_logits = _logits_for(vocab, 3)[0] * 10
+    logits = _logits_for(vocab, 7, 2)[:2] * 10
+    draft_probs = np.full((2, vocab), 1e-3)
+    draft_probs[0, 3] = 1.0
+    draft_probs[1, 7] = 1.0
+    rng = np.random.default_rng(0)
+    accepted, bonus = accept_sampling(
+        tree, root_logits, logits, draft_probs, rng, temperature=1.0
+    )
+    assert accepted == [0, 1] and bonus == 2
+
+
+def test_e2e_speculative_equals_greedy(tmp_path):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.client.speculative import generate_speculative
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "model")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        servers = [
+            BlockServer(model_uid="m", start=0, end=2, model_dir=d,
+                        registry=rc(), compute_dtype=jnp.float32,
+                        num_pages=64, page_size=4),
+            BlockServer(model_uid="m", start=2, end=3, model_dir=d,
+                        registry=rc(), compute_dtype=jnp.float32,
+                        num_pages=64, page_size=4),
+        ]
+        for s in servers:
+            await s.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, rc(), model_uid="m", use_push=False
+        )
+        # the model drafts for itself -> high acceptance, exact equality
+        drafter = GreedyTreeDrafter(
+            LocalJaxDraftModel.from_dir(d), branching=(2, 1)
+        )
+        input_ids = np.arange(5)[None, :]
+        n_new = 10
+
+        spec_ids = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=n_new
+        )
+        # may overshoot by the accepted path length; the generated prefix
+        # must match plain greedy token-for-token
+        assert spec_ids.shape[1] >= input_ids.shape[1] + n_new
+        plain_ids = await model.generate(
+            input_ids, max_new_tokens=spec_ids.shape[1] - input_ids.shape[1]
+        )
+        np.testing.assert_array_equal(spec_ids, plain_ids)
+
+        for s in servers:
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
