@@ -24,20 +24,38 @@ from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
 from bloombee_tpu.client.session import InferenceSession
 from bloombee_tpu.models.spec import ModelSpec
 from bloombee_tpu.ops import rms_norm
+from bloombee_tpu.ops.norms import layer_norm
 
 
-@functools.partial(jax.jit, static_argnames=("embedding_multiplier",))
-def _embed(embed_w, input_ids, embedding_multiplier: float = 1.0):
-    h = embed_w[input_ids]
+@functools.partial(
+    jax.jit, static_argnames=("embedding_multiplier", "has_embed_norm", "eps")
+)
+def _embed(
+    params,
+    input_ids,
+    embedding_multiplier: float = 1.0,
+    has_embed_norm: bool = False,
+    eps: float = 1e-5,
+):
+    h = params["embed"][input_ids]
     if embedding_multiplier != 1.0:
         h = h * embedding_multiplier
+    if has_embed_norm:  # bloom: word_embeddings_layernorm
+        h = layer_norm(h, params["embed_norm"], params["embed_norm_bias"], eps)
     return h
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "soft_cap"))
-def _norm_head(norm_w, head_w, hidden, eps: float, soft_cap: float = 0.0):
-    h = rms_norm(hidden, norm_w, eps)
-    logits = (h @ head_w).astype(jnp.float32)
+@functools.partial(
+    jax.jit, static_argnames=("eps", "soft_cap", "norm_type")
+)
+def _norm_head(
+    params, hidden, eps: float, soft_cap: float = 0.0, norm_type: str = "rms"
+):
+    if norm_type == "ln":
+        h = layer_norm(hidden, params["norm"], params.get("norm_bias"), eps)
+    else:
+        h = rms_norm(hidden, params["norm"], eps)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
     if soft_cap:
         logits = jnp.tanh(logits / soft_cap) * soft_cap
     return logits
@@ -84,20 +102,22 @@ class DistributedModelForCausalLM:
     # ------------------------------------------------------------- components
     def embed(self, input_ids: np.ndarray) -> np.ndarray:
         h = _embed(
-            self.params["embed"],
+            self.params,
             jnp.asarray(input_ids),
             self.spec.embedding_multiplier,
+            "embed_norm" in self.params,
+            self.spec.rms_norm_eps,
         )
         return np.asarray(h, dtype=np.float32)
 
     def logits(self, hidden: np.ndarray) -> np.ndarray:
         return np.asarray(
             _norm_head(
-                self.params["norm"],
-                self.params["lm_head"],
+                self.params,
                 jnp.asarray(hidden),
                 eps=self.spec.rms_norm_eps,
                 soft_cap=self.spec.logits_soft_cap,
+                norm_type=self.spec.norm_type,
             )
         )
 

@@ -20,14 +20,16 @@ class Family:
         self,
         name: str,
         spec_fn: Callable[[Any], ModelSpec],
-        block_keys: dict[str, tuple[str, bool]],
+        block_keys: dict[str, tuple[str, bool]] | None = None,
         layer_prefix: str = "model.layers",
         client_names: dict[str, str] | None = None,
         convert_block: Callable | None = None,
+        loader: Callable | None = None,
+        client_loader: Callable | None = None,
     ):
         self.name = name
         self._spec_fn = spec_fn
-        self.block_keys = block_keys
+        self.block_keys = block_keys or {}
         self.layer_prefix = layer_prefix
         self._client_names = client_names or {
             "embed": "model.embed_tokens.weight",
@@ -35,6 +37,8 @@ class Family:
             "lm_head": "lm_head.weight",
         }
         self._convert_block = convert_block
+        self._loader = loader
+        self.client_loader = client_loader
 
     def spec_from_config_dict(self, config: dict) -> ModelSpec:
         return self._spec_fn(SimpleNamespace(**config))
@@ -43,6 +47,8 @@ class Family:
         return self._client_names
 
     def load_block_params(self, reader, layer_idx: int, dtype=None) -> dict:
+        if self._loader is not None:
+            return self._loader(reader, layer_idx, dtype=dtype)
         tensors = {}
         for hf_key in self.block_keys:
             full = f"{self.layer_prefix}.{layer_idx}.{hf_key}"
@@ -90,6 +96,12 @@ def _register_builtins() -> None:
             convert_block=llama_convert,
         )
     )
+    # side-effect registrations
+    import bloombee_tpu.models.bloom  # noqa: F401
+    import bloombee_tpu.models.falcon  # noqa: F401
+    import bloombee_tpu.models.gemma2  # noqa: F401
+    import bloombee_tpu.models.mixtral  # noqa: F401
+    import bloombee_tpu.models.qwen3  # noqa: F401
 
 
 _register_builtins()

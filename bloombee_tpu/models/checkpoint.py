@@ -62,6 +62,15 @@ class CheckpointReader:
         return self.config.get("model_type", "llama")
 
 
+def read_tensor(reader: CheckpointReader, name: str, dtype=None):
+    """Read one tensor as a jnp array with optional dtype cast (the shared
+    helper for family weight converters)."""
+    import jax.numpy as jnp
+
+    w = jnp.asarray(reader.tensor(name))
+    return w.astype(dtype) if dtype is not None else w
+
+
 def load_spec(model_dir: str) -> ModelSpec:
     """ModelSpec from a local model dir via the family registry."""
     from bloombee_tpu.models.auto import spec_from_config_dict
@@ -87,13 +96,16 @@ def load_span_params(
 
 
 def load_client_params(model_dir: str, dtype=None) -> dict:
-    """Embeddings + final norm + LM head (the client-side trio)."""
+    """Embeddings + final norm + LM head (the client-side trio), plus any
+    family extras (embedding layernorm, norm bias, tied heads)."""
     import jax.numpy as jnp
 
     from bloombee_tpu.models.auto import get_family
 
     reader = CheckpointReader(model_dir)
     family = get_family(reader.model_type())
+    if family.client_loader is not None:
+        return family.client_loader(reader, dtype=dtype)
     names = family.client_param_names()
     embed = jnp.asarray(reader.tensor(names["embed"]))
     norm = jnp.asarray(reader.tensor(names["norm"]))

@@ -1,0 +1,93 @@
+"""Falcon family: rotary + MQA/GQA, LayerNorm, parallel attention/MLP.
+
+Reference: /root/reference/src/bloombee/models/falcon/ (WrappedFalconBlock).
+Supports the falcon-7b shape: multi_query fused QKV ([H q-heads | 1 k | 1 v]
+rows), parallel residual with a single shared input LayerNorm, bias-free
+linears, exact-GELU 4h MLP.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from bloombee_tpu.models.auto import Family, register_family
+from bloombee_tpu.models.checkpoint import read_tensor as _t
+from bloombee_tpu.models.spec import ModelSpec
+
+
+def falcon_spec_from_hf(config: Any) -> ModelSpec:
+    n_head = config.num_attention_heads
+    hidden = config.hidden_size
+    if getattr(config, "new_decoder_architecture", False):
+        raise NotImplementedError(
+            "falcon new_decoder_architecture (grouped fused-QKV layout) is "
+            "not supported yet; falcon-7b-style checkpoints only"
+        )
+    if getattr(config, "alibi", False) or getattr(config, "bias", False):
+        raise NotImplementedError(
+            "falcon-rw variants (alibi/bias) are not supported yet"
+        )
+    n_kv = 1 if getattr(config, "multi_query", True) else n_head
+    return ModelSpec(
+        family="falcon",
+        hidden_size=hidden,
+        intermediate_size=4 * hidden,
+        num_attention_heads=n_head,
+        num_key_value_heads=n_kv,
+        head_dim=hidden // n_head,
+        num_hidden_layers=config.num_hidden_layers,
+        vocab_size=config.vocab_size,
+        rms_norm_eps=getattr(config, "layer_norm_epsilon", 1e-5),
+        rope_theta=getattr(config, "rope_theta", 10000.0),
+        tie_word_embeddings=True,
+        norm_type="ln",
+        mlp_type="gelu",
+        parallel_attn=getattr(config, "parallel_attn", True),
+        alibi=getattr(config, "alibi", False),
+    )
+
+
+def _load_block(reader, layer_idx: int, dtype=None) -> dict:
+    p = f"transformer.h.{layer_idx}"
+    params = {
+        "input_layernorm": _t(reader, f"{p}.input_layernorm.weight", dtype),
+        "input_layernorm_bias": _t(reader, f"{p}.input_layernorm.bias", dtype),
+    }
+    n_head = reader.config["num_attention_heads"]
+    d = reader.config["hidden_size"]
+    head_dim = d // n_head
+    n_kv = 1 if reader.config.get("multi_query", True) else n_head
+    w = _t(reader, f"{p}.self_attention.query_key_value.weight", dtype)
+    # rows: H query heads, then n_kv k heads, then n_kv v heads
+    q_rows = n_head * head_dim
+    kv_rows = n_kv * head_dim
+    params["q_proj"] = w[:q_rows].T
+    params["k_proj"] = w[q_rows : q_rows + kv_rows].T
+    params["v_proj"] = w[q_rows + kv_rows :].T
+    params["o_proj"] = _t(reader, f"{p}.self_attention.dense.weight", dtype).T
+    params["up_proj"] = _t(reader, f"{p}.mlp.dense_h_to_4h.weight", dtype).T
+    params["down_proj"] = _t(reader, f"{p}.mlp.dense_4h_to_h.weight", dtype).T
+    return params
+
+
+def _load_client(reader, dtype=None) -> dict:
+    out = {
+        "embed": _t(reader, "transformer.word_embeddings.weight", dtype),
+        "norm": _t(reader, "transformer.ln_f.weight", dtype),
+        "norm_bias": _t(reader, "transformer.ln_f.bias", dtype),
+    }
+    if reader.has("lm_head.weight"):
+        out["lm_head"] = _t(reader, "lm_head.weight", dtype).T
+    else:
+        out["lm_head"] = out["embed"].T
+    return out
+
+
+register_family(
+    Family(
+        "falcon", falcon_spec_from_hf, loader=_load_block,
+        client_loader=_load_client,
+    )
+)

@@ -1,0 +1,48 @@
+"""Qwen3 family: Llama structure + per-head q/k RMSNorm + explicit head_dim.
+
+Reference: /root/reference/src/bloombee/models/qwen3/ (WrappedQwen3Block).
+152k vocab -> client-side head is the heavy part (README.md:103 note).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from bloombee_tpu.models.auto import Family, register_family
+from bloombee_tpu.models.llama.block import HF_BLOCK_KEYS, convert_hf_block_params
+from bloombee_tpu.models.spec import ModelSpec
+
+
+def qwen3_spec_from_hf(config: Any) -> ModelSpec:
+    return ModelSpec(
+        family="qwen3",
+        hidden_size=config.hidden_size,
+        intermediate_size=config.intermediate_size,
+        num_attention_heads=config.num_attention_heads,
+        num_key_value_heads=config.num_key_value_heads,
+        head_dim=getattr(config, "head_dim", None)
+        or config.hidden_size // config.num_attention_heads,
+        num_hidden_layers=config.num_hidden_layers,
+        vocab_size=config.vocab_size,
+        rms_norm_eps=config.rms_norm_eps,
+        rope_theta=getattr(config, "rope_theta", 1000000.0),
+        tie_word_embeddings=getattr(config, "tie_word_embeddings", False),
+        qk_norm=True,
+    )
+
+
+def _load_block(reader, layer_idx: int, dtype=None) -> dict:
+    prefix = f"model.layers.{layer_idx}"
+    tensors = {k: reader.tensor(f"{prefix}.{k}") for k in HF_BLOCK_KEYS}
+    params = convert_hf_block_params(tensors, dtype=dtype)
+    for name in ("q_norm", "k_norm"):
+        w = jnp.asarray(reader.tensor(f"{prefix}.self_attn.{name}.weight"))
+        params[name] = w.astype(dtype) if dtype is not None else w
+    return params
+
+
+register_family(
+    Family("qwen3", qwen3_spec_from_hf, HF_BLOCK_KEYS, loader=_load_block)
+)

@@ -46,6 +46,18 @@ class ModelSpec:
     embedding_multiplier: float = 1.0
     # Per-layer rope theta override for sliding layers (Gemma3-style)
     rope_local_theta: float = 0.0
+    # block structure knobs
+    norm_type: str = "rms"  # "rms" | "ln"
+    mlp_type: str = "silu"  # "silu" | "gelu" | "gelu_tanh_gated"
+    sandwich_norms: bool = False  # Gemma2-style post-attn/post-ffn norms
+    attn_logit_softcap: float = 0.0
+
+    def window_for_layer(self, layer_idx: int) -> int:
+        return (
+            self.sliding_window
+            if self.layer_type(layer_idx) == "sliding"
+            else 0
+        )
 
     @property
     def gqa_groups(self) -> int:

@@ -1,0 +1,24 @@
+"""ALiBi attention biases (Bloom family).
+
+Matches HF Bloom's slope construction (powers of 2^(-8/n) with the
+odd-head extension). The bias added to logits is slopes[h] * key_position —
+equivalent to the distance form up to a per-row constant, which softmax
+ignores.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    closest = 2 ** math.floor(math.log2(n_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = [base ** (i + 1) for i in range(closest)]
+    if closest != n_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        num_extra = min(closest, n_heads - closest)
+        slopes.extend(extra_base ** (1 + 2 * i) for i in range(num_extra))
+    return np.asarray(slopes, dtype=np.float32)

@@ -38,6 +38,12 @@ PARAM_SPECS = {
     "down_proj": P("pp", "tp", None),
     "q_norm": P("pp", None),
     "k_norm": P("pp", None),
+    # MoE (mixtral): experts shard over the tp axis = expert parallelism,
+    # which the reference lacks entirely (SURVEY.md section 2.8)
+    "router": P("pp", None, None),
+    "experts_gate": P("pp", "tp", None, None),
+    "experts_up": P("pp", "tp", None, None),
+    "experts_down": P("pp", "tp", None, None),
 }
 
 
@@ -96,9 +102,28 @@ def spmd_block_forward(
     hidden = hidden + lax.psum(partial, tp_axis)
 
     x = rms_norm(hidden, params_l["post_attention_layernorm"], spec.rms_norm_eps)
-    partial = silu_mlp(
-        x, params_l["gate_proj"], params_l["up_proj"], params_l["down_proj"]
-    )
+    if spec.num_experts:
+        # expert parallelism: full router everywhere, local expert shard
+        # computes its weighted contribution, psum combines
+        from bloombee_tpu.ops.moe import moe_mlp, router_topk_weights
+
+        weights = router_topk_weights(
+            x @ params_l["router"], spec.num_experts_per_tok
+        )  # [b, c, E] full
+        e_local = params_l["experts_gate"].shape[0]
+        rank = lax.axis_index(tp_axis)
+        local_w = lax.dynamic_slice_in_dim(
+            weights, rank * e_local, e_local, axis=-1
+        )
+        partial = moe_mlp(
+            x, None, params_l["experts_gate"], params_l["experts_up"],
+            params_l["experts_down"], spec.num_experts_per_tok,
+            router_weights=local_w,
+        )
+    else:
+        partial = silu_mlp(
+            x, params_l["gate_proj"], params_l["up_proj"], params_l["down_proj"]
+        )
     hidden = hidden + lax.psum(partial, tp_axis)
     return hidden
 

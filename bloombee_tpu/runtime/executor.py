@@ -37,10 +37,17 @@ class SpanExecutor:
         manager: CacheManager,
         max_chunk_tokens: int = 512,
         compute_dtype=jnp.bfloat16,
+        start_block: int = 0,
     ):
         self.params = stacked_params
         self.spec = spec
         self.manager = manager
+        # per-layer sliding windows (gemma-style alternating layers); layer
+        # types are indexed by ABSOLUTE block id, so the span offset matters
+        self.windows = tuple(
+            spec.window_for_layer(start_block + i)
+            for i in range(manager.num_layers)
+        )
         self.max_chunk_tokens = max_chunk_tokens
         self.compute_dtype = compute_dtype
         # ship hidden states over the host link at half width when computing
@@ -156,6 +163,7 @@ class SpanExecutor:
             page_size=self.page_size,
             max_pages=pb,
             use_tree_mask=tree_mask is not None,
+            windows=self.windows,
         )
         self.manager.arena = {"k": new_k, "v": new_v}
         return np.asarray(out[:b, :t]).astype(np.float32)

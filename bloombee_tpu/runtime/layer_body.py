@@ -1,0 +1,188 @@
+"""Family-generic transformer layer body for the paged span step.
+
+One implementation covers every supported family via ModelSpec switches
+(all resolved at trace time — the compiled program contains no branches):
+
+- llama / qwen3 / mixtral: RMSNorm, rotary, GQA, gated-SiLU or MoE MLP,
+  optional per-head q/k norm (qwen3)
+- gemma2-style: sandwich norms, gated tanh-GELU MLP, attention logit
+  soft-capping, alternating sliding-window layers (per-layer window rides
+  the scan)
+- bloom: LayerNorm(+bias), ALiBi instead of rotary, plain 4h GELU MLP,
+  biased projections
+- falcon: LayerNorm, rotary, MQA/GQA, parallel attention+MLP residual
+
+Replaces the reference's per-family Wrapped*Block zoo
+(/root/reference/src/bloombee/models/*/block.py) — there the per-family code
+wraps HF torch modules; here the differences are data (spec fields + param
+keys), so every family runs through the same scan/paged-attention machinery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bloombee_tpu.kv.arena import arena_write, gather_pages
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.ops import apply_rotary, rms_norm, silu_mlp
+from bloombee_tpu.ops.alibi import alibi_slopes
+from bloombee_tpu.ops.attention import NEG_INF, repeat_kv
+from bloombee_tpu.ops.moe import moe_mlp
+from bloombee_tpu.ops.norms import layer_norm
+
+
+def _norm(x, params, key, spec):
+    if spec.norm_type == "ln":
+        return layer_norm(
+            x, params[key], params.get(f"{key}_bias"), spec.rms_norm_eps
+        )
+    return rms_norm(x, params[key], spec.rms_norm_eps)
+
+
+def _proj(x, params, key):
+    y = x @ params[key]
+    b = params.get(f"{key.removesuffix('_proj')}_bias")
+    if b is not None:
+        y = y + b
+    return y
+
+
+def _mlp(x, params, spec):
+    if spec.num_experts:
+        return moe_mlp(
+            x,
+            params["router"],
+            params["experts_gate"],
+            params["experts_up"],
+            params["experts_down"],
+            spec.num_experts_per_tok,
+        )
+    if spec.mlp_type == "silu":
+        return silu_mlp(
+            x, params["gate_proj"], params["up_proj"], params["down_proj"]
+        )
+    if spec.mlp_type == "gelu_tanh_gated":
+        g = _proj(x, params, "gate_proj")
+        u = _proj(x, params, "up_proj")
+        return (jax.nn.gelu(g, approximate=True) * u) @ params["down_proj"]
+    # plain 4h GELU: "gelu" = exact/erf (falcon), "gelu_tanh" = tanh (bloom)
+    h = jax.nn.gelu(
+        _proj(x, params, "up_proj"), approximate=spec.mlp_type != "gelu"
+    )
+    return _proj(h, params, "down_proj")
+
+
+def attend_paged(
+    spec: ModelSpec,
+    q: jax.Array,  # [B, T, H, hd]
+    k_ctx: jax.Array,  # [B, S, Hkv, hd]
+    v_ctx: jax.Array,
+    q_positions: jax.Array,  # [B, T]
+    total_lens: jax.Array,  # [B]
+    tree_mask: jax.Array | None,
+    window,  # traced int32 scalar; 0 = full attention
+) -> jax.Array:
+    b, t = q.shape[:2]
+    s = k_ctx.shape[1]
+    key_pos = jnp.arange(s, dtype=jnp.int32)[None, None, :]  # [1, 1, S]
+    q_pos = q_positions[:, :, None]  # [B, T, 1]
+    valid = key_pos < total_lens[:, None, None]
+    mask = valid & (key_pos <= q_pos)
+    mask &= (window <= 0) | (key_pos > (q_pos - window))
+    if tree_mask is not None:
+        # current step's tokens sit at cache positions total-T..total-1;
+        # their mutual visibility comes from the tree mask
+        # (reference: backend.py:596-652)
+        step_start = (total_lens - t)[:, None, None]
+        in_step = (key_pos >= step_start) & (key_pos < total_lens[:, None, None])
+        rel = jnp.clip(key_pos - step_start, 0, t - 1)
+        tree_on_keys = jnp.take_along_axis(
+            tree_mask, jnp.broadcast_to(rel, (b, t, s)), axis=2
+        )
+        mask = jnp.where(in_step, tree_on_keys & valid, mask)
+
+    n_rep = q.shape[2] // k_ctx.shape[2]
+    k_r = repeat_kv(k_ctx, n_rep)
+    v_r = repeat_kv(v_ctx, n_rep)
+    scale = (
+        spec.attention_multiplier
+        if spec.attention_multiplier is not None
+        else spec.head_dim**-0.5
+    )
+    logits = jnp.einsum("bthd,bshd->bhts", q, k_r).astype(jnp.float32) * scale
+    if spec.attn_logit_softcap:
+        logits = (
+            jnp.tanh(logits / spec.attn_logit_softcap) * spec.attn_logit_softcap
+        )
+    if spec.alibi:
+        slopes = jnp.asarray(alibi_slopes(spec.num_attention_heads))
+        logits = logits + slopes[None, :, None, None] * key_pos[:, :, None, :].astype(jnp.float32)
+    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v_r)
+
+
+def layer_body(
+    spec: ModelSpec,
+    page_size: int,
+    hidden: jax.Array,  # [B, T, D]
+    params: dict,  # one layer's params
+    k_slab: jax.Array,  # [S_tot, Hkv, hd]
+    v_slab: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    slots: jax.Array,
+    page_table: jax.Array,
+    q_positions: jax.Array,
+    total_lens: jax.Array,
+    tree_mask: jax.Array | None,
+    window,  # traced scalar
+):
+    b, t, d = hidden.shape
+    h_heads, kv_heads, hd = (
+        spec.num_attention_heads,
+        spec.num_key_value_heads,
+        spec.head_dim,
+    )
+    x = _norm(hidden, params, "input_layernorm", spec)
+    q = _proj(x, params, "q_proj").reshape(b, t, h_heads, hd)
+    k = _proj(x, params, "k_proj").reshape(b, t, kv_heads, hd)
+    v = _proj(x, params, "v_proj").reshape(b, t, kv_heads, hd)
+    if spec.qk_norm:
+        q = rms_norm(q, params["q_norm"], spec.rms_norm_eps)
+        k = rms_norm(k, params["k_norm"], spec.rms_norm_eps)
+    if not spec.alibi:
+        q, k = apply_rotary(q, k, cos, sin)
+
+    k_slab, v_slab = arena_write(
+        k_slab, v_slab, slots,
+        k.reshape(b * t, kv_heads, hd), v.reshape(b * t, kv_heads, hd),
+    )
+    k_ctx = gather_pages(k_slab, page_table, page_size).astype(hidden.dtype)
+    v_ctx = gather_pages(v_slab, page_table, page_size).astype(hidden.dtype)
+
+    attn = attend_paged(
+        spec, q, k_ctx, v_ctx, q_positions, total_lens, tree_mask, window
+    )
+    attn_out = _proj(attn.reshape(b, t, h_heads * hd), params, "o_proj")
+
+    if spec.parallel_attn:
+        # falcon-7b style: one shared input norm feeds attention AND MLP
+        hidden = hidden + attn_out + _mlp(x, params, spec)
+        return hidden, k_slab, v_slab
+
+    if spec.sandwich_norms:
+        attn_out = _norm(attn_out, params, "post_attention_layernorm", spec)
+        hidden = hidden + attn_out
+        x2 = _norm(hidden, params, "pre_feedforward_layernorm", spec)
+        mlp_out = _norm(
+            _mlp(x2, params, spec), params, "post_feedforward_layernorm", spec
+        )
+        hidden = hidden + mlp_out
+        return hidden, k_slab, v_slab
+
+    hidden = hidden + attn_out
+    x2 = _norm(hidden, params, "post_attention_layernorm", spec)
+    hidden = hidden + _mlp(x2, params, spec)
+    return hidden, k_slab, v_slab

@@ -23,108 +23,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from bloombee_tpu.kv.arena import arena_write, gather_pages
 from bloombee_tpu.models.spec import ModelSpec
-from bloombee_tpu.ops import apply_rotary, rms_norm, silu_mlp
-from bloombee_tpu.ops.attention import NEG_INF, repeat_kv
 from bloombee_tpu.ops.rotary import rotary_cos_sin
-
-
-def _attend_paged(
-    spec: ModelSpec,
-    q: jax.Array,  # [B, T, H, hd]
-    k_ctx: jax.Array,  # [B, S, Hkv, hd] gathered pages (incl. current tokens)
-    v_ctx: jax.Array,
-    q_positions: jax.Array,  # [B, T] absolute positions (padding rows: 0)
-    total_lens: jax.Array,  # [B] valid cache length incl. current tokens
-    tree_mask: jax.Array | None,  # [B, T, T] visibility among current tokens
-    window: int = 0,  # sliding-window size; 0 = full attention
-) -> jax.Array:
-    b, t = q.shape[:2]
-    s = k_ctx.shape[1]
-    key_pos = jnp.arange(s, dtype=jnp.int32)[None, None, :]  # [1, 1, S]
-    q_pos = q_positions[:, :, None]  # [B, T, 1]
-    valid = key_pos < total_lens[:, None, None]
-    causal = key_pos <= q_pos
-    mask = valid & causal
-    if window:
-        mask &= key_pos > (q_pos - window)
-    if tree_mask is not None:
-        # Current step's tokens sit at absolute positions total-T .. total-1 in
-        # cache order; override causal visibility among them with the tree mask
-        # (reference: backend.py:596-652 tree attention mask build).
-        step_start = (total_lens - t)[:, None, None]  # [B, 1, 1]
-        in_step = (key_pos >= step_start) & (key_pos < total_lens[:, None, None])
-        # scatter tree_mask [B, T, T] onto key positions
-        rel = key_pos - step_start  # [B, 1, S]
-        rel_c = jnp.clip(rel, 0, t - 1)
-        tree_on_keys = jnp.take_along_axis(
-            tree_mask, jnp.broadcast_to(rel_c, (b, t, s)), axis=2
-        )
-        mask = jnp.where(in_step, tree_on_keys & valid, mask)
-
-    n_rep = spec.num_attention_heads // spec.num_key_value_heads
-    k_r = repeat_kv(k_ctx, n_rep)
-    v_r = repeat_kv(v_ctx, n_rep)
-    scale = (
-        spec.attention_multiplier
-        if spec.attention_multiplier is not None
-        else spec.head_dim**-0.5
-    )
-    logits = jnp.einsum("bthd,bshd->bhts", q, k_r).astype(jnp.float32) * scale
-    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhts,bshd->bthd", probs, v_r)
-
-
-def _layer_body(
-    spec: ModelSpec,
-    page_size: int,
-    hidden: jax.Array,  # [B, T, D]
-    params: dict,  # one layer's params
-    k_slab: jax.Array,  # [S_tot, Hkv, hd]
-    v_slab: jax.Array,
-    cos: jax.Array,
-    sin: jax.Array,
-    slots: jax.Array,  # [B*T] flat write slots (OOB => dropped)
-    page_table: jax.Array,  # [B, max_pages]
-    q_positions: jax.Array,
-    total_lens: jax.Array,
-    tree_mask: jax.Array | None,
-    window: int,
-):
-    b, t, d = hidden.shape
-    h_heads, kv_heads, hd = (
-        spec.num_attention_heads,
-        spec.num_key_value_heads,
-        spec.head_dim,
-    )
-    x = rms_norm(hidden, params["input_layernorm"], spec.rms_norm_eps)
-    q = (x @ params["q_proj"]).reshape(b, t, h_heads, hd)
-    k = (x @ params["k_proj"]).reshape(b, t, kv_heads, hd)
-    v = (x @ params["v_proj"]).reshape(b, t, kv_heads, hd)
-    if spec.qk_norm:
-        q = rms_norm(q, params["q_norm"], spec.rms_norm_eps)
-        k = rms_norm(k, params["k_norm"], spec.rms_norm_eps)
-    q, k = apply_rotary(q, k, cos, sin)
-
-    k_slab, v_slab = arena_write(
-        k_slab, v_slab, slots,
-        k.reshape(b * t, kv_heads, hd), v.reshape(b * t, kv_heads, hd),
-    )
-    k_ctx = gather_pages(k_slab, page_table, page_size).astype(hidden.dtype)
-    v_ctx = gather_pages(v_slab, page_table, page_size).astype(hidden.dtype)
-
-    attn = _attend_paged(
-        spec, q, k_ctx, v_ctx, q_positions, total_lens, tree_mask, window
-    )
-    hidden = hidden + attn.reshape(b, t, h_heads * hd) @ params["o_proj"]
-
-    x = rms_norm(hidden, params["post_attention_layernorm"], spec.rms_norm_eps)
-    hidden = hidden + silu_mlp(
-        x, params["gate_proj"], params["up_proj"], params["down_proj"]
-    )
-    return hidden, k_slab, v_slab
+from bloombee_tpu.runtime.layer_body import layer_body
 
 
 def unpack_plan(plan: jax.Array, b: int, t: int, max_pages: int, num_layers: int):
@@ -176,7 +77,7 @@ def span_step_impl(
     page_size: int,
     max_pages: int,
     use_tree_mask: bool = False,
-    window: int = 0,
+    windows: tuple | None = None,
 ):
     """Run all local blocks over one step; returns (hidden, arena_k, arena_v).
 
@@ -193,14 +94,17 @@ def span_step_impl(
     sin = sin.astype(hidden.dtype)
 
     tm = tree_mask if use_tree_mask else None
+    windows_arr = jnp.asarray(
+        windows if windows is not None else (0,) * num_layers, jnp.int32
+    )
 
     def body(h, xs):
-        params_l, k_l, v_l, active = xs
+        params_l, k_l, v_l, active, window_l = xs
 
         def run(h, k_l, v_l):
-            return _layer_body(
+            return layer_body(
                 spec, page_size, h, params_l, k_l, v_l, cos, sin, slots,
-                page_table, q_positions, total_lens, tm, window,
+                page_table, q_positions, total_lens, tm, window_l,
             )
 
         def skip(h, k_l, v_l):
@@ -210,13 +114,13 @@ def span_step_impl(
         return h, (k_l, v_l)
 
     hidden, (arena_k, arena_v) = lax.scan(
-        body, hidden, (stacked_params, arena_k, arena_v, layer_active)
+        body, hidden, (stacked_params, arena_k, arena_v, layer_active, windows_arr)
     )
     return hidden, arena_k, arena_v
 
 
 span_step = functools.partial(
     jax.jit,
-    static_argnames=("spec", "page_size", "max_pages", "use_tree_mask", "window"),
+    static_argnames=("spec", "page_size", "max_pages", "use_tree_mask", "windows"),
     donate_argnames=("arena_k", "arena_v"),
 )(span_step_impl)

@@ -130,6 +130,7 @@ class BlockServer:
             params, spec, self.manager,
             max_chunk_tokens=max_chunk_tokens,
             compute_dtype=compute_dtype,
+            start_block=start,
         )
         self.compute = ComputeQueue()
         self.peers = _PeerPool()
