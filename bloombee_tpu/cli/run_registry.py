@@ -1,0 +1,36 @@
+"""Run the swarm registry (bootstrap node).
+
+Reference: /root/reference/src/bloombee/cli/run_dht.py — the hivemind DHT
+bootstrap role. Usage:
+
+    python -m bloombee_tpu.cli.run_registry --host 0.0.0.0 --port 7700
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=7700)
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level)
+
+    from bloombee_tpu.swarm.registry import RegistryServer
+
+    async def run():
+        reg = RegistryServer(host=args.host, port=args.port)
+        await reg.start()
+        logging.info("registry listening on %s:%d", args.host, reg.port)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

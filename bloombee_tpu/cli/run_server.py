@@ -1,0 +1,99 @@
+"""Run a worker server hosting a span of blocks.
+
+Reference: /root/reference/src/bloombee/cli/run_server.py:18-231. Block
+selection is automatic when --blocks is omitted: the server measures its
+compute throughput, fetches the swarm's current coverage from the registry,
+and picks the least-served window (reference block_selection.py).
+
+    python -m bloombee_tpu.cli.run_server /path/to/model \\
+        --registry 10.0.0.1:7700 --blocks 0:16 --port 7800
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("model_dir", help="local HF model directory")
+    parser.add_argument("--model-uid", default=None,
+                        help="swarm uid (default: model dir name)")
+    parser.add_argument("--registry", default="127.0.0.1:7700")
+    parser.add_argument("--blocks", default=None,
+                        help="'start:end' or omit for automatic selection")
+    parser.add_argument("--num-blocks", type=int, default=None,
+                        help="how many blocks to serve when auto-selecting")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--public-host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--num-pages", type=int, default=256)
+    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--max-chunk-tokens", type=int, default=512)
+    parser.add_argument("--dtype", default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    parser.add_argument("--announce-period", type=float, default=5.0)
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level)
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.models.checkpoint import load_spec
+    from bloombee_tpu.server.block_selection import (
+        choose_best_blocks,
+        choose_num_blocks,
+    )
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient
+    from bloombee_tpu.swarm.spans import compute_spans
+
+    host, port = args.registry.rsplit(":", 1)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    spec = load_spec(args.model_dir)
+    model_uid = args.model_uid or args.model_dir.rstrip("/").split("/")[-1]
+
+    async def run():
+        registry = RegistryClient(host, int(port))
+        if args.blocks:
+            start, end = (int(x) for x in args.blocks.split(":"))
+        else:
+            infos = await registry.get_module_infos(
+                model_uid, range(spec.num_hidden_layers)
+            )
+            n = args.num_blocks or choose_num_blocks(
+                spec, dtype, args.num_pages, args.page_size
+            )
+            start, end = choose_best_blocks(infos, compute_spans(infos), n)
+            logging.info(
+                "auto-selected blocks [%d:%d) (%d blocks)", start, end, n
+            )
+
+        server = BlockServer(
+            model_uid=model_uid, start=start, end=end,
+            model_dir=args.model_dir, registry=registry,
+            host=args.host, port=args.port, public_host=args.public_host,
+            num_pages=args.num_pages, page_size=args.page_size,
+            compute_dtype=dtype, max_chunk_tokens=args.max_chunk_tokens,
+            announce_period=args.announce_period,
+        )
+        await server.start()
+        from bloombee_tpu.server.throughput import measure_and_announce
+
+        # keep a strong reference: the loop holds tasks only weakly
+        server._throughput_task = asyncio.create_task(
+            measure_and_announce(server)
+        )
+        logging.info(
+            "server %s serving %s[%d:%d) on port %d",
+            server.server_id, model_uid, start, end, server.port,
+        )
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,10 @@ class InferenceSession:
         self._history: list[np.ndarray] = []  # chain inputs, for replay
         self._step_counter = 0
         self.position = 0
+        # per-step timing rows (the client half of the reference's
+        # [TIMING_TABLE], handler.py:1276-1605): one entry per step with
+        # per-span compute ms and the end-to-end wall ms
+        self.timings: list[dict] = []
 
     # ------------------------------------------------------------- lifecycle
     async def __aenter__(self) -> "InferenceSession":
@@ -169,7 +173,11 @@ class InferenceSession:
             meta = {**meta_base, "reply": "tensor"}
             await self._spans[0].stream.send(meta, tensors)
 
+        import time
+
+        t_start = time.perf_counter()
         out = None
+        compute_ms = []
         for i, span_sess in enumerate(self._spans):
             try:
                 item = await asyncio.wait_for(
@@ -182,6 +190,7 @@ class InferenceSession:
                 self.manager.ban_peer(span_sess.span.peer_id)
                 raise RpcError(f"span {i} closed mid-session")
             resp_meta, resp_tensors = item
+            compute_ms.append(resp_meta.get("t_compute_ms"))
             if resp_meta.get("ack"):
                 continue
             out = resp_tensors[0]
@@ -191,7 +200,47 @@ class InferenceSession:
                     [out] + tensors[1:],
                 )
         assert out is not None, "no span returned a tensor"
+        total_ms = (time.perf_counter() - t_start) * 1000.0
+        self.timings.append(
+            {
+                "step": step_id,
+                "tokens": hidden.shape[1],
+                "span_compute_ms": compute_ms,
+                "total_ms": total_ms,
+            }
+        )
         return np.asarray(out, dtype=np.float32)
+
+    def timing_summary(self) -> dict:
+        """Aggregate decode-step timing: mean per-span compute vs wire+other
+        (the client-side view of the reference's paper timing tables)."""
+        decode = [t for t in self.timings if t["tokens"] == 1]
+        rows = decode or self.timings
+        if not rows:
+            return {}
+        n_spans = max(len(t["span_compute_ms"]) for t in rows)
+        per_span = [
+            float(
+                np.mean(
+                    [
+                        t["span_compute_ms"][i]
+                        for t in rows
+                        if len(t["span_compute_ms"]) > i
+                        and t["span_compute_ms"][i] is not None
+                    ]
+                    or [0.0]
+                )
+            )
+            for i in range(n_spans)
+        ]
+        total = float(np.mean([t["total_ms"] for t in rows]))
+        compute = float(np.sum(per_span))
+        return {
+            "steps": len(rows),
+            "mean_total_ms": total,
+            "mean_compute_ms_per_span": per_span,
+            "mean_wire_and_overhead_ms": total - compute,
+        }
 
     async def send_accept(self, accept: list) -> None:
         """Apply a speculative accept on every span without running compute

@@ -1,0 +1,181 @@
+"""Client-side remote training: fault-tolerant sequential autograd + p-tuning.
+
+Mirrors /root/reference/src/bloombee/client/sequential_autograd.py:25-278
+(span-wise sequential_forward/sequential_backward with retries) and
+ptune.py:21-80 (trainable prompt embeddings, frozen remote blocks). The
+autograd "function" here is explicit: the local head/loss gradient comes
+from jax.vjp, the chain gradient from rpc_backward span by span in reverse.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+from bloombee_tpu.wire.rpc import RpcError, connect
+
+logger = logging.getLogger(__name__)
+
+
+class RemoteSpanChain:
+    """Forward/backward over the span chain via rpc_forward/rpc_backward."""
+
+    def __init__(self, manager: RemoteSequenceManager, max_retries: int = 3):
+        self.manager = manager
+        self.max_retries = max_retries
+
+    async def _call_span(self, span, method, tensors):
+        conn = await connect(span.server_info.host, span.server_info.port)
+        try:
+            meta = {"start": span.start, "end": span.end}
+            _, out = await conn.call(method, meta, tensors)
+            return out
+        finally:
+            await conn.close()
+
+    async def forward(self, hidden: np.ndarray):
+        """Returns (output, ctx) where ctx holds per-span inputs for backward
+        (reference sequential_forward's intermediate activation capture)."""
+        attempt = 0
+        while True:
+            await self.manager.update()
+            route = self.manager.make_sequence()
+            inputs = []
+            try:
+                h = hidden
+                for span in route:
+                    inputs.append(h)
+                    (h,) = await self._call_span(span, "rpc_forward", [h])
+                return h, (route, inputs)
+            except (RpcError, OSError, asyncio.TimeoutError) as e:
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise
+                logger.warning("chain forward failed (%s); retrying", e)
+                await self.manager.update(force=True)
+
+    async def backward(self, ctx, grad_out: np.ndarray) -> np.ndarray:
+        """Reversed-span gradient chain; retries re-route the failed span
+        only (its input is captured in ctx)."""
+        route, inputs = ctx
+        g = grad_out
+        for span, h_in in zip(reversed(route), reversed(inputs)):
+            attempt = 0
+            while True:
+                try:
+                    (g,) = await self._call_span(
+                        span, "rpc_backward", [h_in, g]
+                    )
+                    break
+                except (RpcError, OSError, asyncio.TimeoutError) as e:
+                    attempt += 1
+                    if attempt > self.max_retries:
+                        raise
+                    logger.warning("span backward failed (%s); re-routing", e)
+                    self.manager.ban_peer(span.peer_id)
+                    await self.manager.update(force=True)
+                    new_route = self.manager.make_sequence(span.start, span.end)
+                    if len(new_route) != 1:
+                        raise RpcError(
+                            f"no single replacement span for "
+                            f"[{span.start},{span.end})"
+                        )
+                    span = new_route[0]
+        return g
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "norm_type"))
+def _head_loss_and_grads(
+    norm_w, norm_b, head_w_in, chain_out, target_ids, mask,
+    eps: float, norm_type: str,
+):
+    """Loss + grads w.r.t. (lm_head, chain_out). Prompts receive their grad
+    through chain_out's leading positions (handled by the caller)."""
+
+    def loss_fn(head_w, h):
+        from bloombee_tpu.ops import rms_norm
+        from bloombee_tpu.ops.norms import layer_norm
+
+        if norm_type == "ln":
+            hn = layer_norm(h, norm_w, norm_b, eps)
+        else:
+            hn = rms_norm(h, norm_w, eps)
+        logits = (hn @ head_w).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tgt = jnp.where(mask, target_ids, 0)
+        token_lp = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        return -(token_lp * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+    loss, (g_head, g_out) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+        head_w_in, chain_out
+    )
+    return loss, g_head, g_out
+
+
+class PTuneTrainer:
+    """Prompt-tuning against frozen remote blocks (reference PTuneMixin)."""
+
+    def __init__(
+        self,
+        model: DistributedModelForCausalLM,
+        n_prompt: int = 8,
+        lr: float = 0.05,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.chain = RemoteSpanChain(model.manager)
+        self.n_prompt = n_prompt
+        self.lr = lr
+        d = model.spec.hidden_size
+        rng = np.random.default_rng(seed)
+        self.prompts = jnp.asarray(
+            rng.normal(size=(n_prompt, d)).astype(np.float32) * 0.02
+        )
+        self.lm_head = model.params["lm_head"].astype(jnp.float32)
+
+    async def train_step(
+        self, input_ids: np.ndarray, target_ids: np.ndarray
+    ) -> float:
+        """One SGD step on (prompts, lm_head); targets -100 = ignored."""
+        b, s = input_ids.shape
+        h_tok = self.model.embed(input_ids)
+        h_in = np.concatenate(
+            [
+                np.broadcast_to(
+                    np.asarray(self.prompts)[None], (b, self.n_prompt, h_tok.shape[-1])
+                ),
+                h_tok,
+            ],
+            axis=1,
+        ).astype(np.float32)
+
+        chain_out, ctx = await self.chain.forward(h_in)
+
+        target_full = np.full((b, self.n_prompt + s), -100, np.int64)
+        target_full[:, self.n_prompt :] = target_ids
+        mask = jnp.asarray(target_full >= 0)
+        loss, g_head, g_out = _head_loss_and_grads(
+            self.model.params["norm"],
+            self.model.params.get("norm_bias"),
+            self.lm_head,
+            jnp.asarray(chain_out),
+            jnp.asarray(np.maximum(target_full, 0)),
+            mask,
+            eps=self.model.spec.rms_norm_eps,
+            norm_type=self.model.spec.norm_type,
+        )
+
+        g_in = await self.chain.backward(ctx, np.asarray(g_out))
+        g_prompts = jnp.asarray(g_in[:, : self.n_prompt]).sum(axis=0)
+
+        self.prompts = self.prompts - self.lr * g_prompts
+        self.lm_head = self.lm_head - self.lr * g_head
+        return float(loss)

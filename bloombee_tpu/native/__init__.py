@@ -1,0 +1,71 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Compiled lazily on first use with the system toolchain and cached under
+~/.cache/bloombee_tpu; every caller must tolerate `None` (pure-Python
+fallback) so the framework works on toolchain-less hosts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import pathlib
+import subprocess
+
+logger = logging.getLogger(__name__)
+
+_SRC_DIR = pathlib.Path(__file__).parent
+_CACHE = pathlib.Path.home() / ".cache" / "bloombee_tpu"
+
+_byte_split_lib = None
+_tried = False
+
+
+def _build(src: pathlib.Path) -> pathlib.Path | None:
+    code = src.read_bytes()
+    tag = hashlib.sha1(code).hexdigest()[:12]
+    out = _CACHE / f"{src.stem}-{tag}.so"
+    if out.exists():
+        return out
+    _CACHE.mkdir(parents=True, exist_ok=True)
+    # build to a process-unique temp path, then rename atomically so
+    # concurrent processes never dlopen a half-written .so
+    import os
+
+    tmp = out.with_suffix(f".tmp-{os.getpid()}")
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", str(src), "-o", str(tmp)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, out)
+        return out
+    except Exception as e:
+        logger.info("native build failed (%s); using numpy fallback", e)
+        tmp.unlink(missing_ok=True)
+        return None
+
+
+def byte_split_lib():
+    """ctypes handle to the byte-split codec, or None."""
+    global _byte_split_lib, _tried
+    if _tried:
+        return _byte_split_lib
+    _tried = True
+    so = _build(_SRC_DIR / "byte_split.cc")
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(so))
+        for fn in ("byte_split_2", "byte_merge_2"):
+            getattr(lib, fn).argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+            ]
+            getattr(lib, fn).restype = None
+        _byte_split_lib = lib
+    except Exception as e:  # pragma: no cover
+        logger.info("native load failed (%s); using numpy fallback", e)
+    return _byte_split_lib

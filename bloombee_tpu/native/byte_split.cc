@@ -1,0 +1,38 @@
+// Byte-plane split/merge for 2-byte dtypes (bf16/fp16 wire compression).
+//
+// The hot transform of the lossless wire wrapper: separating the two byte
+// planes of little-endian 2-byte elements before compression (the exponent
+// plane is highly redundant). Native so the wire path doesn't pay numpy
+// temporary allocations on multi-GB tensors. Built lazily by
+// bloombee_tpu/native/__init__.py with g++ -O3 -shared; the Python caller
+// falls back to numpy when no toolchain is available.
+//
+// Capability port of the reference's byte_split layout
+// (/root/reference/src/bloombee/utils/lossless_transport.py).
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// src: n 2-byte elements; dst: plane0 (low bytes) then plane1 (high bytes)
+void byte_split_2(const uint8_t* src, uint8_t* dst, size_t n) {
+  uint8_t* lo = dst;
+  uint8_t* hi = dst + n;
+  for (size_t i = 0; i < n; ++i) {
+    lo[i] = src[2 * i];
+    hi[i] = src[2 * i + 1];
+  }
+}
+
+// inverse: planes back to interleaved pairs
+void byte_merge_2(const uint8_t* src, uint8_t* dst, size_t n) {
+  const uint8_t* lo = src;
+  const uint8_t* hi = src + n;
+  for (size_t i = 0; i < n; ++i) {
+    dst[2 * i] = lo[i];
+    dst[2 * i + 1] = hi[i];
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,119 @@
+"""Training-side span compute: dense forward + VJP backward.
+
+The reference's training path (SURVEY.md section 3.4) runs rpc_forward /
+rpc_backward over frozen blocks: gradients flow only w.r.t. inputs and
+prompts (p-tuning); the server rebuilds activations then backprops
+(block_functions.py:357 run_rpc_backward, backend.py:427-462).
+
+Here the span forward for training reuses the SAME generic family machinery
+as serving (span_step_impl over a throwaway zero arena — scatter/gather are
+differentiable, so jax.vjp through the paged step gives exact input grads),
+and backward is one jitted VJP call. No activation storage between forward
+and backward RPCs: like the reference, backward recomputes the forward
+(rematerialization is the TPU-native default).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.runtime.step import pack_plan, span_step_impl
+
+
+def _train_plan(
+    b: int, t: int, num_layers: int,
+    layers: tuple[int, int] | None = None,
+) -> np.ndarray:
+    """Plan for a dense full-sequence pass: one page per sequence of size t.
+    `layers` gates a sub-span (router may enter a server's span mid-way)."""
+    slots = np.arange(b * t, dtype=np.int32)
+    page_table = np.arange(b, dtype=np.int32)[:, None]
+    positions = np.broadcast_to(np.arange(t, dtype=np.int32)[None], (b, t))
+    total_lens = np.full((b,), t, np.int32)
+    layer_active = np.ones((num_layers,), np.int32)
+    if layers is not None:
+        layer_active[:] = 0
+        layer_active[layers[0] : layers[1]] = 1
+    return pack_plan(slots, page_table, positions, total_lens, layer_active)
+
+
+def _dense_forward(stacked_params, hidden, plan, spec, windows):
+    b, t, _ = hidden.shape
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    arena_shape = (
+        num_layers, b * t, spec.num_key_value_heads, spec.head_dim,
+    )
+    zeros = jnp.zeros(arena_shape, hidden.dtype)
+    out, _, _ = span_step_impl(
+        stacked_params, zeros, jnp.zeros_like(zeros), hidden, plan, None,
+        spec=spec, page_size=t, max_pages=1, windows=windows,
+    )
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "windows"))
+def span_train_forward(
+    stacked_params, hidden, plan, *, spec: ModelSpec, windows=None
+):
+    return _dense_forward(stacked_params, hidden, plan, spec, windows)
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "windows"))
+def span_train_backward(
+    stacked_params, hidden_in, grad_out, plan, *,
+    spec: ModelSpec, windows=None,
+):
+    """Returns (forward_output, grad_wrt_input)."""
+    out, vjp = jax.vjp(
+        lambda h: _dense_forward(stacked_params, h, plan, spec, windows),
+        hidden_in,
+    )
+    (g_in,) = vjp(grad_out)
+    return out, g_in
+
+
+class TrainingExecutor:
+    """Host wrapper used by the server's rpc_forward/rpc_backward."""
+
+    def __init__(self, stacked_params, spec: ModelSpec, windows=None,
+                 compute_dtype=jnp.float32):
+        self.params = stacked_params
+        self.spec = spec
+        self.windows = windows
+        self.compute_dtype = compute_dtype
+        self.num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+
+    def forward(
+        self, hidden: np.ndarray, layers: tuple[int, int] | None = None
+    ) -> np.ndarray:
+        b, t, _ = hidden.shape
+        plan = jnp.asarray(_train_plan(b, t, self.num_layers, layers))
+        out = span_train_forward(
+            self.params, jnp.asarray(hidden, self.compute_dtype), plan,
+            spec=self.spec, windows=self.windows,
+        )
+        return np.asarray(out, dtype=np.float32)
+
+    def backward(
+        self,
+        hidden_in: np.ndarray,
+        grad_out: np.ndarray,
+        layers: tuple[int, int] | None = None,
+    ) -> np.ndarray:
+        b, t, _ = hidden_in.shape
+        plan = jnp.asarray(_train_plan(b, t, self.num_layers, layers))
+        _, g_in = span_train_backward(
+            self.params,
+            jnp.asarray(hidden_in, self.compute_dtype),
+            jnp.asarray(grad_out, self.compute_dtype),
+            plan,
+            spec=self.spec,
+            windows=self.windows,
+        )
+        return np.asarray(g_in, dtype=np.float32)

@@ -1,0 +1,132 @@
+"""Swarm load balancing: which blocks should a new server host?
+
+Port of /root/reference/src/bloombee/server/block_selection.py:12-95:
+build the per-block aggregate-throughput vector from announced spans, pick
+the contiguous window with minimum total throughput (the least-served
+region), and decide whether an existing server should move
+(`should_choose_other_blocks` with the balance_quality=0.75 hysteresis so
+servers don't thrash).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bloombee_tpu.swarm.data import ModuleInfo, RemoteSpanInfo
+
+BALANCE_QUALITY = 0.75
+
+
+def block_throughputs(module_infos: list[ModuleInfo]) -> np.ndarray:
+    """Aggregate announced throughput per block."""
+    out = np.zeros(len(module_infos))
+    for i, info in enumerate(module_infos):
+        for server in info.servers.values():
+            out[i] += server.throughput or 0.0
+    return out
+
+
+def choose_best_blocks(
+    module_infos: list[ModuleInfo],
+    spans: dict[str, RemoteSpanInfo],
+    num_blocks: int,
+) -> tuple[int, int]:
+    """Least-served contiguous window of `num_blocks`."""
+    tput = block_throughputs(module_infos)
+    num_blocks = min(num_blocks, len(tput))
+    best_start, best_sum = 0, float("inf")
+    for start in range(len(tput) - num_blocks + 1):
+        s = float(tput[start : start + num_blocks].sum())
+        if s < best_sum:
+            best_start, best_sum = start, s
+    return best_start, best_start + num_blocks
+
+
+def should_choose_other_blocks(
+    peer_id: str,
+    module_infos: list[ModuleInfo],
+    spans: dict[str, RemoteSpanInfo],
+) -> bool:
+    """Would moving this server's span to the current best window improve
+    the swarm's bottleneck throughput by more than the hysteresis margin?
+    (reference :40-95 simulates the move the same way)."""
+    my_span = spans.get(peer_id)
+    if my_span is None:
+        return True
+    tput = block_throughputs(module_infos)
+    current_min = float(tput.min())
+
+    # simulate leaving
+    without = tput.copy()
+    without[my_span.start : my_span.end] -= my_span.server_info.throughput or 0.0
+    # best place to re-land
+    n = my_span.length
+    best = None
+    for start in range(len(tput) - n + 1):
+        cand = without.copy()
+        cand[start : start + n] += my_span.server_info.throughput or 0.0
+        m = float(cand.min())
+        if best is None or m > best:
+            best = m
+    return best is not None and best * BALANCE_QUALITY > current_min
+
+
+def estimate_block_bytes(spec, dtype) -> int:
+    """Parameter bytes of one block (reference block_utils.get_block_size:
+    param count x dtype width, meta-device instantiation not needed — the
+    spec already knows the shapes)."""
+    import numpy as np
+
+    d, i = spec.hidden_size, spec.intermediate_size
+    h, kv, hd = (
+        spec.num_attention_heads, spec.num_key_value_heads, spec.head_dim,
+    )
+    attn = d * h * hd + 2 * d * kv * hd + h * hd * d
+    if spec.num_experts:
+        mlp = spec.num_experts * 3 * d * i + d * spec.num_experts
+    elif spec.mlp_type == "silu" or spec.mlp_type == "gelu_tanh_gated":
+        mlp = 3 * d * i
+    else:
+        mlp = 2 * d * i
+    norms = 4 * d
+    itemsize = np.dtype(dtype).itemsize if dtype is not None else 2
+    return (attn + mlp + norms) * itemsize
+
+
+def choose_num_blocks(
+    spec, dtype, num_pages: int, page_size: int, memory_fraction: float = 0.8
+) -> int:
+    """How many blocks fit in this device's memory, after the KV arena
+    (reference Server._choose_num_blocks, server.py:427-477). Falls back to
+    the whole model when the backend exposes no memory stats (e.g. CPU)."""
+    import numpy as np
+
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats()
+        limit = stats["bytes_limit"]
+    except Exception:
+        return spec.num_hidden_layers
+    per_block = estimate_block_bytes(spec, dtype)
+    arena_bytes = (
+        num_pages * page_size * spec.num_key_value_heads * spec.head_dim
+        * 2 * np.dtype(dtype).itemsize
+    )  # per layer (k+v)
+    budget = limit * memory_fraction
+    n = int(budget // (per_block + arena_bytes))
+    return max(1, min(n, spec.num_hidden_layers))
+
+
+async def rebalance_if_needed(server) -> bool:
+    """Periodic check a server can run: fetch swarm state, decide, and
+    report (the actual move = stop + restart with new blocks, driven by the
+    operator or a supervisor loop)."""
+    from bloombee_tpu.swarm.spans import compute_spans
+
+    infos = await server.registry.get_module_infos(
+        server.model_uid, range(server.spec.num_hidden_layers)
+    )
+    return should_choose_other_blocks(
+        server.server_id, infos, compute_spans(infos)
+    )

@@ -117,6 +117,7 @@ class BlockServer:
         self.alloc_timeout = alloc_timeout
         self.public_host = public_host or host
         self.throughput = throughput
+        self.inference_rps: float | None = None
 
         self.manager = CacheManager(
             num_layers=end - start,
@@ -132,6 +133,12 @@ class BlockServer:
             compute_dtype=compute_dtype,
             start_block=start,
         )
+        from bloombee_tpu.runtime.training import TrainingExecutor
+
+        self.training = TrainingExecutor(
+            params, spec, windows=self.executor.windows,
+            compute_dtype=compute_dtype,
+        )
         self.compute = ComputeQueue()
         self.peers = _PeerPool()
         self._sessions: dict[str, _Session] = {}
@@ -142,6 +149,7 @@ class BlockServer:
             unary_handlers={
                 "rpc_info": self._rpc_info,
                 "rpc_forward": self._rpc_forward,
+                "rpc_backward": self._rpc_backward,
             },
             stream_handlers={"rpc_inference": self._rpc_inference},
             push_handlers={"rpc_push": self._rpc_push},
@@ -185,7 +193,7 @@ class BlockServer:
             host=self.public_host,
             port=self.port,
             throughput=self.throughput,
-            inference_rps=None,
+            inference_rps=self.inference_rps,
             cache_tokens_left=self.manager.tokens_left,
             start_block=self.start_block,
             end_block=self.end_block,
@@ -299,7 +307,7 @@ class BlockServer:
                 depths = np.asarray(meta["depths"], dtype=np.int32)
         commit = bool(meta.get("commit", True))
 
-        out = await self.compute.submit(
+        out, t_compute_ms = await self.compute.submit(
             PRIORITY_INFERENCE,
             self._compute_step,
             session,
@@ -331,23 +339,40 @@ class BlockServer:
             conn = await self.peers.get(nxt["host"], nxt["port"])
             await conn.push("rpc_push", push_meta, push_tensors)
             # ack our own client stream so it can detect this hop succeeded
-            await stream.send({"step": meta.get("step"), "ack": True})
+            await stream.send(
+                {"step": meta.get("step"), "ack": True,
+                 "t_compute_ms": t_compute_ms}
+            )
         elif reply == "ack":
-            await stream.send({"step": meta.get("step"), "ack": True})
+            await stream.send(
+                {"step": meta.get("step"), "ack": True,
+                 "t_compute_ms": t_compute_ms}
+            )
         else:
-            await stream.send({"step": meta.get("step")}, [out])
+            await stream.send(
+                {"step": meta.get("step"), "t_compute_ms": t_compute_ms},
+                [out],
+            )
 
     def _compute_step(
         self, session: _Session, hidden, commit, tree_mask, depths=None
     ):
+        """Runs on the compute thread; times pure compute (not queue wait) —
+        the unit of the reference's [TIMING_TABLE] decomposition
+        (handler.py:1276-1605)."""
+        import time
+
+        t0 = time.perf_counter()
         if hidden.shape[1] > 1 and tree_mask is None:
-            return self.executor.prefill(
+            out = self.executor.prefill(
                 session.handle, hidden, commit=commit, layers=session.layers
             )
-        return self.executor.decode(
-            session.handle, hidden, commit=commit, tree_mask=tree_mask,
-            layers=session.layers, depths=depths,
-        )
+        else:
+            out = self.executor.decode(
+                session.handle, hidden, commit=commit, tree_mask=tree_mask,
+                layers=session.layers, depths=depths,
+            )
+        return out, (time.perf_counter() - t0) * 1000.0
 
     async def _rpc_push(self, meta: dict, tensors) -> None:
         session = self._sessions.get(meta["session_id"])
@@ -384,11 +409,20 @@ class BlockServer:
         """Span forward without a session (training / one-shot),
         reference block_functions.py:247 run_rpc_forward."""
         hidden = np.asarray(tensors[0], dtype=np.float32)
-        b, t, _ = hidden.shape
         layers = self._resolve_layers(meta)
-        async with self.manager.allocate(b, t, timeout=self.alloc_timeout) as h:
-            out = await self.compute.submit(
-                PRIORITY_TRAINING, self.executor.prefill, h, hidden,
-                True, layers,
-            )
+        out = await self.compute.submit(
+            PRIORITY_TRAINING, self.training.forward, hidden, layers
+        )
         return {"ok": True}, [out]
+
+    async def _rpc_backward(self, meta: dict, tensors):
+        """Gradient w.r.t. span inputs (blocks frozen; backward recomputes
+        the forward — reference block_functions.py:357 run_rpc_backward)."""
+        hidden_in = np.asarray(tensors[0], dtype=np.float32)
+        grad_out = np.asarray(tensors[1], dtype=np.float32)
+        layers = self._resolve_layers(meta)
+        g_in = await self.compute.submit(
+            PRIORITY_TRAINING, self.training.backward, hidden_in, grad_out,
+            layers,
+        )
+        return {"ok": True}, [g_in]

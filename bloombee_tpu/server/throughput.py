@@ -1,0 +1,90 @@
+"""Server throughput measurement + announcement.
+
+Port of /root/reference/src/bloombee/server/throughput.py:44-345: measure
+real decode steps through the span executor, cache the result on disk keyed
+by (model, span, dtype, device), and fold it into the announced ServerInfo
+so client routing can rank servers. Timing uses the scalar-fetch fence
+(block_until_ready is unreliable on tunneled PJRT backends).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import pathlib
+import time
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+CACHE_PATH = pathlib.Path.home() / ".cache" / "bloombee_tpu" / "throughput.json"
+
+
+def _cache_key(server) -> str:
+    import jax
+
+    raw = json.dumps(
+        [
+            server.model_uid,
+            server.start_block,
+            server.end_block,
+            str(server.executor.compute_dtype),
+            str(jax.devices()[0]),
+        ]
+    )
+    return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+def _load_cache() -> dict:
+    try:
+        with open(CACHE_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _store_cache(cache: dict) -> None:
+    CACHE_PATH.parent.mkdir(parents=True, exist_ok=True)
+    with open(CACHE_PATH, "w") as f:
+        json.dump(cache, f)
+
+
+async def measure_and_announce(server, batch: int = 1, steps: int = 8) -> float:
+    """Measure (or load cached) inference rps and fold into announcements."""
+    import jax.numpy as jnp
+
+    key = _cache_key(server)
+    cache = _load_cache()
+    if key in cache:
+        rps = cache[key]
+        logger.info("throughput cache hit: %.2f rps", rps)
+    else:
+        from bloombee_tpu.server.compute_queue import PRIORITY_TRAINING
+
+        d = server.spec.hidden_size
+        async with server.manager.allocate(batch, steps + 8) as handle:
+            hidden = np.zeros((batch, 1, d), np.float32)
+            # route through the compute queue: it is the single serialization
+            # point for device work and the shared donated KV arena
+            await server.compute.submit(
+                PRIORITY_TRAINING, server.executor.decode, handle, hidden
+            )  # compile
+            t0 = time.time()
+            out = None
+            for _ in range(steps):
+                out = await server.compute.submit(
+                    PRIORITY_TRAINING, server.executor.decode, handle, hidden
+                )
+            float(jnp.sum(jnp.asarray(out)))  # fence
+            rps = steps / max(time.time() - t0, 1e-9)
+        cache[key] = rps
+        try:
+            _store_cache(cache)
+        except Exception as e:
+            logger.warning("throughput cache store failed: %s", e)
+        logger.info("measured %.2f inference rps", rps)
+    server.throughput = rps
+    server.inference_rps = rps
+    return rps

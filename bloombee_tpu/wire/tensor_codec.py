@@ -98,8 +98,7 @@ def serialize_tensor(
         candidate = raw
         if dtype.itemsize == 2:
             # byte-plane split: [b0 b1 b0 b1 ...] -> [b0 b0 ...][b1 b1 ...]
-            pairs = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 2)
-            candidate = pairs.T.tobytes()
+            candidate = _split_planes(raw)
             byte_split = True
         chosen = "zstd" if _zstd is not None else "zlib"
         compressed = _compress(candidate, chosen)
@@ -118,9 +117,38 @@ def deserialize_tensor(meta: TensorMeta, payload: bytes) -> np.ndarray:
     else:
         raw = _decompress(payload, meta.codec)
         if meta.byte_split:
-            planes = np.frombuffer(raw, dtype=np.uint8).reshape(2, -1)
-            raw = planes.T.tobytes()
+            raw = _merge_planes(raw)
     return np.frombuffer(bytearray(raw), dtype=dtype).reshape(meta.shape)
+
+
+def _split_planes(raw: bytes) -> bytes:
+    lib = _native_lib()
+    n = len(raw) // 2
+    if lib is not None:
+        src = np.frombuffer(raw, dtype=np.uint8)
+        dst = np.empty(2 * n, dtype=np.uint8)
+        lib.byte_split_2(
+            src.ctypes.data, dst.ctypes.data, n
+        )
+        return dst.tobytes()
+    return np.frombuffer(raw, dtype=np.uint8).reshape(-1, 2).T.tobytes()
+
+
+def _merge_planes(raw: bytes) -> bytes:
+    lib = _native_lib()
+    n = len(raw) // 2
+    if lib is not None:
+        src = np.frombuffer(raw, dtype=np.uint8)
+        dst = np.empty(2 * n, dtype=np.uint8)
+        lib.byte_merge_2(src.ctypes.data, dst.ctypes.data, n)
+        return dst.tobytes()
+    return np.frombuffer(raw, dtype=np.uint8).reshape(2, -1).T.tobytes()
+
+
+def _native_lib():
+    from bloombee_tpu.native import byte_split_lib
+
+    return byte_split_lib()
 
 
 def serialize_tensors(
