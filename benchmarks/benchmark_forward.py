@@ -1,0 +1,54 @@
+"""Forward-pass (training-style) throughput benchmark.
+
+Port of /root/reference/benchmarks/benchmark_forward.py: tokens/sec through
+rpc_forward over the whole chain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("model_dir")
+    parser.add_argument("--model-uid", default=None)
+    parser.add_argument("--registry", default="127.0.0.1:7700")
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--batch", type=int, default=2)
+    parser.add_argument("--steps", type=int, default=10)
+    args = parser.parse_args(argv)
+    args.model_uid = args.model_uid or args.model_dir.rstrip("/").split("/")[-1]
+
+    async def run():
+        from bloombee_tpu.client.model import DistributedModelForCausalLM
+        from bloombee_tpu.client.trainer import RemoteSpanChain
+        from bloombee_tpu.swarm.registry import RegistryClient
+
+        host, port = args.registry.rsplit(":", 1)
+        model = DistributedModelForCausalLM.from_pretrained(
+            args.model_dir, RegistryClient(host, int(port)),
+            model_uid=args.model_uid,
+        )
+        chain = RemoteSpanChain(model.manager)
+        rng = np.random.default_rng(0)
+        h = rng.normal(
+            size=(args.batch, args.seq_len, model.spec.hidden_size)
+        ).astype(np.float32)
+        await chain.forward(h)  # warmup/compile
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            await chain.forward(h)
+        dt = time.perf_counter() - t0
+        toks = args.steps * args.batch * args.seq_len
+        print(f"forward throughput={toks / dt:.1f} tok/s")
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Client-side decode benchmark against a running swarm.
+
+Port of /root/reference/benchmarks/benchmark_inference.py:90-93: prints
+per-sequence decode throughput and effective batch throughput, plus TTFT and
+the session timing table.
+
+    python benchmarks/benchmark_inference.py MODEL_DIR --registry host:port \\
+        --seq-len 128 --max-new-tokens 64 --batch 1 --n-processes 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import time
+
+import numpy as np
+
+
+async def run_one(args, proc_idx: int) -> dict:
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.swarm.registry import RegistryClient
+
+    host, port = args.registry.rsplit(":", 1)
+    model = DistributedModelForCausalLM.from_pretrained(
+        args.model_dir, RegistryClient(host, int(port)),
+        model_uid=args.model_uid,
+    )
+    rng = np.random.default_rng(proc_idx)
+    input_ids = rng.integers(
+        0, model.spec.vocab_size, size=(args.batch, args.seq_len)
+    )
+    sess = model.inference_session(
+        args.seq_len + args.max_new_tokens, args.batch
+    )
+    await sess.__aenter__()
+    try:
+        t0 = time.perf_counter()
+        hidden = model.embed(input_ids)
+        out = await sess.step(hidden)
+        ttft = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        n = 0
+        next_ids = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+        while n < args.max_new_tokens:
+            out = await sess.step(model.embed(next_ids[:, None]))
+            next_ids = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+            n += 1
+        elapsed = time.perf_counter() - t0
+        return {
+            "ttft_s": ttft,
+            "tok_per_s_per_seq": n / elapsed,
+            "effective_tok_per_s": n * args.batch / elapsed,
+            "timing": sess.timing_summary(),
+        }
+    finally:
+        await sess.__aexit__(None, None, None)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("model_dir")
+    parser.add_argument("--model-uid", default=None)
+    parser.add_argument("--registry", default="127.0.0.1:7700")
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--max-new-tokens", type=int, default=64)
+    parser.add_argument("--batch", type=int, default=1)
+    parser.add_argument("--n-sessions", "--n-processes", type=int,
+                        default=1, dest="n_sessions",
+                        help="concurrent client sessions (one event loop)")
+    args = parser.parse_args(argv)
+    args.model_uid = args.model_uid or args.model_dir.rstrip("/").split("/")[-1]
+
+    async def run():
+        results = await asyncio.gather(
+            *(run_one(args, i) for i in range(args.n_sessions))
+        )
+        tput = float(np.mean([r["tok_per_s_per_seq"] for r in results]))
+        eff = float(np.sum([r["effective_tok_per_s"] for r in results]))
+        ttft = float(np.mean([r["ttft_s"] for r in results]))
+        print(
+            f"throughput={tput:.2f} tok/s/seq  effective_throughput={eff:.2f}"
+            f" tok/s  mean_ttft={ttft*1000:.0f} ms"
+        )
+        print("timing:", results[0]["timing"])
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Speculative decoding benchmark: speed vs plain greedy.
+
+Port of /root/reference/benchmarks/benchmark_speculative_decoding.py:55
+(prints `Final result: speed=`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("model_dir")
+    parser.add_argument("--drafter-dir", default=None,
+                        help="small draft model dir (default: target model)")
+    parser.add_argument("--model-uid", default=None)
+    parser.add_argument("--registry", default="127.0.0.1:7700")
+    parser.add_argument("--seq-len", type=int, default=32)
+    parser.add_argument("--max-new-tokens", type=int, default=64)
+    parser.add_argument("--branching", default="2,2,1")
+    args = parser.parse_args(argv)
+    args.model_uid = args.model_uid or args.model_dir.rstrip("/").split("/")[-1]
+
+    async def run():
+        from bloombee_tpu.client.model import DistributedModelForCausalLM
+        from bloombee_tpu.client.speculative import generate_speculative
+        from bloombee_tpu.spec.drafter import (
+            GreedyTreeDrafter,
+            LocalJaxDraftModel,
+        )
+        from bloombee_tpu.swarm.registry import RegistryClient
+
+        host, port = args.registry.rsplit(":", 1)
+        model = DistributedModelForCausalLM.from_pretrained(
+            args.model_dir, RegistryClient(host, int(port)),
+            model_uid=args.model_uid,
+        )
+        drafter = GreedyTreeDrafter(
+            LocalJaxDraftModel.from_dir(args.drafter_dir or args.model_dir),
+            branching=tuple(int(x) for x in args.branching.split(",")),
+        )
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, model.spec.vocab_size, size=(1, args.seq_len))
+
+        t0 = time.perf_counter()
+        plain = await model.generate(ids, max_new_tokens=args.max_new_tokens)
+        t_plain = time.perf_counter() - t0
+        n_plain = plain.shape[1] - ids.shape[1]
+
+        t0 = time.perf_counter()
+        spec = await generate_speculative(
+            model, drafter, ids, max_new_tokens=args.max_new_tokens
+        )
+        t_spec = time.perf_counter() - t0
+        n_spec = spec.shape[1] - ids.shape[1]
+
+        assert (spec[:, : plain.shape[1]] == plain).all(), "spec != greedy!"
+        print(
+            f"Final result: speed={n_spec / t_spec:.2f} tok/s "
+            f"(plain {n_plain / t_plain:.2f} tok/s, "
+            f"speedup x{(n_spec / t_spec) / (n_plain / t_plain):.2f})"
+        )
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

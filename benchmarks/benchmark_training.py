@@ -1,0 +1,58 @@
+"""Training (fwd+bwd) throughput benchmark — p-tuning steps/sec.
+
+Port of /root/reference/benchmarks/benchmark_training.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("model_dir")
+    parser.add_argument("--model-uid", default=None)
+    parser.add_argument("--registry", default="127.0.0.1:7700")
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--batch", type=int, default=2)
+    parser.add_argument("--steps", type=int, default=8)
+    parser.add_argument("--n-prompt", type=int, default=8)
+    args = parser.parse_args(argv)
+    args.model_uid = args.model_uid or args.model_dir.rstrip("/").split("/")[-1]
+
+    async def run():
+        from bloombee_tpu.client.model import DistributedModelForCausalLM
+        from bloombee_tpu.client.trainer import PTuneTrainer
+        from bloombee_tpu.swarm.registry import RegistryClient
+
+        host, port = args.registry.rsplit(":", 1)
+        model = DistributedModelForCausalLM.from_pretrained(
+            args.model_dir, RegistryClient(host, int(port)),
+            model_uid=args.model_uid,
+        )
+        trainer = PTuneTrainer(model, n_prompt=args.n_prompt)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(
+            0, model.spec.vocab_size, size=(args.batch, args.seq_len + 1)
+        )
+        await trainer.train_step(ids[:, :-1], ids[:, 1:])  # warmup
+        t0 = time.perf_counter()
+        losses = []
+        for _ in range(args.steps):
+            losses.append(await trainer.train_step(ids[:, :-1], ids[:, 1:]))
+        dt = time.perf_counter() - t0
+        toks = args.steps * args.batch * args.seq_len
+        print(
+            f"train throughput={toks / dt:.1f} tok/s  "
+            f"steps/s={args.steps / dt:.2f}  loss {losses[0]:.3f}->{losses[-1]:.3f}"
+        )
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

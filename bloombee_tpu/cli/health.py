@@ -1,0 +1,69 @@
+"""Swarm health check: which blocks are covered, by whom, with what state.
+
+Port of the reference's `bloombee.cli.health`-style checks
+(tests/test_aux_functions.py) reading registry records + rpc_info.
+
+    python -m bloombee_tpu.cli.health MODEL_UID --num-blocks 32 \\
+        --registry 127.0.0.1:7700
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("model_uid")
+    parser.add_argument("--num-blocks", type=int, required=True)
+    parser.add_argument("--registry", default="127.0.0.1:7700")
+    parser.add_argument("--probe", action="store_true",
+                        help="also call rpc_info on every server")
+    args = parser.parse_args(argv)
+
+    async def run():
+        from bloombee_tpu.swarm.registry import RegistryClient
+        from bloombee_tpu.swarm.spans import compute_spans
+        from bloombee_tpu.wire.rpc import connect
+
+        host, port = args.registry.rsplit(":", 1)
+        reg = RegistryClient(host, int(port))
+        infos = await reg.get_module_infos(
+            args.model_uid, range(args.num_blocks)
+        )
+        spans = compute_spans(infos)
+        covered = {b for s in spans.values() for b in range(s.start, s.end)}
+        missing = [b for b in range(args.num_blocks) if b not in covered]
+
+        print(f"model {args.model_uid}: {len(spans)} server(s)")
+        for sid, span in sorted(spans.items(), key=lambda kv: kv[1].start):
+            info = span.server_info
+            line = (
+                f"  {sid}  blocks [{span.start}:{span.end})  "
+                f"{info.host}:{info.port}  throughput={info.throughput:.2f}"
+            )
+            if info.cache_tokens_left is not None:
+                line += f"  cache_tokens_left={info.cache_tokens_left}"
+            if args.probe:
+                conn = None
+                try:
+                    conn = await connect(info.host, info.port)
+                    await asyncio.wait_for(conn.call("rpc_info", {}), 5)
+                    line += "  [reachable]"
+                except Exception as e:
+                    line += f"  [UNREACHABLE: {type(e).__name__}]"
+                finally:
+                    if conn is not None:
+                        await conn.close()
+            print(line)
+        if missing:
+            print(f"  MISSING blocks: {missing}")
+            raise SystemExit(1)
+        print("  swarm is COMPLETE")
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

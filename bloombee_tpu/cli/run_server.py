@@ -34,6 +34,8 @@ def main(argv=None):
     parser.add_argument("--max-chunk-tokens", type=int, default=512)
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["bfloat16", "float32"])
+    parser.add_argument("--adapter-dirs", nargs="*", default=None,
+                        help="LoRA adapter directories to merge into blocks")
     parser.add_argument("--announce-period", type=float, default=5.0)
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
@@ -78,6 +80,7 @@ def main(argv=None):
             num_pages=args.num_pages, page_size=args.page_size,
             compute_dtype=dtype, max_chunk_tokens=args.max_chunk_tokens,
             announce_period=args.announce_period,
+            adapter_dirs=args.adapter_dirs,
         )
         await server.start()
         from bloombee_tpu.server.throughput import measure_and_announce

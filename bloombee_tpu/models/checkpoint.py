@@ -80,19 +80,103 @@ def load_spec(model_dir: str) -> ModelSpec:
 
 
 def load_span_params(
-    model_dir: str, start: int, end: int, dtype=None
+    model_dir: str, start: int, end: int, dtype=None,
+    adapter_dirs: list[str] | None = None,
 ):
-    """Stacked per-layer params for blocks [start, end)."""
+    """Stacked per-layer params for blocks [start, end), with optional LoRA
+    adapters merged into the base weights (W' = W + alpha/r * B A — the
+    capability of the reference's utils/peft.py LoraLinear; merging at load
+    keeps the serving path a plain matmul)."""
     from bloombee_tpu.models.auto import get_family
     from bloombee_tpu.utils.tree import stack_params
 
     reader = CheckpointReader(model_dir)
     family = get_family(reader.model_type())
-    layers = [
-        family.load_block_params(reader, i, dtype=dtype)
-        for i in range(start, end)
-    ]
+    adapters = [LoraAdapter(d) for d in (adapter_dirs or [])]
+    layers = []
+    for i in range(start, end):
+        params = family.load_block_params(reader, i, dtype=dtype)
+        for adapter in adapters:
+            params = adapter.merge_into(params, i)
+        layers.append(params)
     return stack_params(layers), family.spec_from_config_dict(reader.config)
+
+
+class LoraAdapter:
+    """A PEFT-format LoRA adapter directory (adapter_config.json +
+    adapter_model.safetensors)."""
+
+    # our param name -> HF module suffix
+    _TARGETS = {
+        "q_proj": "self_attn.q_proj",
+        "k_proj": "self_attn.k_proj",
+        "v_proj": "self_attn.v_proj",
+        "o_proj": "self_attn.o_proj",
+        "gate_proj": "mlp.gate_proj",
+        "up_proj": "mlp.up_proj",
+        "down_proj": "mlp.down_proj",
+    }
+
+    def __init__(self, adapter_dir: str):
+        d = pathlib.Path(adapter_dir)
+        self.dir = d
+        with open(d / "adapter_config.json") as f:
+            cfg = json.load(f)
+        import math
+
+        r = cfg["r"]
+        self.scaling = cfg["lora_alpha"] / (
+            math.sqrt(r) if cfg.get("use_rslora") else r
+        )
+        files = sorted(d.glob("*.safetensors"))
+        if not files:
+            raise FileNotFoundError(f"no adapter safetensors in {d}")
+        self._handles = [safe_open(f, framework="numpy") for f in files]
+        self._key_to_handle = {
+            k: h for h in self._handles for k in h.keys()
+        }
+        self.merged_tensors = 0
+
+    def _find(self, layer_idx: int, target: str, which: str) -> str | None:
+        suffix = f"layers.{layer_idx}.{target}.{which}.weight"
+        for k in self._key_to_handle:
+            if k.endswith(suffix):
+                return k
+        return None
+
+    def _get(self, key: str) -> np.ndarray:
+        return np.asarray(
+            self._key_to_handle[key].get_tensor(key), dtype=np.float32
+        )
+
+    def merge_into(self, params: dict, layer_idx: int) -> dict:
+        import jax.numpy as jnp
+
+        merged_here = 0
+        for name, target in self._TARGETS.items():
+            if name not in params:
+                continue
+            ka = self._find(layer_idx, target, "lora_A")
+            kb = self._find(layer_idx, target, "lora_B")
+            if ka is None or kb is None:
+                continue
+            a = self._get(ka)
+            b = self._get(kb)
+            delta = (b @ a).T * self.scaling  # [in, out], matches our layout
+            params[name] = (
+                params[name].astype(jnp.float32) + jnp.asarray(delta)
+            ).astype(params[name].dtype)
+            merged_here += 1
+        if merged_here == 0:
+            # silently serving base weights as "fine-tuned" would be a
+            # correctness trap (fused-QKV families, or prefix-mismatched keys)
+            raise ValueError(
+                f"adapter {self.dir} matched no tensors for layer "
+                f"{layer_idx}; param names {sorted(params)} vs adapter keys "
+                f"like {next(iter(self._key_to_handle), None)!r}"
+            )
+        self.merged_tensors += merged_here
+        return params
 
 
 def load_client_params(model_dir: str, dtype=None) -> dict:

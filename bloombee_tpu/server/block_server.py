@@ -99,12 +99,14 @@ class BlockServer:
         announce_period: float = 5.0,
         alloc_timeout: float = 60.0,
         throughput: float = 1.0,
+        adapter_dirs: list[str] | None = None,
     ):
         if params is None:
             from bloombee_tpu.models.checkpoint import load_span_params
 
             params, spec = load_span_params(
-                model_dir, start, end, dtype=compute_dtype
+                model_dir, start, end, dtype=compute_dtype,
+                adapter_dirs=adapter_dirs,
             )
         assert spec is not None
         self.model_uid = model_uid

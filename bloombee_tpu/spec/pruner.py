@@ -1,0 +1,119 @@
+"""Server-side draft-tree pruning (MidLMHead + probability pruner).
+
+Port of /root/reference/src/bloombee/server/speculative_pruner/
+(pruner_manager.py:13-186, simple_probability_pruner.py:11-241,
+mid_layer_LM_head.py): a small trainable linear head scores MID-network
+hidden states of draft-tree nodes; children whose renormalized
+parent-conditioned probability clears a threshold are kept, the rest are
+pruned before the remaining (deeper) blocks run — cutting wasted tree
+compute and downstream wire bytes.
+
+This module provides the jitted scoring head and the keep-index math with
+the reference's semantics (keep_indices padded with -1, parents always kept
+when any descendant survives). Wire integration (shrinking the tree
+mid-chain) lands with the micro-batch/multiplexing work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bloombee_tpu.spec.tree import DraftTree
+
+
+class MidLMHead:
+    """Small linear head over mid-network hidden states (trainable online in
+    the reference via lm_head_trainer; here initialized from the real LM
+    head or randomly and updatable by assignment)."""
+
+    def __init__(self, weight: jax.Array):  # [D, V]
+        self.weight = weight
+
+    @staticmethod
+    @jax.jit
+    def _probs(weight, hidden):
+        logits = (hidden @ weight).astype(jnp.float32)
+        return jax.nn.softmax(logits, axis=-1)
+
+    def probs(self, hidden: np.ndarray) -> np.ndarray:
+        """hidden [N, D] -> softmax rows [N, V]; per-token gathering against
+        the parent's distribution happens in the pruner."""
+        return np.asarray(self._probs(self.weight, jnp.asarray(hidden)))
+
+
+@dataclasses.dataclass
+class SimpleProbabilityPruner:
+    """Keep children whose parent-conditioned renormalized probability
+    clears `threshold` (reference simple_probability_pruner.py)."""
+
+    threshold: float = 0.05
+    max_keep: int | None = None
+
+    def keep_indices(
+        self,
+        tree: DraftTree,
+        probs: np.ndarray,  # [T+1?, V]: row 0.. per node position; row for
+        # the root level comes from the last committed token (index -1 via
+        # `root_probs`)
+        root_probs: np.ndarray,  # [V]
+    ) -> np.ndarray:
+        """Returns kept linear indices, padded with -1 to max_keep (or tree
+        size). A node is kept iff its own conditional prob clears the
+        threshold AND its parent is kept (subtree pruning)."""
+        t = tree.size
+        keep = np.zeros(t, dtype=bool)
+        # renormalize within each sibling group
+        for parent in [-1] + list(range(t)):
+            children = tree.children_of(parent)
+            if len(children) == 0:
+                continue
+            dist = root_probs if parent < 0 else probs[parent]
+            child_p = np.asarray(
+                [dist[int(tree.tokens[c])] for c in children], np.float64
+            )
+            z = child_p.sum()
+            if z <= 0:
+                continue
+            child_p = child_p / z
+            for c, p in zip(children, child_p):
+                parent_ok = parent < 0 or keep[parent]
+                keep[c] = parent_ok and (p >= self.threshold)
+        kept = np.nonzero(keep)[0]
+        cap = self.max_keep or t
+        if len(kept) > cap:
+            kept = kept[:cap]
+        out = np.full(cap, -1, dtype=np.int32)
+        out[: len(kept)] = kept
+        return out
+
+
+class PrunerManager:
+    """Lazy-init + method dispatch (reference pruner_manager.py): owns the
+    MidLMHead and the active pruning strategy."""
+
+    def __init__(self, threshold: float = 0.05):
+        self._head: MidLMHead | None = None
+        self._pruner = SimpleProbabilityPruner(threshold=threshold)
+
+    def ensure_head(self, lm_head_weight) -> MidLMHead:
+        if self._head is None:
+            self._head = MidLMHead(jnp.asarray(lm_head_weight))
+        return self._head
+
+    def prune(
+        self,
+        tree: DraftTree,
+        hidden: np.ndarray,  # [T, D] mid-network hidden states of the nodes
+        root_hidden: np.ndarray,  # [D] last committed token's hidden
+        lm_head_weight,
+    ) -> np.ndarray:
+        head = self.ensure_head(lm_head_weight)
+        all_rows = head.probs(
+            np.concatenate([root_hidden[None], hidden], axis=0)
+        )
+        return self._pruner.keep_indices(tree, all_rows[1:], all_rows[0])

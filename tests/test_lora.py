@@ -1,0 +1,77 @@
+"""LoRA adapter merging: served logits must match an HF model whose weights
+were merged in torch (port of /root/reference/tests/test_peft.py intent)."""
+
+import asyncio
+import json
+
+import numpy as np
+import torch
+
+import jax.numpy as jnp
+
+
+def test_lora_merge_matches_torch(tmp_path):
+    from safetensors.torch import save_file
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    base = str(tmp_path / "base")
+    hf.save_pretrained(base, safe_serialization=True)
+
+    # random LoRA on q_proj/v_proj of both layers (PEFT layout)
+    r, alpha = 4, 8.0
+    adapter = tmp_path / "adapter"
+    adapter.mkdir()
+    tensors = {}
+    torch.manual_seed(1)
+    for i in range(2):
+        for proj in ("q_proj", "v_proj"):
+            mod_w = getattr(hf.model.layers[i].self_attn, proj).weight
+            a = torch.randn(r, mod_w.shape[1]) * 0.1
+            b = torch.randn(mod_w.shape[0], r) * 0.1
+            key = f"base_model.model.model.layers.{i}.self_attn.{proj}"
+            tensors[f"{key}.lora_A.weight"] = a
+            tensors[f"{key}.lora_B.weight"] = b
+            # merge into the torch reference: W += alpha/r * B @ A
+            mod = getattr(hf.model.layers[i].self_attn, proj)
+            with torch.no_grad():
+                mod.weight += (alpha / r) * (b @ a)
+    save_file(tensors, str(adapter / "adapter_model.safetensors"))
+    (adapter / "adapter_config.json").write_text(
+        json.dumps({"r": r, "lora_alpha": alpha, "peft_type": "LORA"})
+    )
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        server = BlockServer(
+            model_uid="m", start=0, end=2, model_dir=base,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=32, page_size=4,
+            adapter_dirs=[str(adapter)],
+        )
+        await server.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            base, RegistryClient("127.0.0.1", reg.port), model_uid="m"
+        )
+        input_ids = np.arange(8)[None, :]
+        async with model.inference_session(16, 1) as sess:
+            out = await sess.step(model.embed(input_ids))
+        logits = model.logits(out)
+        with torch.no_grad():
+            ref = hf(torch.tensor(input_ids)).logits.numpy()
+        np.testing.assert_allclose(logits, ref, atol=2e-3, rtol=2e-3)
+        await server.stop()
+        await reg.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,60 @@
+"""Speculative pruner semantics (port of
+/root/reference/tests/test_speculative_pruner_manager.py intent)."""
+
+import numpy as np
+
+from bloombee_tpu.spec.pruner import SimpleProbabilityPruner
+from bloombee_tpu.spec.tree import DraftTree
+
+
+def _probs(vocab, rows):
+    out = np.full((len(rows), vocab), 1e-6)
+    for i, spec in enumerate(rows):
+        for tok, p in spec.items():
+            out[i, tok] = p
+    return out / out.sum(axis=-1, keepdims=True)
+
+
+def test_prunes_low_probability_children_and_subtrees():
+    #  0(tok 1)   1(tok 2)     roots
+    #  2(tok 3, child of 0)    3(tok 4, child of 1)
+    tree = DraftTree(
+        tokens=np.asarray([1, 2, 3, 4]),
+        parents=np.asarray([-1, -1, 0, 1]),
+    )
+    vocab = 8
+    # root distribution: token 1 likely, token 2 negligible
+    root = _probs(vocab, [{1: 0.9, 2: 0.01}])[0]
+    probs = _probs(
+        vocab,
+        [
+            {3: 0.8},  # node 0's dist -> child 2 strong
+            {4: 0.9},  # node 1's dist -> child 3 strong, but 1 is pruned
+            {},
+            {},
+        ],
+    )
+    kept = SimpleProbabilityPruner(threshold=0.1).keep_indices(
+        tree, probs, root
+    )
+    kept_set = set(kept[kept >= 0].tolist())
+    assert 0 in kept_set and 2 in kept_set  # strong path survives
+    assert 1 not in kept_set  # weak root pruned
+    assert 3 not in kept_set  # descendant of pruned node gone too
+
+
+def test_keep_indices_padding_and_cap():
+    tree = DraftTree(
+        tokens=np.asarray([1, 2, 3]), parents=np.asarray([-1, 0, 1])
+    )
+    vocab = 4
+    root = _probs(vocab, [{1: 1.0}])[0]
+    probs = _probs(vocab, [{2: 1.0}, {3: 1.0}, {}])
+    kept = SimpleProbabilityPruner(threshold=0.5, max_keep=2).keep_indices(
+        tree, probs, root
+    )
+    assert kept.tolist() == [0, 1]  # capped at 2
+    kept = SimpleProbabilityPruner(threshold=0.99).keep_indices(
+        tree, probs, root
+    )
+    assert kept.tolist() == [0, 1, 2]  # single children renormalize to 1.0
