@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the hot ops.
+
+The serving decode path is weight-bandwidth-bound and well served by XLA
+fusion; these kernels target the places XLA's default lowering materializes
+large intermediates — full [B, H, T, S] attention logits in HBM during
+prefill / training. `flash_attention` streams K/V blocks through VMEM with
+online-softmax accumulation instead.
+"""
+
+from bloombee_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,171 @@
+"""Flash attention (Pallas TPU kernel): causal/full, GQA, fp32 accumulation.
+
+Replaces the reference's prefill attention kernel
+(/root/reference/src/bloombee/flexgen_utils/pytorch_backend.py:665
+`mha_llama`) for long sequences: attention logits never hit HBM, and K/V
+stream through VMEM one [block_k, hd] tile at a time (third grid dimension)
+with online-softmax stats (m, l, acc) carried in VMEM scratch across the
+K-tile steps — so VMEM residency is O(block) regardless of sequence length.
+
+Supports S >= T with the extra keys treated as a committed prefix: query i
+(absolute position s - t + i) attends to keys <= its position, matching
+`ops.attention.causal_mask(t, offset=s-t)`. Raises on unsupported layouts;
+callers that need a portable path use `ops.attention.masked_attention`
+(CPU tests run this kernel in interpreter mode).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e30
+
+
+def _kernel(
+    q_ref,  # [block_q, hd]
+    k_ref,  # [block_k, hd] (current K tile)
+    v_ref,  # [block_k, hd]
+    o_ref,  # [block_q, hd]
+    m_scr,  # [block_q, 1] f32 scratch
+    l_scr,  # [block_q, 1] f32 scratch
+    acc_scr,  # [block_q, hd] f32 scratch
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    n_k: int,
+    offset: int,  # s - t: absolute position of query 0
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q_pos = (
+        offset
+        + qi * block_q
+        + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    )
+    # highest absolute query position in this q block
+    q_max = offset + qi * block_q + block_q - 1
+    block_visible = (not causal) or (kj * block_k <= q_max)
+
+    @pl.when(block_visible)
+    def _update():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            mask = k_pos <= q_pos
+            logits = jnp.where(mask, logits, NEG)
+            pmask = mask.astype(jnp.float32)
+        else:
+            pmask = jnp.ones((1, 1), jnp.float32)
+        m = m_scr[...]
+        m_new = jnp.maximum(m, logits.max(axis=1, keepdims=True))
+        p = jnp.exp(logits - m_new) * pmask
+        corr = jnp.exp(m - m_new)
+        l_scr[...] = l_scr[...] * corr + p.sum(axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        o_ref[...] = (
+            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,  # [B, T, H, hd]
+    k: jax.Array,  # [B, S, Hkv, hd], S >= T (extra = committed prefix)
+    v: jax.Array,  # [B, S, Hkv, hd]
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    b, t, h, hd = q.shape
+    s, hkv = k.shape[1], k.shape[2]
+    if h % hkv:
+        raise ValueError(f"H={h} must be a multiple of Hkv={hkv}")
+    if s < t:
+        raise ValueError(f"S={s} must be >= T={t}")
+    n_rep = h // hkv
+    if scale is None:
+        scale = hd**-0.5
+    block_q = min(block_q, t)
+    block_k = min(block_k, s)
+    if t % block_q or s % block_k:
+        raise ValueError(
+            f"seq lens must divide blocks: T={t}%{block_q}, S={s}%{block_k}"
+        )
+    n_k = s // block_k
+
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, hd)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, hd)
+
+    grid = (b * h, t // block_q, n_k)
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel,
+            scale=scale,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            n_k=n_k,
+            offset=s - t,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (None, block_q, hd), lambda bh, qi, kj: (bh, qi, 0)
+            ),
+            pl.BlockSpec(
+                (None, block_k, hd),
+                lambda bh, qi, kj, n_rep=n_rep: (bh // n_rep, kj, 0),
+            ),
+            pl.BlockSpec(
+                (None, block_k, hd),
+                lambda bh, qi, kj, n_rep=n_rep: (bh // n_rep, kj, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, block_q, hd), lambda bh, qi, kj: (bh, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, hd).transpose(0, 2, 1, 3)
