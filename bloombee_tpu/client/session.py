@@ -18,6 +18,7 @@ import uuid
 import numpy as np
 
 from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+from bloombee_tpu.wire.tensor_codec import dtype_for_name
 from bloombee_tpu.swarm.data import RemoteSpanInfo
 from bloombee_tpu.wire.rpc import Connection, RpcError, Stream, connect
 
@@ -154,7 +155,10 @@ class InferenceSession:
             meta_base["depths"] = np.asarray(depths).tolist()
         if accept is not None:
             meta_base["accept"] = [np.asarray(a).tolist() for a in accept]
-        tensors = [hidden.astype(np.float32)]
+        # ship hidden in the first span's advertised wire dtype (bf16 for
+        # bf16-compute servers: half the bytes on the latency-critical hop)
+        wire_dt = dtype_for_name(self._spans[0].span.server_info.wire_dtype)
+        tensors = [hidden.astype(wire_dt)]
         if tree_mask is not None:
             tensors.append(tree_mask.astype(np.uint8))
 

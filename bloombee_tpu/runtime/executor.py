@@ -53,7 +53,7 @@ class SpanExecutor:
         # ship hidden states over the host link at half width when computing
         # in bf16 (transfer latency/bandwidth is the bottleneck; SURVEY.md
         # section 3.3 timing decomposition)
-        self._transfer_dtype = (
+        self.transfer_dtype = np.dtype(
             ml_dtypes.bfloat16 if compute_dtype == jnp.bfloat16 else np.float32
         )
         self.page_size = manager.page_size
@@ -154,7 +154,7 @@ class SpanExecutor:
             self.params,
             arena["k"],
             arena["v"],
-            jnp.asarray(h_pad.astype(self._transfer_dtype)).astype(
+            jnp.asarray(h_pad.astype(self.transfer_dtype)).astype(
                 self.compute_dtype
             ),
             jnp.asarray(plan),
@@ -166,4 +166,6 @@ class SpanExecutor:
             windows=self.windows,
         )
         self.manager.arena = {"k": new_k, "v": new_v}
-        return np.asarray(out[:b, :t]).astype(np.float32)
+        # keep the transfer dtype (bf16 when computing in bf16): this array
+        # goes straight onto the wire (reply or server-to-server push)
+        return np.asarray(out[:b, :t]).astype(self.transfer_dtype)

@@ -37,7 +37,9 @@ from bloombee_tpu.server.compute_queue import (
     ComputeQueue,
 )
 from bloombee_tpu.swarm.data import ServerInfo, ServerState
+from bloombee_tpu.utils import env
 from bloombee_tpu.wire.rpc import Connection, RpcServer, Stream, connect
+from bloombee_tpu.wire.tensor_codec import name_for_dtype
 
 logger = logging.getLogger(__name__)
 
@@ -120,6 +122,7 @@ class BlockServer:
         self.public_host = public_host or host
         self.throughput = throughput
         self.inference_rps: float | None = None
+        self.compute_dtype = compute_dtype
 
         self.manager = CacheManager(
             num_layers=end - start,
@@ -135,6 +138,7 @@ class BlockServer:
             compute_dtype=compute_dtype,
             start_block=start,
         )
+        self.wire_dtype = name_for_dtype(self.executor.transfer_dtype)
         from bloombee_tpu.runtime.training import TrainingExecutor
 
         self.training = TrainingExecutor(
@@ -199,6 +203,7 @@ class BlockServer:
             cache_tokens_left=self.manager.tokens_left,
             start_block=self.start_block,
             end_block=self.end_block,
+            wire_dtype=self.wire_dtype,
         )
 
     async def _announce(self, state: ServerState) -> None:
@@ -300,7 +305,9 @@ class BlockServer:
             await stream.send({"step": meta.get("step"), "ack": True})
             return
 
-        hidden = np.asarray(tensors[0], dtype=np.float32)
+        # keep the sender's dtype (bf16 on the production wire); the executor
+        # casts to compute dtype on device
+        hidden = np.asarray(tensors[0])
         tree_mask = None
         depths = None
         if meta.get("tree"):
@@ -335,7 +342,7 @@ class BlockServer:
                 push_meta["depths"] = meta["depths"]
             if accept is not None:
                 push_meta["accept"] = accept
-            push_tensors = [out.astype(np.float32)]
+            push_tensors = [out]  # executor output is already wire dtype
             if tree_mask is not None:
                 push_tensors.append(tree_mask.astype(np.uint8))
             conn = await self.peers.get(nxt["host"], nxt["port"])
@@ -374,7 +381,13 @@ class BlockServer:
                 session.handle, hidden, commit=commit, tree_mask=tree_mask,
                 layers=session.layers, depths=depths,
             )
-        return out, (time.perf_counter() - t0) * 1000.0
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        if env.log_channel_enabled("timing"):
+            logger.info(
+                "[timing] session=%s tokens=%d compute_ms=%.2f",
+                session.id, hidden.shape[1], dt_ms,
+            )
+        return out, dt_ms
 
     async def _rpc_push(self, meta: dict, tensors) -> None:
         session = self._sessions.get(meta["session_id"])

@@ -30,6 +30,10 @@ class ServerInfo:
     next_pings: dict[str, float] | None = None  # server_id -> rtt seconds
     start_block: int | None = None
     end_block: int | None = None
+    # dtype this server wants hidden states shipped in ("bf16" when it
+    # computes in bf16; "f32" for exact-parity fp32 serving). Halves the
+    # bytes of the latency-critical decode payload vs the round-1 fp32 wire.
+    wire_dtype: str = "f32"
 
     def to_wire(self) -> dict:
         d = dataclasses.asdict(self)

@@ -30,8 +30,24 @@ try:
 except Exception:  # pragma: no cover - zstandard is in the base image
     _zstd = None
 
+from bloombee_tpu.utils import env as _env
+
+# defaults; overridable per process via the env switches declared below
 MIN_COMPRESS_BYTES = 48 * 1024
 MIN_GAIN_BYTES = 2 * 1024
+
+_env.declare(
+    "BBTPU_MIN_COMPRESS_BYTES", int, MIN_COMPRESS_BYTES,
+    "payloads below this ship raw (reference lossless_transport 48 KiB gate)",
+)
+_env.declare(
+    "BBTPU_MIN_COMPRESS_GAIN", int, MIN_GAIN_BYTES,
+    "compression kept only if it saves at least this many bytes",
+)
+_env.declare(
+    "BBTPU_WIRE_COMPRESSION", bool, True,
+    "losslessly compress large wire tensors (zstd byte-split)",
+)
 
 _DTYPES = {
     "f32": np.float32,
@@ -44,6 +60,17 @@ _DTYPES = {
     "f64": np.float64,
 }
 _DTYPE_NAMES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def dtype_for_name(name: str, default=np.float32):
+    """Resolve a wire dtype name ("bf16", "f32", ...) to a numpy dtype."""
+    dt = _DTYPES.get(name)
+    return np.dtype(dt) if dt is not None else np.dtype(default)
+
+
+def name_for_dtype(dtype) -> str:
+    """Wire name of a numpy dtype (the inverse of dtype_for_name)."""
+    return _DTYPE_NAMES[np.dtype(dtype)]
 
 
 @dataclasses.dataclass
@@ -94,7 +121,11 @@ def serialize_tensor(
     codec = "raw"
     byte_split = False
     payload = raw
-    if compression and len(raw) >= MIN_COMPRESS_BYTES:
+    min_bytes = _env.get("BBTPU_MIN_COMPRESS_BYTES")
+    min_gain = _env.get("BBTPU_MIN_COMPRESS_GAIN")
+    if not _env.get("BBTPU_WIRE_COMPRESSION"):
+        compression = False
+    if compression and len(raw) >= min_bytes:
         candidate = raw
         if dtype.itemsize == 2:
             # byte-plane split: [b0 b1 b0 b1 ...] -> [b0 b0 ...][b1 b1 ...]
@@ -102,7 +133,7 @@ def serialize_tensor(
             byte_split = True
         chosen = "zstd" if _zstd is not None else "zlib"
         compressed = _compress(candidate, chosen)
-        if len(compressed) + MIN_GAIN_BYTES <= len(raw):
+        if len(compressed) + min_gain <= len(raw):
             payload = compressed
             codec = chosen
         else:

@@ -120,6 +120,49 @@ def test_logits_parity_full_chain(tiny_model_dir):
     asyncio.run(run())
 
 
+def test_bf16_wire_logits_close(tiny_model_dir):
+    """bf16-compute servers advertise wire_dtype=bf16; hidden states ship
+    bf16 both directions (half the decode payload) and logits stay close to
+    the fp32 HF reference (ADVICE round-1: fp32-on-the-wire fix)."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s1 = _server(model_dir, rc(), 0, 2, compute_dtype=jnp.bfloat16)
+        s2 = _server(model_dir, rc(), 2, 3, compute_dtype=jnp.bfloat16)
+        await s1.start()
+        await s2.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", use_push=False
+        )
+        input_ids = np.arange(10)[None, :] % config.vocab_size
+        async with model.inference_session(16, 1) as sess:
+            assert all(
+                s.span.server_info.wire_dtype == "bf16" for s in sess._spans
+            )
+            hidden = model.embed(input_ids)
+            out = await sess.step(hidden)
+        assert out.dtype == np.float32  # client edge upcasts
+        logits = model.logits(out)
+        with torch.no_grad():
+            ref = hf_model(torch.tensor(input_ids)).logits.numpy()
+        # bf16 has an 8-bit mantissa: loose tolerance, but the argmax chain
+        # through 3 blocks must still agree for most positions
+        np.testing.assert_allclose(logits, ref, atol=0.3, rtol=0.1)
+
+        await s1.stop()
+        await s2.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
 def test_overlapping_spans_suffix_entry(tiny_model_dir):
     """Overlapping spans A=[0,2) and B=[1,3): the router enters B mid-span
     (suffix sub-span) and the server must run only the requested layers
