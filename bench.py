@@ -1,15 +1,20 @@
-"""Headline bench: Llama-3-8B-dimension span decode throughput on one chip.
+"""Headline bench: Llama-3-8B-dimension SERVED span decode on one chip.
 
-Measures the server-side decode path (paged KV arena + scan-over-blocks span
-step) on an 8-layer span with Llama-3-8B dimensions in bfloat16 — the
-per-chip unit of the north-star config (BASELINE.md: 8B served from a v5e-8
-swarm, 32 layers = 4 such spans). Decode steps run as ONE jitted lax.scan
-over per-step plans with the KV arena as carry, so the number reflects
-on-device serving throughput, not host-link latency.
+Two measurements on an 8-layer span with Llama-3-8B dimensions in bfloat16
+(the per-chip unit of the north-star config — BASELINE.md: 8B from a v5e-8
+swarm, 32 layers = 4 such spans):
 
-Prints exactly one JSON line:
-  value = full-model-equivalent decode tokens/sec (batch), i.e.
-          span_steps_per_sec * batch / 4 spans
+1. **Served path (the headline)**: a real registry + BlockServer + client
+   InferenceSession on loopback — every decode step pays wire serialization,
+   the compute queue, one packed h2d, the jitted span step, and the d2h
+   fetch, exactly like the reference's benchmark_inference.py measures
+   (/root/reference/benchmarks/benchmark_inference.py:90-93).
+2. **Fused-scan proxy (logged)**: 64 decode steps as ONE jitted lax.scan —
+   the on-device ceiling with zero host involvement.
+
+Prints exactly one JSON line for the served number:
+  value = full-model-equivalent decode tokens/sec/sequence, i.e.
+          served_span_steps_per_sec / 4 spans
   vs_baseline = value / 35.0  (A100 single-stream Llama-3-8B decode tok/s,
           the reference's north-star comparison point)
 """
@@ -170,24 +175,140 @@ def main():
     equiv_per_seq = steps_per_sec / spans_per_model
     equiv_batch = batch_tok_per_sec / spans_per_model
     log(
-        f"span decode: {steps_per_sec:.1f} steps/s; 8B-equiv per-seq "
+        f"fused-scan proxy: {steps_per_sec:.1f} steps/s; 8B-equiv per-seq "
         f"{equiv_per_seq:.1f} tok/s, batch({B}) {equiv_batch:.0f} tok/s; "
         f"prefill(ttft proxy) {ttft*1000:.0f} ms"
     )
 
-    # value: full-model-equivalent PER-SEQUENCE decode tok/s (while serving
-    # batch 8); baseline 35 tok/s = single-A100 single-stream HF decode on
-    # Llama-3-8B, the north-star comparison (BASELINE.md)
+    served = run_served(spec, params, B, PREFILL, DECODE, spans_per_model)
+    log(
+        f"served: {served['steps_per_sec']:.1f} steps/s; 8B-equiv per-seq "
+        f"{served['equiv_per_seq']:.1f} tok/s, batch({B}) "
+        f"{served['equiv_per_seq'] * B:.0f} tok/s; ttft {served['ttft_ms']:.0f}"
+        f" ms; effective({served['n_sessions']} sessions x batch {B}) "
+        f"{served['effective_equiv_tok_per_s']:.0f} 8B-equiv tok/s; "
+        f"timing {served['timing']}"
+    )
+
+    # value: SERVED full-model-equivalent PER-SEQUENCE decode tok/s (batch 8
+    # session through registry + BlockServer + wire); baseline 35 tok/s =
+    # single-A100 single-stream HF decode on Llama-3-8B (BASELINE.md).
+    # Extra keys: the on-device fused-scan ceiling and the multi-session
+    # effective throughput (per-seq is floored by the host<->device round
+    # trip, ~70-100 ms on this tunnel-attached chip; concurrent sessions
+    # overlap those round trips).
     print(
         json.dumps(
             {
-                "metric": "llama3_8b_equiv_decode_tok_per_s_per_seq",
-                "value": round(equiv_per_seq, 2),
+                "metric": "llama3_8b_equiv_served_decode_tok_per_s_per_seq",
+                "value": round(served["equiv_per_seq"], 2),
                 "unit": "tokens/sec/seq",
-                "vs_baseline": round(equiv_per_seq / 35.0, 3),
+                "vs_baseline": round(served["equiv_per_seq"] / 35.0, 3),
+                "effective_equiv_tok_per_s": round(
+                    served["effective_equiv_tok_per_s"], 1
+                ),
+                "fused_scan_proxy_tok_per_s_per_seq": round(equiv_per_seq, 2),
+                "ttft_ms": round(served["ttft_ms"], 1),
             }
         )
     )
+
+
+def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
+    """Registry + BlockServer + client session on loopback: the E2E serving
+    path the reference's benchmark_inference.py measures."""
+    import asyncio
+
+    from bloombee_tpu.client.session import InferenceSession
+    from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    span_layers = spec.num_hidden_layers
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        # pages sized for the multi-session phase: N_SESS sessions x B seqs
+        # x (PREFILL + DECODE + settle/compile steps) tokens
+        N_SESS = 6
+        SETTLE = 5  # 1 compile + 4 settle decode steps before the timed loop
+        server = BlockServer(
+            model_uid="bench", start=0, end=span_layers, params=params,
+            spec=spec, registry=rc(), num_pages=768, page_size=16,
+        )
+        await server.start()
+        manager = RemoteSequenceManager(rc(), "bench", span_layers)
+        rng = np.random.default_rng(0)
+        hidden = rng.standard_normal(
+            (B, PREFILL, spec.hidden_size)
+        ).astype(np.float32) * 0.02
+        step_h = hidden[:, -1:, :]
+
+        # ---- phase A: single-session per-seq latency
+        sess = InferenceSession(
+            manager, max_length=PREFILL + DECODE + SETTLE, batch_size=B
+        )
+        async with sess:
+            t0 = time.time()
+            await sess.step(hidden)  # prefill (compiles the T=128 bucket)
+            log(f"served prefill compile+run: {time.time()-t0:.1f}s")
+            t0 = time.time()
+            await sess.step(step_h)  # compiles the T=1 bucket
+            log(f"served first decode compile+run: {time.time()-t0:.1f}s")
+            for _ in range(4):  # settle
+                await sess.step(step_h)
+            n_timed = DECODE
+            t0 = time.time()
+            for _ in range(n_timed):
+                await sess.step(step_h)
+            elapsed = time.time() - t0
+        timing = sess.timing_summary()  # decode-step rows
+        steps_per_sec = n_timed / elapsed
+
+        # ---- phase B: N_SESS concurrent sessions — round trips overlap,
+        # aggregate throughput approaches the device ceiling (the role of
+        # the reference's --n-processes clients, benchmark_inference.py)
+        async def one_session():
+            s = InferenceSession(
+                manager, max_length=PREFILL + DECODE, batch_size=B
+            )
+            async with s:
+                await s.step(hidden)
+                for _ in range(DECODE):
+                    await s.step(step_h)
+
+        t0 = time.time()
+        await asyncio.gather(*(one_session() for _ in range(N_SESS)))
+        wall = time.time() - t0
+        # count only decode steps (prefills overlap the first decodes)
+        eff_steps_per_sec = N_SESS * DECODE / wall
+        eff_equiv_tok = eff_steps_per_sec * B / spans_per_model
+
+        # TTFT on a fresh session with warm buckets
+        sess2 = InferenceSession(
+            manager, max_length=PREFILL + DECODE, batch_size=B
+        )
+        async with sess2:
+            t0 = time.time()
+            await sess2.step(hidden)
+            ttft = time.time() - t0
+        await server.stop()
+        await reg.stop()
+        return {
+            "steps_per_sec": steps_per_sec,
+            "equiv_per_seq": steps_per_sec / spans_per_model,
+            "ttft_ms": ttft * 1000.0,
+            "timing": timing,
+            "n_sessions": N_SESS,
+            "effective_equiv_tok_per_s": eff_equiv_tok,
+        }
+
+    return asyncio.run(run())
 
 
 if __name__ == "__main__":

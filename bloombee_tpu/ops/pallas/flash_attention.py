@@ -7,10 +7,15 @@ stream through VMEM one [block_k, hd] tile at a time (third grid dimension)
 with online-softmax stats (m, l, acc) carried in VMEM scratch across the
 K-tile steps — so VMEM residency is O(block) regardless of sequence length.
 
-Supports S >= T with the extra keys treated as a committed prefix: query i
-(absolute position s - t + i) attends to keys <= its position, matching
-`ops.attention.causal_mask(t, offset=s-t)`. Raises on unsupported layouts;
-callers that need a portable path use `ops.attention.masked_attention`
+The query positions are `offset + i` for query i; keys occupy absolute
+positions 0..S-1. `offset` is a *traced* scalar (scalar-prefetch input), so
+chunked prefill at varying start positions reuses one compiled kernel. With
+causal=True, keys beyond `offset + T - 1` are masked — which also masks the
+garbage tail of a gathered page run (the serving path gathers whole pages, so
+S is the page-aligned bucket, not the exact context length).
+
+Callers that need tree masks / ALiBi / sliding windows / soft-capping use
+`ops.attention.masked_attention`; the serving executor picks per step
 (CPU tests run this kernel in interpreter mode).
 """
 
@@ -28,6 +33,7 @@ NEG = -1e30
 
 
 def _kernel(
+    offset_ref,  # [1] i32 scalar prefetch: absolute position of query 0
     q_ref,  # [block_q, hd]
     k_ref,  # [block_k, hd] (current K tile)
     v_ref,  # [block_k, hd]
@@ -41,7 +47,6 @@ def _kernel(
     block_q: int,
     block_k: int,
     n_k: int,
-    offset: int,  # s - t: absolute position of query 0
 ):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -52,6 +57,7 @@ def _kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
+    offset = offset_ref[0]
     q_pos = (
         offset
         + qi * block_q
@@ -59,7 +65,9 @@ def _kernel(
     )
     # highest absolute query position in this q block
     q_max = offset + qi * block_q + block_q - 1
-    block_visible = (not causal) or (kj * block_k <= q_max)
+    block_visible = (
+        jnp.bool_(True) if not causal else (kj * block_k <= q_max)
+    )
 
     @pl.when(block_visible)
     def _update():
@@ -110,6 +118,7 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    offset=None,  # traced i32 scalar; None => S - T (queries at the end)
 ) -> jax.Array:
     b, t, h, hd = q.shape
     s, hkv = k.shape[1], k.shape[2]
@@ -127,12 +136,40 @@ def flash_attention(
             f"seq lens must divide blocks: T={t}%{block_q}, S={s}%{block_k}"
         )
     n_k = s // block_k
+    if offset is None:
+        offset = s - t
+    offset_arr = jnp.asarray(offset, jnp.int32).reshape(1)
 
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
     kf = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, hd)
     vf = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, hd)
 
     grid = (b * h, t // block_q, n_k)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (None, block_q, hd), lambda bh, qi, kj, off: (bh, qi, 0)
+            ),
+            pl.BlockSpec(
+                (None, block_k, hd),
+                lambda bh, qi, kj, off, n_rep=n_rep: (bh // n_rep, kj, 0),
+            ),
+            pl.BlockSpec(
+                (None, block_k, hd),
+                lambda bh, qi, kj, off, n_rep=n_rep: (bh // n_rep, kj, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, block_q, hd), lambda bh, qi, kj, off: (bh, qi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, hd), jnp.float32),
+        ],
+    )
     out = pl.pallas_call(
         functools.partial(
             _kernel,
@@ -141,31 +178,9 @@ def flash_attention(
             block_q=block_q,
             block_k=block_k,
             n_k=n_k,
-            offset=s - t,
         ),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(
-                (None, block_q, hd), lambda bh, qi, kj: (bh, qi, 0)
-            ),
-            pl.BlockSpec(
-                (None, block_k, hd),
-                lambda bh, qi, kj, n_rep=n_rep: (bh // n_rep, kj, 0),
-            ),
-            pl.BlockSpec(
-                (None, block_k, hd),
-                lambda bh, qi, kj, n_rep=n_rep: (bh // n_rep, kj, 0),
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (None, block_q, hd), lambda bh, qi, kj: (bh, qi, 0)
-        ),
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, t, hd), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, hd), jnp.float32),
-        ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(offset_arr, qf, kf, vf)
     return out.reshape(b, h, t, hd).transpose(0, 2, 1, 3)

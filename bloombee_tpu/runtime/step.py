@@ -65,6 +65,63 @@ def pack_plan(slots, page_table, q_positions, total_lens, layer_active):
     )
 
 
+def pack_step_payload(h_pad, plan):
+    """Host side: hidden + plan bitcast into ONE vector, so a serving step
+    costs a single h2d transfer (transfer count, not size, dominates on
+    DCN/tunnel-attached hosts — each transfer is ~4 ms here regardless of
+    payload). The device side splits and bitcasts back (see
+    span_step_packed_impl); verified little-endian-consistent between numpy
+    views and XLA bitcast_convert_type on both CPU and TPU."""
+    import numpy as np
+
+    lane = np.uint16 if h_pad.dtype.itemsize == 2 else np.uint32
+    return np.concatenate([h_pad.view(lane).ravel(), plan.view(lane).ravel()])
+
+
+def span_step_packed_impl(
+    stacked_params: dict,
+    arena_k: jax.Array,
+    arena_v: jax.Array,
+    payload: jax.Array,  # uint16 (bf16 compute) or uint32 (f32 compute)
+    tree_mask: jax.Array | None = None,
+    *,
+    spec: ModelSpec,
+    b: int,
+    t: int,
+    page_size: int,
+    max_pages: int,
+    use_tree_mask: bool = False,
+    windows: tuple | None = None,
+    use_flash: bool = False,
+):
+    """span_step over a pack_step_payload buffer (one h2d per step)."""
+    n_h = b * t * spec.hidden_size
+    if payload.dtype == jnp.uint16:
+        hidden = lax.bitcast_convert_type(payload[:n_h], jnp.bfloat16)
+        plan = lax.bitcast_convert_type(
+            payload[n_h:].reshape(-1, 2), jnp.int32
+        )
+    else:
+        hidden = lax.bitcast_convert_type(payload[:n_h], jnp.float32)
+        plan = lax.bitcast_convert_type(payload[n_h:], jnp.int32)
+    hidden = hidden.reshape(b, t, spec.hidden_size)
+    return span_step_impl(
+        stacked_params, arena_k, arena_v, hidden, plan, tree_mask,
+        spec=spec, page_size=page_size, max_pages=max_pages,
+        use_tree_mask=use_tree_mask, windows=windows, use_flash=use_flash,
+    )
+
+
+span_step_packed = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "spec", "b", "t", "page_size", "max_pages", "use_tree_mask",
+        "windows", "use_flash",
+    ),
+    donate_argnames=("arena_k", "arena_v"),
+)(span_step_packed_impl)
+
+
 def span_step_impl(
     stacked_params: dict,  # pytree, leading dim L on every leaf
     arena_k: jax.Array,  # [L, S_tot, Hkv, hd] (donated)
@@ -78,6 +135,7 @@ def span_step_impl(
     max_pages: int,
     use_tree_mask: bool = False,
     windows: tuple | None = None,
+    use_flash: bool = False,
 ):
     """Run all local blocks over one step; returns (hidden, arena_k, arena_v).
 
@@ -105,6 +163,7 @@ def span_step_impl(
             return layer_body(
                 spec, page_size, h, params_l, k_l, v_l, cos, sin, slots,
                 page_table, q_positions, total_lens, tm, window_l,
+                use_flash=use_flash,
             )
 
         def skip(h, k_l, v_l):
@@ -121,6 +180,9 @@ def span_step_impl(
 
 span_step = functools.partial(
     jax.jit,
-    static_argnames=("spec", "page_size", "max_pages", "use_tree_mask", "windows"),
+    static_argnames=(
+        "spec", "page_size", "max_pages", "use_tree_mask", "windows",
+        "use_flash",
+    ),
     donate_argnames=("arena_k", "arena_v"),
 )(span_step_impl)

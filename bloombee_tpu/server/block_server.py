@@ -316,7 +316,13 @@ class BlockServer:
                 depths = np.asarray(meta["depths"], dtype=np.int32)
         commit = bool(meta.get("commit", True))
 
-        out, t_compute_ms = await self.compute.submit(
+        # Two phases: dispatch runs on the serialized compute queue (device
+        # work enqueues in order, ~1 ms), but the d2h fetch happens HERE, off
+        # the queue, so concurrent sessions overlap their device round trips
+        # (the round trip dominates per-step latency on tunnel/DCN hosts —
+        # the reference overlaps the same way with per-handler processes and
+        # CUDA streams, task_pool.py:127-192).
+        out_dev, t_dispatch_ms = await self.compute.submit(
             PRIORITY_INFERENCE,
             self._compute_step,
             session,
@@ -325,6 +331,17 @@ class BlockServer:
             tree_mask,
             depths,
         )
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out = await asyncio.to_thread(self.executor.fetch, out_dev)
+        t_fetch_ms = (_time.perf_counter() - t0) * 1000.0
+        t_compute_ms = t_dispatch_ms + t_fetch_ms
+        timing_meta = {
+            "t_compute_ms": t_compute_ms,
+            "t_dispatch_ms": t_dispatch_ms,
+            "t_fetch_ms": t_fetch_ms,
+        }
 
         route = meta.get("route") or []
         reply = meta.get("reply", "tensor")
@@ -349,42 +366,43 @@ class BlockServer:
             await conn.push("rpc_push", push_meta, push_tensors)
             # ack our own client stream so it can detect this hop succeeded
             await stream.send(
-                {"step": meta.get("step"), "ack": True,
-                 "t_compute_ms": t_compute_ms}
+                {"step": meta.get("step"), "ack": True, **timing_meta}
             )
         elif reply == "ack":
             await stream.send(
-                {"step": meta.get("step"), "ack": True,
-                 "t_compute_ms": t_compute_ms}
+                {"step": meta.get("step"), "ack": True, **timing_meta}
             )
         else:
             await stream.send(
-                {"step": meta.get("step"), "t_compute_ms": t_compute_ms},
+                {"step": meta.get("step"), **timing_meta},
                 [out],
             )
 
     def _compute_step(
         self, session: _Session, hidden, commit, tree_mask, depths=None
     ):
-        """Runs on the compute thread; times pure compute (not queue wait) —
-        the unit of the reference's [TIMING_TABLE] decomposition
-        (handler.py:1276-1605)."""
+        """Runs on the compute thread: plan packing + async device dispatch
+        only (the d2h fetch happens off-queue in _run_step). The dispatch
+        time is the serialized cost per step — the unit that bounds server
+        throughput (reference [TIMING_TABLE] decomposition,
+        handler.py:1276-1605)."""
         import time
 
         t0 = time.perf_counter()
         if hidden.shape[1] > 1 and tree_mask is None:
             out = self.executor.prefill(
-                session.handle, hidden, commit=commit, layers=session.layers
+                session.handle, hidden, commit=commit, layers=session.layers,
+                fetch=False,
             )
         else:
             out = self.executor.decode(
                 session.handle, hidden, commit=commit, tree_mask=tree_mask,
-                layers=session.layers, depths=depths,
+                layers=session.layers, depths=depths, fetch=False,
             )
         dt_ms = (time.perf_counter() - t0) * 1000.0
         if env.log_channel_enabled("timing"):
             logger.info(
-                "[timing] session=%s tokens=%d compute_ms=%.2f",
+                "[timing] session=%s tokens=%d dispatch_ms=%.2f",
                 session.id, hidden.shape[1], dt_ms,
             )
         return out, dt_ms

@@ -49,6 +49,75 @@ def test_flash_prefix_offset_matches_dense():
     )
 
 
+def test_flash_explicit_offset_masks_padded_tail():
+    """offset=0 with S > T (fresh prefill over a page-padded context): keys
+    beyond the causal horizon — including the garbage tail — are masked."""
+    b, t, s, h, hkv, hd = 1, 64, 128, 4, 2, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, t, h, hd), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, hd), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, hd), jnp.float32)
+    # dense reference sees only the first t keys (the real ones)
+    ref = masked_attention(
+        q, k[:, :t], v[:, :t], causal_mask(t)[None]
+    )
+    # poison the tail: if the kernel ever attends there, outputs explode
+    k = k.at[:, t:].set(100.0)
+    v = v.at[:, t:].set(100.0)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=32, block_k=32, interpret=True,
+        offset=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_span_prefill_flash_matches_dense():
+    """The serving span step with the flash path on vs off (executor
+    heuristic end-to-end): identical prefill outputs."""
+    import ml_dtypes
+
+    from bloombee_tpu.kv.cache_manager import CacheManager
+    from bloombee_tpu.models.llama.block import init_block_params
+    from bloombee_tpu.models.spec import ModelSpec
+    from bloombee_tpu.runtime.executor import SpanExecutor
+    from bloombee_tpu.utils.tree import stack_params
+
+    spec = ModelSpec(
+        family="llama", hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        num_hidden_layers=2, vocab_size=64,
+    )
+    params = stack_params(
+        [init_block_params(jax.random.PRNGKey(i), spec, dtype=jnp.float32)
+         for i in range(2)]
+    )
+    hidden = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(7), (2, 128, 64), jnp.float32)
+    )
+
+    import asyncio
+    import os
+
+    async def run_one(flag):
+        os.environ["BBTPU_FLASH_ATTENTION"] = flag
+        try:
+            manager = CacheManager(
+                num_layers=2, num_pages=64, page_size=16,
+                n_kv_heads=2, head_dim=16, dtype=jnp.float32,
+            )
+            ex = SpanExecutor(params, spec, manager,
+                              compute_dtype=jnp.float32)
+            async with manager.allocate(2, 256) as handle:
+                return ex.prefill(handle, hidden)
+        finally:
+            del os.environ["BBTPU_FLASH_ATTENTION"]
+
+    out_flash = asyncio.run(run_one("1"))
+    out_dense = asyncio.run(run_one("0"))
+    np.testing.assert_allclose(out_flash, out_dense, atol=2e-5, rtol=2e-5)
+
+
 def test_flash_rejects_bad_shapes():
     q = jnp.zeros((1, 100, 2, 16))
     k = v = jnp.zeros((1, 100, 2, 16))
