@@ -262,6 +262,7 @@ def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
             log(f"served first decode compile+run: {time.time()-t0:.1f}s")
             for _ in range(4):  # settle
                 await sess.step(step_h)
+            sess.timings.clear()  # summarize only steady-state steps
             n_timed = DECODE
             t0 = time.time()
             for _ in range(n_timed):

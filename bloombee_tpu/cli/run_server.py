@@ -37,6 +37,9 @@ def main(argv=None):
     parser.add_argument("--adapter-dirs", nargs="*", default=None,
                         help="LoRA adapter directories to merge into blocks")
     parser.add_argument("--announce-period", type=float, default=5.0)
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel degree over local chips "
+                        "(reference --tensor_parallel_devices)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
     logging.basicConfig(level=args.log_level)
@@ -81,6 +84,7 @@ def main(argv=None):
             compute_dtype=dtype, max_chunk_tokens=args.max_chunk_tokens,
             announce_period=args.announce_period,
             adapter_dirs=args.adapter_dirs,
+            tp=args.tp,
         )
         await server.start()
         from bloombee_tpu.server.throughput import measure_and_announce

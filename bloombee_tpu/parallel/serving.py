@@ -1,0 +1,108 @@
+"""Tensor-parallel SERVING: the paged span step partitioned over a tp mesh.
+
+The reference serves real decode under tensor parallelism with hand-rolled
+per-device CUDA streams and stream all-reduces
+(/root/reference/src/bloombee/server/flexgen_tensor_parallel.py:540-828:
+row/col weight slices, `_reduce_partials`, per-shard KV merge). The TPU
+idiom is the opposite of hand-scheduling: annotate the *placement* of the
+weights and the KV arena over the mesh and let GSPMD partition the very same
+`span_step_packed` computation, inserting the Megatron collectives (psum
+after o_proj and down_proj) over ICI automatically.
+
+Sharding layout (serving mesh has one axis, "tp"):
+- q/k/v projections: output dim sharded -> each device computes its local
+  heads. Attention is embarrassingly parallel over heads, so the paged
+  gather/scatter and masks replicate per shard.
+- o_proj / down_proj: input dim sharded -> local partial matmul, XLA psums.
+- KV arena: the kv-head dim sharded -> each device holds its heads' pages
+  (the per-shard KV merge of the reference's `_merge_cache_parts` never
+  needs to happen).
+- Mixtral experts: the expert dim shards over tp = true expert parallelism
+  (the reference runs all experts densely on every device).
+
+Requires num_attention_heads % tp == 0 and num_key_value_heads % tp == 0
+(KV-head replication for tp > Hkv is not implemented).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bloombee_tpu.models.spec import ModelSpec
+
+# specs for stacked span params [L, ...] (L unsharded: one server owns the
+# whole span; cf. parallel/spmd.py PARAM_SPECS which also shards pp)
+SERVING_PARAM_SPECS = {
+    "input_layernorm": P(None, None),
+    "input_layernorm_bias": P(None, None),
+    "post_attention_layernorm": P(None, None),
+    "post_attention_layernorm_bias": P(None, None),
+    "pre_feedforward_layernorm": P(None, None),
+    "post_feedforward_layernorm": P(None, None),
+    "q_proj": P(None, None, "tp"),
+    "k_proj": P(None, None, "tp"),
+    "v_proj": P(None, None, "tp"),
+    "o_proj": P(None, "tp", None),
+    "q_bias": P(None, "tp"),
+    "k_bias": P(None, "tp"),
+    "v_bias": P(None, "tp"),
+    "o_bias": P(None, None),
+    "gate_proj": P(None, None, "tp"),
+    "up_proj": P(None, None, "tp"),
+    "down_proj": P(None, "tp", None),
+    "gate_bias": P(None, "tp"),
+    "up_bias": P(None, "tp"),
+    "down_bias": P(None, None),
+    "q_norm": P(None, None),
+    "k_norm": P(None, None),
+    "router": P(None, None, None),
+    "experts_gate": P(None, "tp", None, None),
+    "experts_up": P(None, "tp", None, None),
+    "experts_down": P(None, "tp", None, None),
+}
+
+# KV arena [L, S_tot, Hkv, hd]: heads shard over tp
+ARENA_SPEC = P(None, None, "tp", None)
+
+
+def make_serving_mesh(tp: int, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if tp > len(devices):
+        raise ValueError(f"tp={tp} needs {tp} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:tp]), ("tp",))
+
+
+def check_tp_divides(spec: ModelSpec, tp: int) -> None:
+    if spec.num_attention_heads % tp or spec.num_key_value_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_attention_heads="
+            f"{spec.num_attention_heads} and num_key_value_heads="
+            f"{spec.num_key_value_heads}"
+        )
+    if spec.num_experts and spec.num_experts % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_experts={spec.num_experts}"
+        )
+
+
+def place_span_params(params: dict, mesh: Mesh) -> dict:
+    """Commit stacked span params to the serving mesh (tp-sharded)."""
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, SERVING_PARAM_SPECS[k]))
+        for k, v in params.items()
+    }
+
+
+def place_arena(arena: dict, mesh: Mesh) -> dict:
+    """Commit the KV arena to the serving mesh (kv heads sharded)."""
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, ARENA_SPEC))
+        for k, v in arena.items()
+    }
+
+
+def replicated(x, mesh: Mesh):
+    """Commit a host array replicated over the mesh (step payloads/masks)."""
+    return jax.device_put(x, NamedSharding(mesh, P()))

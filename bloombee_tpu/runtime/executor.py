@@ -49,7 +49,15 @@ class SpanExecutor:
         max_chunk_tokens: int = 512,
         compute_dtype=jnp.bfloat16,
         start_block: int = 0,
+        mesh=None,  # jax.sharding.Mesh with a "tp" axis: TP-sharded serving
     ):
+        self.mesh = mesh
+        if mesh is not None:
+            from bloombee_tpu.parallel import serving as tp_serving
+
+            tp_serving.check_tp_divides(spec, mesh.devices.size)
+            stacked_params = tp_serving.place_span_params(stacked_params, mesh)
+            manager.arena = tp_serving.place_arena(manager.arena, mesh)
         self.params = stacked_params
         self.spec = spec
         self.manager = manager
@@ -186,7 +194,8 @@ class SpanExecutor:
         # exactly "uniform start, uniform length, no extra masking"
         s_ctx = pb * self.page_size
         use_flash = bool(
-            tree_mask is None
+            self.mesh is None  # Pallas kernels don't GSPMD-partition
+            and tree_mask is None
             and tb >= 128
             and tb % 128 == 0
             and s_ctx % 128 == 0
@@ -202,12 +211,20 @@ class SpanExecutor:
 
         arena = self.manager.arena
         payload = pack_step_payload(h_pad, plan)
+        payload_dev = jnp.asarray(payload)
+        tm_dev = jnp.asarray(tm_pad) if tm_pad is not None else None
+        if self.mesh is not None:
+            from bloombee_tpu.parallel import serving as tp_serving
+
+            payload_dev = tp_serving.replicated(payload, self.mesh)
+            if tm_dev is not None:
+                tm_dev = tp_serving.replicated(tm_pad, self.mesh)
         out, new_k, new_v = span_step_packed(
             self.params,
             arena["k"],
             arena["v"],
-            jnp.asarray(payload),
-            jnp.asarray(tm_pad) if tm_pad is not None else None,
+            payload_dev,
+            tm_dev,
             spec=spec,
             b=bb,
             t=tb,

@@ -73,6 +73,14 @@ def _mlp(x, params, spec):
     return _proj(h, params, "down_proj")
 
 
+def attn_scale(spec: ModelSpec) -> float:
+    return (
+        spec.attention_multiplier
+        if spec.attention_multiplier is not None
+        else spec.head_dim**-0.5
+    )
+
+
 def attend_paged(
     spec: ModelSpec,
     q: jax.Array,  # [B, T, H, hd]
@@ -105,11 +113,7 @@ def attend_paged(
     n_rep = q.shape[2] // k_ctx.shape[2]
     k_r = repeat_kv(k_ctx, n_rep)
     v_r = repeat_kv(v_ctx, n_rep)
-    scale = (
-        spec.attention_multiplier
-        if spec.attention_multiplier is not None
-        else spec.head_dim**-0.5
-    )
+    scale = attn_scale(spec)
     logits = jnp.einsum("bthd,bshd->bhts", q, k_r).astype(jnp.float32) * scale
     if spec.attn_logit_softcap:
         logits = (
@@ -171,13 +175,8 @@ def layer_body(
         # uniform start offset also masks the page-padded tail of k_ctx.
         from bloombee_tpu.ops.pallas.flash_attention import flash_attention
 
-        scale = (
-            spec.attention_multiplier
-            if spec.attention_multiplier is not None
-            else spec.head_dim**-0.5
-        )
         attn = flash_attention(
-            q, k_ctx, v_ctx, causal=True, scale=scale,
+            q, k_ctx, v_ctx, causal=True, scale=attn_scale(spec),
             offset=q_positions[0, 0],
             interpret=jax.default_backend() == "cpu",
         )

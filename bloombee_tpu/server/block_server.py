@@ -102,6 +102,7 @@ class BlockServer:
         alloc_timeout: float = 60.0,
         throughput: float = 1.0,
         adapter_dirs: list[str] | None = None,
+        tp: int = 1,
     ):
         if params is None:
             from bloombee_tpu.models.checkpoint import load_span_params
@@ -132,11 +133,21 @@ class BlockServer:
             head_dim=spec.head_dim,
             dtype=compute_dtype,
         )
+        mesh = None
+        if tp > 1:
+            # intra-server tensor parallelism over the local chips (ICI):
+            # GSPMD-partitioned span step, KV heads + weight shards per chip
+            # (reference flexgen_tensor_parallel.py:540-828 role)
+            from bloombee_tpu.parallel.serving import make_serving_mesh
+
+            mesh = make_serving_mesh(tp)
+        self.tp = tp
         self.executor = SpanExecutor(
             params, spec, self.manager,
             max_chunk_tokens=max_chunk_tokens,
             compute_dtype=compute_dtype,
             start_block=start,
+            mesh=mesh,
         )
         self.wire_dtype = name_for_dtype(self.executor.transfer_dtype)
         from bloombee_tpu.runtime.training import TrainingExecutor

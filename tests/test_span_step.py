@@ -164,3 +164,83 @@ def test_speculative_decode_rollback(setup):
             )
 
     asyncio.run(run())
+
+
+def test_packed_payload_bitcast_roundtrip_bf16_and_f32():
+    """pack_step_payload's single-buffer bitcast must round-trip exactly on
+    the device for BOTH lane widths: uint16 (bf16 serving, the production
+    wire) and uint32 (fp32 parity serving)."""
+    import functools
+
+    import jax
+    import ml_dtypes
+    from jax import lax
+
+    from bloombee_tpu.runtime.step import pack_step_payload
+
+    rng = np.random.default_rng(0)
+    plan = rng.integers(-(2**31), 2**31 - 1, size=(57,), dtype=np.int32)
+
+    for np_dt, jnp_dt in ((ml_dtypes.bfloat16, jnp.bfloat16),
+                          (np.float32, jnp.float32)):
+        h = rng.standard_normal((2, 3, 8)).astype(np_dt)
+        payload = pack_step_payload(h, plan)
+
+        @functools.partial(jax.jit, static_argnames=("n_h",))
+        def unpack(p, n_h):
+            if p.dtype == jnp.uint16:
+                hid = lax.bitcast_convert_type(p[:n_h], jnp.bfloat16)
+                pl_ = lax.bitcast_convert_type(
+                    p[n_h:].reshape(-1, 2), jnp.int32
+                )
+            else:
+                hid = lax.bitcast_convert_type(p[:n_h], jnp.float32)
+                pl_ = lax.bitcast_convert_type(p[n_h:], jnp.int32)
+            return hid, pl_
+
+        hid, pl_ = unpack(jnp.asarray(payload), n_h=h.size)
+        assert np.asarray(hid).view(np.uint8).tobytes() == h.tobytes()
+        np.testing.assert_array_equal(np.asarray(pl_), plan)
+
+
+def test_span_decode_bf16_compute_runs_packed_path():
+    """The bf16 (uint16-lane) packed path through the real executor: prefill
+    + decode produce finite bf16 outputs."""
+    import ml_dtypes
+
+    from bloombee_tpu.models.llama.block import init_block_params
+    from bloombee_tpu.models.spec import ModelSpec
+
+    spec = ModelSpec(
+        family="llama", hidden_size=32, intermediate_size=64,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        num_hidden_layers=2, vocab_size=64,
+    )
+    import jax
+
+    params = stack_params(
+        [init_block_params(jax.random.PRNGKey(i), spec, dtype=jnp.bfloat16)
+         for i in range(2)]
+    )
+
+    async def run():
+        manager = CacheManager(
+            num_layers=2, num_pages=16, page_size=4, n_kv_heads=2,
+            head_dim=8, dtype=jnp.bfloat16,
+        )
+        ex = SpanExecutor(params, spec, manager,
+                          compute_dtype=jnp.bfloat16)
+        rng = np.random.default_rng(0)
+        async with manager.allocate(2, 12) as handle:
+            out = ex.prefill(
+                handle, rng.standard_normal((2, 6, 32)).astype(np.float32)
+            )
+            assert out.dtype == ml_dtypes.bfloat16
+            assert np.isfinite(out.astype(np.float32)).all()
+            out = ex.decode(
+                handle, rng.standard_normal((2, 1, 32)).astype(np.float32)
+            )
+            assert out.dtype == ml_dtypes.bfloat16
+            assert np.isfinite(out.astype(np.float32)).all()
+
+    asyncio.run(run())

@@ -1,0 +1,164 @@
+"""TP-sharded serving: GSPMD-partitioned span step parity vs unsharded.
+
+Matches the role of the reference's TP decode serving
+(/root/reference/src/bloombee/server/flexgen_tensor_parallel.py:540-828),
+tested the reference's way (tests/test_flexgen_tensor_parallel.py shard math
+on CPU): tp=2 serving output must equal tp=1 to tight tolerance, through the
+real paged executor (prefill + stepwise decode), for dense Llama and for
+Mixtral with expert parallelism. Runs on the virtual 8-device CPU mesh from
+conftest.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from bloombee_tpu.kv.cache_manager import CacheManager
+from bloombee_tpu.models.llama.block import init_block_params
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.parallel.serving import make_serving_mesh
+from bloombee_tpu.runtime.executor import SpanExecutor
+from bloombee_tpu.utils.tree import stack_params
+
+LLAMA_SPEC = ModelSpec(
+    family="llama", hidden_size=64, intermediate_size=128,
+    num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+    num_hidden_layers=3, vocab_size=64,
+)
+
+MOE_SPEC = ModelSpec(
+    family="mixtral", hidden_size=32, intermediate_size=64,
+    num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+    num_hidden_layers=2, vocab_size=64, num_experts=4,
+    num_experts_per_tok=2,
+)
+
+
+def _params_for(spec):
+    layers = []
+    for i in range(spec.num_hidden_layers):
+        p = init_block_params(jr.PRNGKey(i), spec)
+        if spec.num_experts:
+            d, inter, e = (
+                spec.hidden_size, spec.intermediate_size, spec.num_experts
+            )
+            del p["gate_proj"], p["up_proj"], p["down_proj"]
+            p["router"] = jr.normal(jr.PRNGKey(10 + i), (d, e)) * 0.1
+            p["experts_gate"] = jr.normal(jr.PRNGKey(20 + i), (e, d, inter)) * 0.1
+            p["experts_up"] = jr.normal(jr.PRNGKey(30 + i), (e, d, inter)) * 0.1
+            p["experts_down"] = jr.normal(jr.PRNGKey(40 + i), (e, inter, d)) * 0.1
+        layers.append(p)
+    return stack_params(layers)
+
+
+def _serve_steps(spec, params, mesh):
+    """Prefill 6 tokens then decode 3, through the paged executor."""
+
+    async def run():
+        manager = CacheManager(
+            num_layers=spec.num_hidden_layers, num_pages=32, page_size=4,
+            n_kv_heads=spec.num_key_value_heads, head_dim=spec.head_dim,
+            dtype=jnp.float32,
+        )
+        ex = SpanExecutor(
+            params, spec, manager, compute_dtype=jnp.float32, mesh=mesh
+        )
+        rng = np.random.default_rng(0)
+        outs = []
+        async with manager.allocate(2, 16) as handle:
+            hidden = rng.standard_normal((2, 6, spec.hidden_size)).astype(
+                np.float32
+            )
+            outs.append(ex.prefill(handle, hidden))
+            for s in range(3):
+                step = rng.standard_normal((2, 1, spec.hidden_size)).astype(
+                    np.float32
+                )
+                outs.append(ex.decode(handle, step))
+        return outs
+
+    return asyncio.run(run())
+
+
+@pytest.mark.parametrize("spec", [LLAMA_SPEC, MOE_SPEC],
+                         ids=["llama", "mixtral_ep"])
+def test_tp2_matches_tp1(spec):
+    params = _params_for(spec)
+    ref = _serve_steps(spec, params, mesh=None)
+    tp2 = _serve_steps(spec, params, mesh=make_serving_mesh(2))
+    for a, b in zip(ref, tp2):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_tp4_matches_tp1_llama():
+    # tp=4 means one attention head per device and kv heads replicated?
+    # No: Hkv=2 < tp=4 is rejected; use Hkv=4 here.
+    spec = ModelSpec(
+        family="llama", hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_key_value_heads=4, head_dim=16,
+        num_hidden_layers=2, vocab_size=64,
+    )
+    params = _params_for(spec)
+    ref = _serve_steps(spec, params, mesh=None)
+    tp4 = _serve_steps(spec, params, mesh=make_serving_mesh(4))
+    for a, b in zip(ref, tp4):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_tp_rejects_indivisible_heads():
+    with pytest.raises(ValueError):
+        _serve_steps(LLAMA_SPEC, _params_for(LLAMA_SPEC),
+                     mesh=make_serving_mesh(3))
+
+
+def test_tp2_block_server_e2e(tmp_path):
+    """Full swarm path with a tp=2 server: greedy tokens match HF."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        server = BlockServer(
+            model_uid="t", start=0, end=3, model_dir=str(tmp_path),
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, tp=2,
+        )
+        await server.start()
+        dm = DistributedModelForCausalLM.from_pretrained(
+            str(tmp_path), rc(), model_uid="t"
+        )
+        ids_in = np.arange(6)[None, :] % config.vocab_size
+        ids = await dm.generate(ids_in, max_new_tokens=6)
+        with torch.no_grad():
+            ref = model.generate(
+                torch.tensor(ids_in), max_new_tokens=6, do_sample=False,
+                use_cache=True,
+            ).numpy()
+        np.testing.assert_array_equal(ids, ref)
+        await server.stop()
+        await reg.stop()
+
+    asyncio.run(run())
