@@ -122,10 +122,12 @@ class DistributedModelForCausalLM:
         )
 
     def inference_session(
-        self, max_length: int, batch_size: int = 1
+        self, max_length: int, batch_size: int = 1,
+        microbatch: int | None = None,
     ) -> InferenceSession:
         return InferenceSession(
-            self.manager, max_length, batch_size, use_push=self.use_push
+            self.manager, max_length, batch_size, use_push=self.use_push,
+            microbatch=microbatch,
         )
 
     # --------------------------------------------------------------- generate

@@ -18,11 +18,19 @@ import uuid
 import numpy as np
 
 from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
-from bloombee_tpu.wire.tensor_codec import dtype_for_name
 from bloombee_tpu.swarm.data import RemoteSpanInfo
+from bloombee_tpu.utils import env
 from bloombee_tpu.wire.rpc import Connection, RpcError, Stream, connect
+from bloombee_tpu.wire.tensor_codec import dtype_for_name
 
 logger = logging.getLogger(__name__)
+
+env.declare(
+    "BBTPU_MICROBATCH", int, 1,
+    "default within-stage micro-batch count for client sessions (>1 splits "
+    "each step's batch so stage N+1 computes chunk k while stage N computes "
+    "k+1 — the reference's BLOOMBEE_MICRO_BATCH_SIZE overlap)",
+)
 
 
 class _SpanSession:
@@ -56,6 +64,7 @@ class InferenceSession:
         use_push: bool = True,
         max_retries: int = 3,
         step_timeout: float = 120.0,
+        microbatch: int | None = None,
     ):
         self.manager = manager
         self.max_length = max_length
@@ -63,6 +72,14 @@ class InferenceSession:
         self.use_push = use_push
         self.max_retries = max_retries
         self.step_timeout = step_timeout
+        # within-stage micro-batch pipelining (reference
+        # microbatch_config.py:84-130 overlap-only mode): split each step's
+        # batch into chunks so downstream spans start on chunk k while
+        # upstream computes k+1
+        self.microbatch = (
+            microbatch if microbatch is not None
+            else env.get("BBTPU_MICROBATCH")
+        )
         self._spans: list[_SpanSession] = []
         self._history: list[np.ndarray] = []  # chain inputs, for replay
         self._step_counter = 0
@@ -158,10 +175,20 @@ class InferenceSession:
         # ship hidden in the first span's advertised wire dtype (bf16 for
         # bf16-compute servers: half the bytes on the latency-critical hop)
         wire_dt = dtype_for_name(self._spans[0].span.server_info.wire_dtype)
-        tensors = [hidden.astype(wire_dt)]
-        if tree_mask is not None:
-            tensors.append(tree_mask.astype(np.uint8))
+        hidden_w = hidden.astype(wire_dt)
+        extra = [tree_mask.astype(np.uint8)] if tree_mask is not None else []
 
+        # within-stage micro-batching: plain committed steps only (tree/
+        # accept steps keep whole-batch semantics)
+        b = hidden.shape[0]
+        mb = self.microbatch
+        if tree_mask is not None or accept is not None or mb > b:
+            mb = 1
+        bounds = [
+            (round(k * b / mb), round((k + 1) * b / mb)) for k in range(mb)
+        ]
+
+        route = []
         if self.use_push and len(self._spans) > 1:
             route = [
                 {
@@ -171,39 +198,63 @@ class InferenceSession:
                 }
                 for s in self._spans[1:]
             ]
-            meta = {**meta_base, "route": route, "reply": "tensor"}
-            await self._spans[0].stream.send(meta, tensors)
-        else:
-            meta = {**meta_base, "reply": "tensor"}
-            await self._spans[0].stream.send(meta, tensors)
+        for k, (lo, hi) in enumerate(bounds):
+            meta = {
+                **meta_base,
+                "reply": "tensor",
+                "mb": k,
+                "mb_of": mb,
+                "rows": [lo, hi],
+            }
+            if route:
+                meta["route"] = route
+            await self._spans[0].stream.send(
+                meta, [hidden_w[lo:hi]] + extra
+            )
 
         import time
 
         t_start = time.perf_counter()
-        out = None
+        out = np.zeros(hidden.shape, dtype=np.float32)
+        got_tensor = False
         compute_ms = []
         for i, span_sess in enumerate(self._spans):
-            try:
-                item = await asyncio.wait_for(
-                    span_sess.stream.recv(), self.step_timeout
-                )
-            except (RpcError, OSError, asyncio.TimeoutError):
-                self.manager.ban_peer(span_sess.span.peer_id)
-                raise
-            if item is None:
-                self.manager.ban_peer(span_sess.span.peer_id)
-                raise RpcError(f"span {i} closed mid-session")
-            resp_meta, resp_tensors = item
-            compute_ms.append(resp_meta.get("t_compute_ms"))
-            if resp_meta.get("ack"):
-                continue
-            out = resp_tensors[0]
-            if not self.use_push and i + 1 < len(self._spans):
-                await self._spans[i + 1].stream.send(
-                    {**meta_base, "reply": "tensor"},
-                    [out] + tensors[1:],
-                )
-        assert out is not None, "no span returned a tensor"
+            span_ms = 0.0
+            for _ in range(mb):
+                try:
+                    item = await asyncio.wait_for(
+                        span_sess.stream.recv(), self.step_timeout
+                    )
+                except (RpcError, OSError, asyncio.TimeoutError):
+                    self.manager.ban_peer(span_sess.span.peer_id)
+                    raise
+                if item is None:
+                    self.manager.ban_peer(span_sess.span.peer_id)
+                    raise RpcError(f"span {i} closed mid-session")
+                resp_meta, resp_tensors = item
+                if resp_meta.get("t_compute_ms") is not None:
+                    span_ms += resp_meta["t_compute_ms"]
+                if resp_meta.get("ack"):
+                    continue
+                lo, hi = resp_meta.get("rows") or (0, b)
+                chunk = resp_tensors[0]
+                out[lo:hi] = np.asarray(chunk, dtype=np.float32)
+                got_tensor = True
+                if not self.use_push and i + 1 < len(self._spans):
+                    # relay mode: forward each chunk as it lands so the next
+                    # span starts while this span computes the next chunk
+                    fwd_meta = {
+                        **meta_base,
+                        "reply": "tensor",
+                        "mb": resp_meta.get("mb", 0),
+                        "mb_of": mb,
+                        "rows": [lo, hi],
+                    }
+                    await self._spans[i + 1].stream.send(
+                        fwd_meta, [chunk] + extra
+                    )
+            compute_ms.append(span_ms)
+        assert got_tensor, "no span returned a tensor"
         total_ms = (time.perf_counter() - t_start) * 1000.0
         self.timings.append(
             {
@@ -213,7 +264,7 @@ class InferenceSession:
                 "total_ms": total_ms,
             }
         )
-        return np.asarray(out, dtype=np.float32)
+        return out
 
     def timing_summary(self) -> dict:
         """Aggregate decode-step timing: mean per-span compute vs wire+other

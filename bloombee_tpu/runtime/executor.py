@@ -211,14 +211,18 @@ class SpanExecutor:
 
         arena = self.manager.arena
         payload = pack_step_payload(h_pad, plan)
-        payload_dev = jnp.asarray(payload)
-        tm_dev = jnp.asarray(tm_pad) if tm_pad is not None else None
         if self.mesh is not None:
             from bloombee_tpu.parallel import serving as tp_serving
 
             payload_dev = tp_serving.replicated(payload, self.mesh)
-            if tm_dev is not None:
-                tm_dev = tp_serving.replicated(tm_pad, self.mesh)
+            tm_dev = (
+                tp_serving.replicated(tm_pad, self.mesh)
+                if tm_pad is not None
+                else None
+            )
+        else:
+            payload_dev = jnp.asarray(payload)
+            tm_dev = jnp.asarray(tm_pad) if tm_pad is not None else None
         out, new_k, new_v = span_step_packed(
             self.params,
             arena["k"],

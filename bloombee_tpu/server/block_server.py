@@ -52,6 +52,7 @@ class _Session:
         self.batch_size = batch_size
         self.layers = layers  # relative (l0, l1) within this server's span
         self.push_inbox: asyncio.Queue = asyncio.Queue()
+        self.step_tasks: set[asyncio.Task] = set()  # in-flight mb chunks
 
 
 class _PeerPool:
@@ -276,7 +277,11 @@ class BlockServer:
 
     async def _session_loop(self, session: _Session, stream: Stream) -> None:
         """Race client-stream items against pushed items
-        (reference handler.py:1677-1847)."""
+        (reference handler.py:1677-1847). Micro-batch chunks (mb_of > 1) run
+        as concurrent tasks so chunk k+1's compute dispatches while chunk k's
+        output is still in flight downstream — the within-stage overlap of
+        the reference's accumulate/immediate queues (handler.py:1850-2151);
+        whole-batch steps keep strict sequential handling."""
         stream_next = asyncio.ensure_future(stream.recv())
         push_next = asyncio.ensure_future(session.push_inbox.get())
         try:
@@ -289,15 +294,50 @@ class BlockServer:
                     item = stream_next.result()
                     if item is None:
                         break  # client closed the session
-                    await self._run_step(session, stream, *item)
+                    await self._handle_item(session, stream, *item)
                     stream_next = asyncio.ensure_future(stream.recv())
                 if push_next in done:
                     meta, tensors = push_next.result()
-                    await self._run_step(session, stream, meta, tensors)
+                    await self._handle_item(session, stream, meta, tensors)
                     push_next = asyncio.ensure_future(session.push_inbox.get())
         finally:
             stream_next.cancel()
             push_next.cancel()
+            # drain in-flight chunk tasks BEFORE the allocate context frees
+            # the session's pages: a still-running dispatch must not write
+            # KV into pages a new session may reuse
+            if session.step_tasks:
+                await asyncio.gather(
+                    *session.step_tasks, return_exceptions=True
+                )
+
+    async def _handle_item(
+        self, session: _Session, stream: Stream, meta: dict, tensors: list
+    ) -> None:
+        if int(meta.get("mb_of", 1)) <= 1:
+            await self._run_step(session, stream, meta, tensors)
+            return
+        task = asyncio.create_task(
+            self._run_step_logged(session, stream, meta, tensors)
+        )
+        session.step_tasks.add(task)
+        task.add_done_callback(session.step_tasks.discard)
+
+    async def _run_step_logged(
+        self, session: _Session, stream: Stream, meta: dict, tensors: list
+    ) -> None:
+        try:
+            await self._run_step(session, stream, meta, tensors)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # a failed chunk poisons the whole step: close the stream so the
+            # client's retry path rebuilds the chain
+            logger.warning("micro-batch step failed: %s", e)
+            try:
+                await stream.close()
+            except Exception:
+                pass
 
     async def _run_step(
         self, session: _Session, stream: Stream, meta: dict, tensors: list
@@ -326,6 +366,21 @@ class BlockServer:
             if meta.get("depths") is not None:
                 depths = np.asarray(meta["depths"], dtype=np.int32)
         commit = bool(meta.get("commit", True))
+        # micro-batch chunk: operate on a row slice of the session's cache
+        # handle (seq_ids are independent, so a sub-handle is just a slice)
+        rows = meta.get("rows")
+        handle = session.handle
+        if rows is not None and tuple(rows) != (0, session.batch_size):
+            import dataclasses as _dc
+
+            handle = _dc.replace(
+                session.handle, seq_ids=session.handle.seq_ids[rows[0]:rows[1]]
+            )
+        if hidden.shape[0] != handle.batch_size:
+            raise ValueError(
+                f"step rows {rows} carry batch {hidden.shape[0]} != "
+                f"{handle.batch_size} cache rows"
+            )
 
         # Two phases: dispatch runs on the serialized compute queue (device
         # work enqueues in order, ~1 ms), but the d2h fetch happens HERE, off
@@ -337,6 +392,7 @@ class BlockServer:
             PRIORITY_INFERENCE,
             self._compute_step,
             session,
+            handle,
             hidden,
             commit,
             tree_mask,
@@ -366,6 +422,9 @@ class BlockServer:
                 "reply": reply,
                 "route": route[1:],
             }
+            for key in ("mb", "mb_of", "rows"):
+                if meta.get(key) is not None:
+                    push_meta[key] = meta[key]
             if meta.get("tree"):
                 push_meta["depths"] = meta["depths"]
             if accept is not None:
@@ -384,13 +443,15 @@ class BlockServer:
                 {"step": meta.get("step"), "ack": True, **timing_meta}
             )
         else:
-            await stream.send(
-                {"step": meta.get("step"), **timing_meta},
-                [out],
-            )
+            resp = {"step": meta.get("step"), **timing_meta}
+            for key in ("mb", "rows"):
+                if meta.get(key) is not None:
+                    resp[key] = meta[key]
+            await stream.send(resp, [out])
 
     def _compute_step(
-        self, session: _Session, hidden, commit, tree_mask, depths=None
+        self, session: _Session, handle, hidden, commit, tree_mask,
+        depths=None,
     ):
         """Runs on the compute thread: plan packing + async device dispatch
         only (the d2h fetch happens off-queue in _run_step). The dispatch
@@ -402,12 +463,12 @@ class BlockServer:
         t0 = time.perf_counter()
         if hidden.shape[1] > 1 and tree_mask is None:
             out = self.executor.prefill(
-                session.handle, hidden, commit=commit, layers=session.layers,
+                handle, hidden, commit=commit, layers=session.layers,
                 fetch=False,
             )
         else:
             out = self.executor.decode(
-                session.handle, hidden, commit=commit, tree_mask=tree_mask,
+                handle, hidden, commit=commit, tree_mask=tree_mask,
                 layers=session.layers, depths=depths, fetch=False,
             )
         dt_ms = (time.perf_counter() - t0) * 1000.0
