@@ -37,6 +37,9 @@ def main(argv=None):
     parser.add_argument("--adapter-dirs", nargs="*", default=None,
                         help="LoRA adapter directories to merge into blocks")
     parser.add_argument("--announce-period", type=float, default=5.0)
+    parser.add_argument("--kv-quant", default=None,
+                        choices=["none", "int4"],
+                        help="KV cache quantization (int4 = ~3.2x capacity)")
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel degree over local chips "
                         "(reference --tensor_parallel_devices)")
@@ -85,6 +88,7 @@ def main(argv=None):
             announce_period=args.announce_period,
             adapter_dirs=args.adapter_dirs,
             tp=args.tp,
+            kv_quant=args.kv_quant,
         )
         await server.start()
         from bloombee_tpu.server.throughput import measure_and_announce

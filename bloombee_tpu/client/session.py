@@ -80,6 +80,10 @@ class InferenceSession:
             microbatch if microbatch is not None
             else env.get("BBTPU_MICROBATCH")
         )
+        if self.microbatch < 1:
+            raise ValueError(
+                f"microbatch must be >= 1, got {self.microbatch}"
+            )
         self._spans: list[_SpanSession] = []
         self._history: list[np.ndarray] = []  # chain inputs, for replay
         self._step_counter = 0

@@ -26,8 +26,19 @@ def make_arena(
     n_kv_heads: int,
     head_dim: int,
     dtype=jnp.bfloat16,
+    quant: str | None = None,
 ) -> dict:
+    """quant="int4": store the slabs group-quantized (the reference's
+    TorchCompressedDevice KV capacity lever, compression.py:22-210) — ~3.2x
+    more tokens per HBM byte; writes quantize and reads dequantize inside
+    the jitted span step."""
     shape = (num_layers, num_pages * page_size, n_kv_heads, head_dim)
+    if quant == "int4":
+        from bloombee_tpu.kv.quant import make_quant_slab
+
+        return {"k": make_quant_slab(shape), "v": make_quant_slab(shape)}
+    if quant not in (None, "none"):
+        raise ValueError(f"unknown KV quant mode {quant!r}")
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -43,6 +54,23 @@ def arena_write(
     Out-of-bounds slot ids are dropped — the span step points padding rows at
     slot == num_slots to discard their writes.
     """
+    from bloombee_tpu.kv.quant import QuantSlab, quantize
+
+    if isinstance(k_layer, QuantSlab):
+        new_k, new_v = quantize(k_new), quantize(v_new)
+        k_layer = QuantSlab(
+            *(
+                a.at[slots].set(b, mode="drop")
+                for a, b in zip(k_layer, new_k)
+            )
+        )
+        v_layer = QuantSlab(
+            *(
+                a.at[slots].set(b, mode="drop")
+                for a, b in zip(v_layer, new_v)
+            )
+        )
+        return k_layer, v_layer
     k_layer = k_layer.at[slots].set(k_new.astype(k_layer.dtype), mode="drop")
     v_layer = v_layer.at[slots].set(v_new.astype(v_layer.dtype), mode="drop")
     return k_layer, v_layer
@@ -59,11 +87,16 @@ def gather_pages(
     length — the clamped-read invariant lives in the attention mask, mirroring
     the reference's gather_prefix clamp (paged_kv.py:265-316).
     """
+    from bloombee_tpu.kv.quant import QuantSlab, dequantize
+
     b, max_pages = page_table.shape
     slots = (
         page_table[:, :, None] * page_size
         + jnp.arange(page_size, dtype=page_table.dtype)[None, None, :]
     ).reshape(b, max_pages * page_size)
+    if isinstance(layer_slab, QuantSlab):
+        gathered = QuantSlab(*(leaf[slots] for leaf in layer_slab))
+        return dequantize(gathered, jnp.float32)
     return layer_slab[slots]
 
 

@@ -104,6 +104,7 @@ class BlockServer:
         throughput: float = 1.0,
         adapter_dirs: list[str] | None = None,
         tp: int = 1,
+        kv_quant: str | None = None,  # "int4" -> quantized KV arena
     ):
         if params is None:
             from bloombee_tpu.models.checkpoint import load_span_params
@@ -133,6 +134,7 @@ class BlockServer:
             n_kv_heads=spec.num_key_value_heads,
             head_dim=spec.head_dim,
             dtype=compute_dtype,
+            quant=kv_quant,
         )
         mesh = None
         if tp > 1:

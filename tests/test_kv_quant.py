@@ -1,0 +1,179 @@
+"""int4 KV quantization (reference flexgen_utils/compression.py:22-210).
+
+- round-trip error bound for the group-wise quantizer
+- capacity: the int4 arena stores >= 3x more tokens per byte than bf16
+- serving parity: an int4-arena server's logits stay close to the dense
+  server's (KV quantization tolerance, not exactness)
+- parked-host quantization round trip through park/unpark
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bloombee_tpu.kv.arena import make_arena
+from bloombee_tpu.kv.cache_manager import CacheManager
+from bloombee_tpu.kv.quant import QuantSlab, dequantize, quantize, slab_nbytes
+
+
+def test_quant_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((64, 4, 64)).astype(np.float32))
+    back = dequantize(quantize(x), jnp.float32)
+    # 15 levels over each group's range: error <= range / 30 (+ f16 scale
+    # rounding slack); normal data range within a 32-group is ~4-5 sigma
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    group_range = np.asarray(
+        x.reshape(64, 4, 2, 32).max(-1) - x.reshape(64, 4, 2, 32).min(-1)
+    )
+    bound = np.repeat(group_range / 30.0, 32, axis=-1).reshape(x.shape) + 2e-2
+    assert (err <= bound).all(), err.max()
+
+
+def test_quant_capacity_3x():
+    dense = make_arena(2, 16, 16, 8, 128, jnp.bfloat16)
+    q4 = make_arena(2, 16, 16, 8, 128, jnp.bfloat16, quant="int4")
+    ratio = slab_nbytes(dense["k"]) / slab_nbytes(q4["k"])
+    assert ratio >= 3.0, ratio
+    # same byte budget -> >= 3x the pages -> >= 3x tokens admitted
+    assert int(16 * ratio) >= 48
+
+
+def test_int4_server_logits_close(tmp_path):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    async def logits_with(kv_quant):
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s = BlockServer(
+            model_uid="t", start=0, end=3, model_dir=str(tmp_path),
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, kv_quant=kv_quant,
+        )
+        await s.start()
+        dm = DistributedModelForCausalLM.from_pretrained(
+            str(tmp_path), rc(), model_uid="t"
+        )
+        input_ids = np.arange(10)[None, :] % config.vocab_size
+        async with dm.inference_session(16, 1) as sess:
+            hidden = dm.embed(input_ids)
+            out = await sess.step(hidden)
+        res = dm.logits(out)
+        await s.stop()
+        await reg.stop()
+        return res
+
+    dense = asyncio.run(logits_with(None))
+    q4 = asyncio.run(logits_with("int4"))
+    # int4 KV error is bounded per-group; logits drift but ranks hold for a
+    # prefill this short
+    np.testing.assert_allclose(q4, dense, atol=0.15, rtol=0.1)
+    assert (np.argmax(q4, -1) == np.argmax(dense, -1)).mean() >= 0.8
+
+
+def test_int4_decode_steps_consistent():
+    """Stepwise decode through the paged executor with an int4 arena: the
+    step outputs must track the dense-arena outputs."""
+    from bloombee_tpu.models.llama.block import init_block_params
+    from bloombee_tpu.models.spec import ModelSpec
+    from bloombee_tpu.runtime.executor import SpanExecutor
+    from bloombee_tpu.utils.tree import stack_params
+
+    spec = ModelSpec(
+        family="llama", hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        num_hidden_layers=2, vocab_size=64,
+    )
+    params = stack_params(
+        [init_block_params(jax.random.PRNGKey(i), spec) for i in range(2)]
+    )
+
+    async def run(quant):
+        manager = CacheManager(
+            num_layers=2, num_pages=32, page_size=4, n_kv_heads=2,
+            head_dim=16, dtype=jnp.float32, quant=quant,
+        )
+        ex = SpanExecutor(params, spec, manager, compute_dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        outs = []
+        async with manager.allocate(2, 16) as handle:
+            outs.append(ex.prefill(
+                handle, rng.standard_normal((2, 6, 64)).astype(np.float32)
+            ))
+            for _ in range(3):
+                outs.append(ex.decode(
+                    handle,
+                    rng.standard_normal((2, 1, 64)).astype(np.float32),
+                ))
+        return outs
+
+    dense = asyncio.run(run(None))
+    q4 = asyncio.run(run("int4"))
+    for a, b in zip(dense, q4):
+        # int4 KV drift through random-init blocks: direction preserved and
+        # bounded relative to the activation scale (measured ~0.997 / ~8%)
+        cos = float(
+            (a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9)
+        )
+        assert cos > 0.99, cos
+        assert np.abs(a - b).max() < 0.12 * np.abs(a).max()
+
+
+def test_park_unpark_quantized_host(monkeypatch):
+    """Dense arena + BBTPU_PARK_QUANT: parked KV is int4 on host; unpark
+    restores within quantization tolerance and the page table survives."""
+    monkeypatch.setenv("BBTPU_PARK_QUANT", "1")
+
+    async def run():
+        manager = CacheManager(
+            num_layers=2, num_pages=16, page_size=4, n_kv_heads=2,
+            head_dim=32, dtype=jnp.float32,
+        )
+        rng = np.random.default_rng(1)
+        async with manager.allocate(1, 12) as handle:
+            slots = manager.write_slots(handle, 6)
+            k_new = rng.standard_normal((6, 2, 32)).astype(np.float32)
+            v_new = rng.standard_normal((6, 2, 32)).astype(np.float32)
+            from bloombee_tpu.kv import arena as arena_ops
+
+            ak, av = arena_ops.arena_write(
+                manager.arena["k"][0], manager.arena["v"][0],
+                jnp.asarray(slots), jnp.asarray(k_new), jnp.asarray(v_new),
+            )
+            manager.arena["k"] = manager.arena["k"].at[0].set(ak)
+            manager.arena["v"] = manager.arena["v"].at[0].set(av)
+            sid = handle.seq_ids[0]
+            before = np.asarray(manager.arena["k"][0, slots])
+            manager.park_sequence(sid)
+            parked_k = manager._parked[sid][0]
+            assert isinstance(parked_k, QuantSlab)  # int4 on host
+            manager.unpark_sequence(sid)
+            after_slots = manager.table.prefix_slots(sid)
+            after = np.asarray(manager.arena["k"][0, after_slots])
+            # int4 over one 32-wide group of ~N(0,1): range ~4-5 sigma,
+            # quantization step = range/15 -> error bound ~range/30 ~ 0.17
+            np.testing.assert_allclose(after, before, atol=0.2)
+
+    asyncio.run(run())
