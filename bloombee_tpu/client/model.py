@@ -127,7 +127,7 @@ class DistributedModelForCausalLM:
     ) -> InferenceSession:
         return InferenceSession(
             self.manager, max_length, batch_size, use_push=self.use_push,
-            microbatch=microbatch,
+            microbatch=microbatch, embed_fn=self.embed,
         )
 
     # --------------------------------------------------------------- generate
@@ -152,7 +152,7 @@ class DistributedModelForCausalLM:
         rng = np.random.default_rng(seed)
         try:
             hidden = self.embed(input_ids)
-            out = await session.step(hidden)
+            out = await session.step(hidden, ids=input_ids)
             ids = input_ids
             finished = np.zeros((b,), dtype=bool)
             for _ in range(max_new_tokens):
@@ -168,7 +168,9 @@ class DistributedModelForCausalLM:
                     break
                 if ids.shape[1] >= max_length:
                     break
-                out = await session.step(self.embed(next_ids[:, None]))
+                out = await session.step(
+                    self.embed(next_ids[:, None]), ids=next_ids[:, None]
+                )
             return ids
         finally:
             if own_session:

@@ -65,6 +65,7 @@ class InferenceSession:
         max_retries: int = 3,
         step_timeout: float = 120.0,
         microbatch: int | None = None,
+        embed_fn=None,  # ids [B, T] -> hidden; enables token-id replay
     ):
         self.manager = manager
         self.max_length = max_length
@@ -72,6 +73,7 @@ class InferenceSession:
         self.use_push = use_push
         self.max_retries = max_retries
         self.step_timeout = step_timeout
+        self.embed_fn = embed_fn
         # within-stage micro-batch pipelining (reference
         # microbatch_config.py:84-130 overlap-only mode): split each step's
         # batch into chunks so downstream spans start on chunk k while
@@ -85,7 +87,12 @@ class InferenceSession:
                 f"microbatch must be >= 1, got {self.microbatch}"
             )
         self._spans: list[_SpanSession] = []
-        self._history: list[np.ndarray] = []  # chain inputs, for replay
+        # failure-replay history. Preferred: per-row committed token ids
+        # (ragged; replayed by re-embedding — the reference replays ids, not
+        # hidden states, inference_session.py:802-831). Fallback when no
+        # embed_fn / raw-hidden steps: stored hidden arrays (memory-heavy).
+        self._id_rows: list[list[int]] = [[] for _ in range(batch_size)]
+        self._history: list[np.ndarray] = []  # legacy hidden replay
         self._step_counter = 0
         self.position = 0
         # per-step timing rows (the client half of the reference's
@@ -133,16 +140,22 @@ class InferenceSession:
         tree_mask: np.ndarray | None = None,
         depths: np.ndarray | None = None,
         accept: list | None = None,
+        ids: np.ndarray | None = None,  # [B, T]: enables token-id replay
+        commit_lens: list | None = None,
     ) -> np.ndarray:
         """Push hidden through the whole chain; returns last span's output."""
         attempt = 0
         while True:
             try:
                 out = await self._step_once(
-                    hidden, commit, tree_mask, depths, accept
+                    hidden, commit, tree_mask, depths, accept, commit_lens
                 )
                 if commit and tree_mask is None:
-                    self._history.append(hidden)
+                    if ids is not None and self.embed_fn is not None:
+                        for i, row in enumerate(np.asarray(ids)):
+                            self._id_rows[i].extend(int(t) for t in row)
+                    else:
+                        self._history.append(hidden)
                     self.position += hidden.shape[1]
                 return out
             except (RpcError, OSError, asyncio.TimeoutError) as e:
@@ -163,7 +176,8 @@ class InferenceSession:
                     await asyncio.sleep(min(0.2 * attempt, 2.0))
 
     async def _step_once(
-        self, hidden, commit, tree_mask, depths=None, accept=None
+        self, hidden, commit, tree_mask, depths=None, accept=None,
+        commit_lens=None,
     ):
         step_id = self._step_counter
         self._step_counter += 1
@@ -176,6 +190,8 @@ class InferenceSession:
             meta_base["depths"] = np.asarray(depths).tolist()
         if accept is not None:
             meta_base["accept"] = [np.asarray(a).tolist() for a in accept]
+        if commit_lens is not None:
+            meta_base["commit_lens"] = [int(x) for x in commit_lens]
         # ship hidden in the first span's advertised wire dtype (bf16 for
         # bf16-compute servers: half the bytes on the latency-critical hop)
         wire_dt = dtype_for_name(self._spans[0].span.server_info.wire_dtype)
@@ -186,7 +202,12 @@ class InferenceSession:
         # accept steps keep whole-batch semantics)
         b = hidden.shape[0]
         mb = self.microbatch
-        if tree_mask is not None or accept is not None or mb > b:
+        if (
+            tree_mask is not None
+            or accept is not None
+            or commit_lens is not None
+            or mb > b
+        ):
             mb = 1
         bounds = [
             (round(k * b / mb), round((k + 1) * b / mb)) for k in range(mb)
@@ -327,12 +348,33 @@ class InferenceSession:
         self._history.append(hidden)
         self.position += hidden.shape[1]
 
+    def record_history_ids(self, rows: list[list[int]]) -> None:
+        """Ragged per-row committed token ids (batched speculative rounds:
+        each row accepts a different count). Requires embed_fn — id history
+        can only be replayed by re-embedding."""
+        if self.embed_fn is None:
+            raise ValueError(
+                "record_history_ids needs a session with embed_fn "
+                "(model.inference_session provides it)"
+            )
+        for i, row in enumerate(rows):
+            self._id_rows[i].extend(int(t) for t in row)
+
     # -------------------------------------------------------------- recovery
     async def _recover(self) -> None:
         """Rebuild the entire chain and replay history
         (v1 of reference `_update_sequence`: suffix-only rebuild is an
         optimization; full rebuild is correct because servers key KV caches by
         session, and new sessions start empty)."""
+        if any(self._id_rows) and self._history:
+            # both histories populated -> replay interleaving is unknowable;
+            # refuse before touching the chain (sessions must record ids
+            # consistently: pass ids= to step / record_history_ids)
+            await self.close()
+            raise RuntimeError(
+                "session mixed token-id and hidden-state history; replay "
+                "order is ambiguous"
+            )
         await self.close()
         await self.manager.update(force=True)
         route = self.manager.make_sequence(
@@ -351,12 +393,26 @@ class InferenceSession:
                 await sp.close()
             raise
         self._spans = spans
-        if self._history:
-            replay = np.concatenate(self._history, axis=1)
-            try:
+        try:
+            if self.embed_fn is not None and any(self._id_rows):
+                # token-id replay (ragged rows): right-pad to a rectangle,
+                # write speculatively, then commit each row to its true
+                # length — padded garbage lands after a row's real tokens so
+                # the causal mask hides it, and commit_lens frees its pages
+                lens = [len(r) for r in self._id_rows]
+                width = max(lens)
+                padded = np.zeros((self.batch_size, width), np.int64)
+                for i, r in enumerate(self._id_rows):
+                    padded[i, : len(r)] = r
+                replay = self.embed_fn(padded)
+                await self._step_once(
+                    replay, commit=False, tree_mask=None, commit_lens=lens
+                )
+            elif self._history:
+                replay = np.concatenate(self._history, axis=1)
                 await self._step_once(replay, commit=True, tree_mask=None)
-            except Exception:
-                # a half-replayed chain must not be reused: its KV caches are
-                # incomplete and a later "successful" step would be garbage
-                await self.close()
-                raise
+        except Exception:
+            # a half-replayed chain must not be reused: its KV caches are
+            # incomplete and a later "successful" step would be garbage
+            await self.close()
+            raise

@@ -1,21 +1,23 @@
-"""Speculative generation over the swarm.
+"""Speculative generation over the swarm (batched).
 
 Port of the reference's DistributedLlamaForSpeculativeGeneration.generate
 loop (/root/reference/src/bloombee/models/llama/speculative_model.py:33-117):
-draft a tree rooted at the last certain token, verify the linearized tree in
-ONE distributed step (tree mask + depth positions, KV written speculatively),
-accept a path, and tell the servers which speculative slots survive (they
+draft per-sample trees, verify every row's linearized tree in ONE distributed
+step (tree mask + depth positions, KV written speculatively), accept a path
+per row, and tell the servers which speculative slots survive per row (they
 compact + commit on device). Greedy mode is token-exact with plain greedy
 decode.
+
+Batching: all rows share the drafter's static branching, so every row's tree
+has identical structure (parents/depths/mask) — only tokens differ. Rows
+accept different counts per round; the paged cache tracks per-row lengths
+natively and history replay is by ragged token ids.
 
 Round structure: every round's tree has node 0 = the bonus token from the
 previous round (certain, always accepted) with the drafter's tree hanging
 under it — so the certain token's KV is written in the same step as the
 drafts, and the accept metadata rides the NEXT round's step (no extra RTT,
 cf. the reference's set_kv_cache piggybacking).
-
-Batch size 1 per session for now (the reference pads per-sample trees to a
-common shape; that generalization is wiring, not design).
 """
 
 from __future__ import annotations
@@ -31,12 +33,11 @@ from bloombee_tpu.spec.verify import accept_greedy
 async def generate_speculative(
     model: DistributedModelForCausalLM,
     drafter: GreedyTreeDrafter,
-    input_ids: np.ndarray,  # [1, S]
+    input_ids: np.ndarray,  # [B, S]
     max_new_tokens: int,
     session=None,
 ) -> np.ndarray:
     input_ids = np.asarray(input_ids)
-    assert input_ids.shape[0] == 1, "speculative path is per-sequence for now"
     b, s = input_ids.shape
     tree_size = 1 + sum(
         int(np.prod(drafter.branching[: i + 1]))
@@ -47,28 +48,50 @@ async def generate_speculative(
     if own:
         session = model.inference_session(max_length, b)
         await session.__aenter__()
+    if session.embed_fn is None:
+        raise ValueError(
+            "speculative generation records token-id history; the session "
+            "must be built with embed_fn (model.inference_session does)"
+        )
     try:
-        ids = list(input_ids[0])
-        # prefill -> logits at the last prompt token
-        hidden = model.embed(np.asarray([ids]))
-        out = await session.step(hidden)
-        root_logits = model.logits(out[:, -1:])[0, 0]
-        bonus = int(np.argmax(root_logits))
-        new_tokens = [bonus]
+        rows = [list(r) for r in input_ids]
+        # prefill -> logits at each row's last prompt token
+        out = await session.step(model.embed(input_ids), ids=input_ids)
+        root_logits = np.array(model.logits(out[:, -1:])[:, 0])  # [B, V]
+        bonus = np.argmax(root_logits, axis=-1)  # [B]
+        new_rows = [[int(bonus[i])] for i in range(b)]
         pending_accept = None
 
-        while len(new_tokens) < max_new_tokens:
-            # tree: node 0 = bonus (certain), drafter's tree under it
-            sub, _probs = drafter.build(np.asarray(ids + new_tokens))
-            tokens = np.concatenate([[new_tokens[-1]], sub.tokens])
+        while min(len(r) for r in new_rows) < max_new_tokens:
+            # done rows still occupy a slot in the rectangular tree step,
+            # but draft from a 1-token context so their drafter cost is nil
+            # (their speculative writes roll back via empty accepts)
+            contexts = [
+                (rows[i] + new_rows[i])
+                if len(new_rows[i]) < max_new_tokens
+                else [new_rows[i][-1]]
+                for i in range(b)
+            ]
+            subs, _probs = drafter.build_batch(contexts)
+            # per-row tree: node 0 = that row's last (certain) token, the
+            # drafter's tree hanging under it; structure shared across rows
+            toks = np.stack(
+                [
+                    np.concatenate([[new_rows[i][-1]], subs[i].tokens])
+                    for i in range(b)
+                ]
+            )  # [B, T]
             parents = np.concatenate(
-                [[-1], np.where(sub.parents < 0, 0, sub.parents + 1)]
+                [[-1], np.where(subs[0].parents < 0, 0, subs[0].parents + 1)]
             ).astype(np.int32)
-            tree = DraftTree(tokens=tokens, parents=parents)
-            mask = tree_attention_mask(tree)[None]  # [1, T, T]
-            depths = tree.depths()[None]  # [1, T]
+            tree0 = DraftTree(tokens=toks[0], parents=parents)
+            t = tree0.size
+            mask = np.broadcast_to(
+                tree_attention_mask(tree0)[None], (b, t, t)
+            )
+            depths = np.broadcast_to(tree0.depths()[None], (b, t))
 
-            h_tree = model.embed(tree.tokens[None])
+            h_tree = model.embed(toks)
             out = await session.step(
                 h_tree,
                 commit=False,
@@ -76,28 +99,40 @@ async def generate_speculative(
                 depths=depths,
                 accept=pending_accept,
             )
-            logits = model.logits(out)[0]  # [T, V]
+            logits = model.logits(out)  # [B, T, V]
 
-            accepted, nxt_bonus = accept_greedy(tree, root_logits, logits)
-            # node 0 is certain and always accepted first
-            assert accepted and accepted[0] == 0
-            pending_accept = [np.asarray(accepted)]
-            accepted_tokens = [int(tree.tokens[a]) for a in accepted[1:]]
-            # accepted rows of h_tree ARE the history inputs — no re-embed
-            session.record_history(np.asarray(h_tree[:, accepted]))
-            root_logits = logits[accepted[-1]]
-            new_tokens.extend(accepted_tokens)
-            new_tokens.append(nxt_bonus)
+            pending_accept = []
+            committed_rows = []
+            for i in range(b):
+                room = max_new_tokens - len(new_rows[i])
+                if room <= 0:
+                    # row done: accept nothing (its speculative rows roll
+                    # back) so its cache stays "all committed but the final
+                    # bonus" while slow rows continue
+                    pending_accept.append(np.asarray([], dtype=np.int64))
+                    committed_rows.append([])
+                    continue
+                tree_i = DraftTree(tokens=toks[i], parents=parents)
+                accepted, _ = accept_greedy(tree_i, root_logits[i], logits[i])
+                assert accepted and accepted[0] == 0
+                # cap so the row lands on EXACTLY max_new_tokens with its
+                # last token an uncommitted bonus — the same resume contract
+                # as plain generate (last returned token not yet stepped)
+                accepted = accepted[: 1 + max(room - 1, 0)]
+                nxt = int(np.argmax(logits[i][accepted[-1]]))
+                pending_accept.append(np.asarray(accepted))
+                committed_rows.append([int(toks[i][a]) for a in accepted])
+                root_logits[i] = logits[i][accepted[-1]]
+                new_rows[i].extend(int(toks[i][a]) for a in accepted[1:])
+                new_rows[i].append(nxt)
+            # accepted nodes' token ids ARE the committed history
+            session.record_history_ids(committed_rows)
 
         if pending_accept is not None:
             await session.send_accept(pending_accept)
-        # every token except the final bonus is committed in server KV, so
-        # only that may be trimmed — a resumed session must see ids that
-        # match the committed cache (may overshoot max_new_tokens by up to
-        # the accepted path length, like the reference's tree spikes)
-        if len(new_tokens) > max_new_tokens:
-            new_tokens = new_tokens[:-1]
-        return np.asarray([ids + new_tokens])
+        # rows converged to exactly max_new_tokens; every returned token
+        # except each row's final bonus is committed server-side
+        return np.asarray([rows[i] + new_rows[i] for i in range(b)])
     finally:
         if own:
             await session.__aexit__(None, None, None)

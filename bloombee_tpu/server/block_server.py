@@ -400,6 +400,13 @@ class BlockServer:
             tree_mask,
             depths,
         )
+        commit_lens = meta.get("commit_lens")
+        if commit_lens is not None:
+            # ragged replay: the step wrote a padded rectangle speculatively;
+            # commit each row to its true length (frees the padding's pages).
+            # Safe right after dispatch: slots were assigned in-queue, and
+            # freed pages can only be overwritten by later-dispatched steps.
+            self.manager.commit(handle, lengths=[int(x) for x in commit_lens])
         import time as _time
 
         t0 = _time.perf_counter()
@@ -424,7 +431,7 @@ class BlockServer:
                 "reply": reply,
                 "route": route[1:],
             }
-            for key in ("mb", "mb_of", "rows"):
+            for key in ("mb", "mb_of", "rows", "commit_lens"):
                 if meta.get(key) is not None:
                     push_meta[key] = meta[key]
             if meta.get("tree"):

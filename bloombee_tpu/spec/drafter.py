@@ -68,13 +68,22 @@ class LocalJaxDraftModel:
     def last_logits(self, ids: np.ndarray) -> np.ndarray:
         """Bucket the context length (pow2) so round-over-round growth reuses
         compiled shapes instead of retracing every round."""
+        return self.last_logits_ragged([list(row) for row in ids])
+
+    def last_logits_ragged(self, seqs: list[list[int]]) -> np.ndarray:
+        """Per-sequence next-token logits for ragged contexts (batched
+        speculative rows have per-row lengths); right-padded to a pow2
+        bucket, with the per-row `last` index selecting the true end (the
+        causal mask keeps padding invisible)."""
         from bloombee_tpu.runtime.executor import next_pow2
 
-        n, s = ids.shape
-        sb = next_pow2(s, floor=8)
+        n = len(seqs)
+        lens = [len(q) for q in seqs]
+        sb = next_pow2(max(lens), floor=8)
         padded = np.zeros((n, sb), dtype=np.int64)
-        padded[:, :s] = ids
-        last = np.full((n,), s - 1, dtype=np.int32)
+        for i, q in enumerate(seqs):
+            padded[i, : len(q)] = q
+        last = np.asarray([ln - 1 for ln in lens], dtype=np.int32)
         return np.asarray(
             self._last_logits(jnp.asarray(padded), jnp.asarray(last))
         )
@@ -88,31 +97,49 @@ class GreedyTreeDrafter:
         self.branching = tuple(branching)
 
     def build(self, context_ids: np.ndarray) -> tuple[DraftTree, np.ndarray]:
-        """context_ids [S] -> (tree, draft_probs [T, V]).
+        """context_ids [S] -> (tree, draft_probs [T, V])."""
+        trees, probs = self.build_batch([list(context_ids)])
+        return trees[0], probs[0]
 
-        draft_probs[i] is the drafter's softmax distribution at node i's
-        position (conditioned on its path) — what accept_sampling needs.
+    def build_batch(
+        self, contexts: list[list[int]]
+    ) -> tuple[list[DraftTree], list[np.ndarray]]:
+        """Per-row trees in ONE drafter call per depth (the reference drafts
+        per-sample trees in parallel threads, speculative_model.py:33-117;
+        here all rows' frontiers batch into one bucketed forward).
+
+        All trees share the same static branching, hence identical parents/
+        depths/mask structure — only tokens differ per row. draft_probs[r][i]
+        is row r's drafter distribution at node i (for accept_sampling).
         """
-        tokens: list[int] = []
-        parents: list[int] = []
-        probs: list[np.ndarray] = []
-        # frontier: list of (parent_index, path_ids)
-        frontier = [(-1, list(context_ids))]
+        bsz = len(contexts)
+        tokens = [[] for _ in range(bsz)]
+        parents: list[int] = []  # shared across rows
+        probs = [[] for _ in range(bsz)]
+        # per-row frontier: list of (parent_index, path_ids)
+        frontiers = [[(-1, list(c))] for c in contexts]
         for width in self.branching:
-            ids = np.asarray([f[1] for f in frontier], dtype=np.int64)
-            logits = self.model.last_logits(ids)  # [n, V]
+            n = len(frontiers[0])
+            seqs = [f[1] for fr in frontiers for f in fr]  # [bsz*n] ragged
+            logits = self.model.last_logits_ragged(seqs).reshape(
+                bsz, n, -1
+            )  # [bsz, n, V]
             p = _softmax(logits)
-            top = np.argsort(-logits, axis=-1)[:, :width]
-            new_frontier = []
-            for fi, (parent, path) in enumerate(frontier):
-                for tok in top[fi]:
-                    idx = len(tokens)
-                    tokens.append(int(tok))
-                    parents.append(parent)
-                    probs.append(p[fi])
-                    new_frontier.append((idx, path + [int(tok)]))
-            frontier = new_frontier
-        tree = DraftTree(
-            tokens=np.asarray(tokens), parents=np.asarray(parents)
-        )
-        return tree, np.stack(probs)
+            top = np.argsort(-logits, axis=-1)[..., :width]  # [bsz, n, w]
+            for r in range(bsz):
+                new_frontier = []
+                for fi, (parent, path) in enumerate(frontiers[r]):
+                    for tok in top[r, fi]:
+                        idx = len(tokens[r])
+                        tokens[r].append(int(tok))
+                        probs[r].append(p[r, fi])
+                        new_frontier.append((idx, path + [int(tok)]))
+                        if r == 0:
+                            parents.append(parent)  # structure shared
+                frontiers[r] = new_frontier
+        par = np.asarray(parents, dtype=np.int32)
+        trees = [
+            DraftTree(tokens=np.asarray(tokens[r]), parents=par.copy())
+            for r in range(bsz)
+        ]
+        return trees, [np.stack(pr) for pr in probs]

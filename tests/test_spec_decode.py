@@ -158,3 +158,149 @@ def test_e2e_speculative_equals_greedy(tmp_path):
         await reg.stop()
 
     asyncio.run(run())
+
+
+def test_e2e_speculative_batch4_equals_greedy(tmp_path):
+    """Batched speculative decoding (reference speculative_model.py:33-117
+    per-sample trees): 4 rows with different prompts, per-row accepts, all
+    token-exact vs plain batched greedy."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.client.speculative import generate_speculative
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "model")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        servers = [
+            BlockServer(model_uid="m", start=0, end=2, model_dir=d,
+                        registry=rc(), compute_dtype=jnp.float32,
+                        num_pages=256, page_size=4),
+            BlockServer(model_uid="m", start=2, end=3, model_dir=d,
+                        registry=rc(), compute_dtype=jnp.float32,
+                        num_pages=256, page_size=4),
+        ]
+        for s in servers:
+            await s.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, rc(), model_uid="m", use_push=False
+        )
+        drafter = GreedyTreeDrafter(
+            LocalJaxDraftModel.from_dir(d), branching=(2, 1)
+        )
+        rng = np.random.default_rng(7)
+        input_ids = rng.integers(0, 128, size=(4, 5))
+        n_new = 8
+
+        spec_ids = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=n_new
+        )
+        assert spec_ids.shape == (4, 5 + n_new)
+        plain_ids = await model.generate(input_ids, max_new_tokens=n_new)
+        np.testing.assert_array_equal(spec_ids, plain_ids)
+
+        for s in servers:
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_e2e_speculative_failover_ragged_replay(tmp_path):
+    """Kill the preferred tail server between two batched speculative calls
+    on one session: recovery replays RAGGED per-row token ids (rows committed
+    different counts) and continuation stays token-exact vs plain greedy."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.client.speculative import generate_speculative
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "model")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s_a = BlockServer(model_uid="m", start=0, end=2, model_dir=d,
+                          registry=rc(), compute_dtype=jnp.float32,
+                          num_pages=256, page_size=4, throughput=10.0)
+        s_b = BlockServer(model_uid="m", start=2, end=3, model_dir=d,
+                          registry=rc(), compute_dtype=jnp.float32,
+                          num_pages=256, page_size=4, throughput=10.0)
+        s_c = BlockServer(model_uid="m", start=2, end=3, model_dir=d,
+                          registry=rc(), compute_dtype=jnp.float32,
+                          num_pages=256, page_size=4, throughput=1.0)
+        for s in (s_a, s_b, s_c):
+            await s.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, rc(), model_uid="m", use_push=False
+        )
+        drafter = GreedyTreeDrafter(
+            LocalJaxDraftModel.from_dir(d), branching=(2, 1)
+        )
+        rng = np.random.default_rng(11)
+        input_ids = rng.integers(0, 128, size=(3, 5))
+        session = model.inference_session(64, 3)
+        await session.__aenter__()
+        used = {x.span.server_info.port for x in session._spans}
+        assert s_b.port in used and s_c.port not in used
+
+        first = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=5, session=session
+        )
+        # rows committed ragged counts; kill the preferred tail server
+        await s_b.stop()
+        more = await generate_speculative(
+            model, drafter, first[:, -1:], max_new_tokens=5, session=session
+        )
+        await session.__aexit__(None, None, None)
+        final = np.concatenate([first, more[:, 1:]], axis=1)
+        plain = await model.generate(input_ids, max_new_tokens=10)
+        np.testing.assert_array_equal(final, plain)
+
+        for s in (s_a, s_c):
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
