@@ -142,11 +142,18 @@ class InferenceSession:
         accept: list | None = None,
         ids: np.ndarray | None = None,  # [B, T]: enables token-id replay
         commit_lens: list | None = None,
+        prune: dict | None = None,  # mid-chain tree pruning (tree steps)
+        accept_per_span: list | None = None,  # pruned chains: accept per span
     ) -> np.ndarray:
-        """Push hidden through the whole chain; returns last span's output."""
+        """Push hidden through the whole chain; returns last span's output
+        (or (output, keep) for pruned tree steps)."""
         attempt = 0
         while True:
             try:
+                if prune is not None or accept_per_span is not None:
+                    return await self._step_pruned(
+                        hidden, tree_mask, depths, prune, accept_per_span
+                    )
                 out = await self._step_once(
                     hidden, commit, tree_mask, depths, accept, commit_lens
                 )
@@ -171,9 +178,87 @@ class InferenceSession:
                     # on the fresh chain; the rebuilt servers have an empty
                     # speculative window, so a carried accept is stale
                     accept = None
+                    accept_per_span = None
                 except (RpcError, OSError, asyncio.TimeoutError) as e2:
                     logger.warning("recovery attempt failed: %s", e2)
                     await asyncio.sleep(min(0.2 * attempt, 2.0))
+
+    async def _step_pruned(
+        self, hidden, tree_mask, depths, prune, accept_per_span
+    ):
+        """Tree step through the chain with mid-chain pruning: span 0 runs
+        the full tree and returns only surviving rows + keep indices; the
+        client forwards the pruned tree (restricted mask/depths) downstream
+        (relay mode only). Accepts may differ per span — downstream spans
+        hold KV in kept-row order (reference backend.py:763-775 +
+        block_functions.py restore_hidden_states, inverted client-side).
+
+        Returns (out [B, K, D] fp32, keep [B, K] or None if the pruning
+        span has no pruner weight)."""
+        if self.use_push and len(self._spans) > 1:
+            raise ValueError("pruned tree steps need relay mode (use_push=False)")
+        assert tree_mask is not None and depths is not None
+        step_id = self._step_counter
+        self._step_counter += 1
+        b = hidden.shape[0]
+        wire_dt = dtype_for_name(self._spans[0].span.server_info.wire_dtype)
+        chunk = hidden.astype(wire_dt)
+        mask_u8 = np.asarray(tree_mask).astype(np.uint8)
+        depths_list = np.asarray(depths).tolist()
+        keep = None
+
+        import time
+
+        t_start = time.perf_counter()
+        compute_ms = []
+        for i, span_sess in enumerate(self._spans):
+            meta = {
+                "step": step_id,
+                "commit": False,
+                "tree": True,
+                "depths": depths_list,
+                "reply": "tensor",
+            }
+            if accept_per_span is not None and accept_per_span[i] is not None:
+                meta["accept"] = [
+                    np.asarray(a).tolist() for a in accept_per_span[i]
+                ]
+            if i == 0 and prune is not None:
+                meta["prune"] = prune
+            try:
+                await span_sess.stream.send(meta, [chunk, mask_u8])
+                item = await asyncio.wait_for(
+                    span_sess.stream.recv(), self.step_timeout
+                )
+            except (RpcError, OSError, asyncio.TimeoutError):
+                self.manager.ban_peer(span_sess.span.peer_id)
+                raise
+            if item is None:
+                self.manager.ban_peer(span_sess.span.peer_id)
+                raise RpcError(f"span {i} closed mid-session")
+            resp_meta, resp_tensors = item
+            compute_ms.append(resp_meta.get("t_compute_ms"))
+            chunk = resp_tensors[0]
+            if i == 0 and resp_meta.get("keep") is not None:
+                from bloombee_tpu.spec.tree import pruned_step_arrays
+
+                keep = np.asarray(resp_meta["keep"], dtype=np.int32)
+                mask_k, depths_k = pruned_step_arrays(
+                    np.asarray(tree_mask, dtype=bool),
+                    np.asarray(depths),
+                    keep,
+                )
+                mask_u8 = mask_k.astype(np.uint8)
+                depths_list = depths_k.tolist()
+        self.timings.append(
+            {
+                "step": step_id,
+                "tokens": hidden.shape[1],
+                "span_compute_ms": compute_ms,
+                "total_ms": (time.perf_counter() - t_start) * 1000.0,
+            }
+        )
+        return np.asarray(chunk, dtype=np.float32), keep
 
     async def _step_once(
         self, hidden, commit, tree_mask, depths=None, accept=None,
@@ -322,18 +407,23 @@ class InferenceSession:
             "mean_wire_and_overhead_ms": total - compute,
         }
 
-    async def send_accept(self, accept: list) -> None:
+    async def send_accept(
+        self, accept: list, per_span: list | None = None
+    ) -> None:
         """Apply a speculative accept on every span without running compute
-        (the final accept of a generation, or an accept with no next tree)."""
+        (the final accept of a generation, or an accept with no next tree).
+        `per_span` overrides the accept for each span (pruned chains hold KV
+        in kept-row order downstream)."""
         step_id = self._step_counter
         self._step_counter += 1
-        meta = {
-            "step": step_id,
-            "accept": [np.asarray(a).tolist() for a in accept],
-            "accept_only": True,
-            "reply": "ack",
-        }
-        for span_sess in self._spans:
+        for i, span_sess in enumerate(self._spans):
+            acc = accept if per_span is None else per_span[i]
+            meta = {
+                "step": step_id,
+                "accept": [np.asarray(a).tolist() for a in acc],
+                "accept_only": True,
+                "reply": "ack",
+            }
             await span_sess.stream.send(meta, [])
         for i, span_sess in enumerate(self._spans):
             item = await asyncio.wait_for(
@@ -341,12 +431,6 @@ class InferenceSession:
             )
             if item is None:
                 raise RpcError(f"span {i} closed during accept")
-
-    def record_history(self, hidden: np.ndarray) -> None:
-        """Register committed tokens' inputs for failure replay (speculative
-        rounds bypass step()'s automatic history)."""
-        self._history.append(hidden)
-        self.position += hidden.shape[1]
 
     def record_history_ids(self, rows: list[list[int]]) -> None:
         """Ragged per-row committed token ids (batched speculative rounds:

@@ -30,12 +30,27 @@ from bloombee_tpu.spec.tree import DraftTree, tree_attention_mask
 from bloombee_tpu.spec.verify import accept_greedy
 
 
+def _per_span_accepts(
+    accepts: list, keep: np.ndarray, n_spans: int
+) -> list:
+    """Translate original-space accepts into each span's KV row space:
+    span 0 saw the full tree; downstream spans hold KV in kept-row order
+    (every accepted node is verifiable, hence present in keep)."""
+    kept_space = []
+    for i, acc in enumerate(accepts):
+        pos = {int(orig): p for p, orig in enumerate(keep[i]) if orig >= 0}
+        kept_space.append(np.asarray([pos[int(a)] for a in acc], np.int64))
+    return [accepts] + [kept_space] * (n_spans - 1)
+
+
 async def generate_speculative(
     model: DistributedModelForCausalLM,
     drafter: GreedyTreeDrafter,
     input_ids: np.ndarray,  # [B, S]
     max_new_tokens: int,
     session=None,
+    prune_threshold: float | None = None,  # mid-chain pruning (relay mode)
+    prune_max_keep: int | None = None,
 ) -> np.ndarray:
     input_ids = np.asarray(input_ids)
     b, s = input_ids.shape
@@ -60,7 +75,8 @@ async def generate_speculative(
         root_logits = np.array(model.logits(out[:, -1:])[:, 0])  # [B, V]
         bonus = np.argmax(root_logits, axis=-1)  # [B]
         new_rows = [[int(bonus[i])] for i in range(b)]
-        pending_accept = None
+        pending_accept = None  # original-space accepts per row
+        pending_spans = None  # per-span accepts for pruned chains
 
         while min(len(r) for r in new_rows) < max_new_tokens:
             # done rows still occupy a slot in the rectangular tree step,
@@ -92,14 +108,46 @@ async def generate_speculative(
             depths = np.broadcast_to(tree0.depths()[None], (b, t))
 
             h_tree = model.embed(toks)
-            out = await session.step(
-                h_tree,
-                commit=False,
-                tree_mask=mask,
-                depths=depths,
-                accept=pending_accept,
-            )
-            logits = model.logits(out)  # [B, T, V]
+            if prune_threshold is None:
+                out = await session.step(
+                    h_tree,
+                    commit=False,
+                    tree_mask=mask,
+                    depths=depths,
+                    accept=pending_accept,
+                )
+                logits = model.logits(out)  # [B, T, V]
+                verifiable = None
+            else:
+                # mid-chain pruning: span 0 keeps only MidLMHead survivors;
+                # downstream spans verify the smaller tree; restore maps
+                # kept logits back to original node indices
+                prune_meta = {
+                    "threshold": float(prune_threshold),
+                    "max_keep": int(prune_max_keep or t),
+                    "tokens": toks.tolist(),
+                    "parents": parents.tolist(),
+                }
+                out_k, keep = await session.step(
+                    h_tree,
+                    commit=False,
+                    tree_mask=mask,
+                    depths=depths,
+                    prune=prune_meta,
+                    accept_per_span=pending_spans,
+                )
+                logits_k = model.logits(out_k)  # [B, K, V]
+                if keep is None:  # pruning span had no pruner weight
+                    logits = logits_k
+                    verifiable = None
+                    keep = np.broadcast_to(np.arange(t), (b, t))
+                else:
+                    logits = np.zeros((b, t, logits_k.shape[-1]), np.float32)
+                    verifiable = np.zeros((b, t), dtype=bool)
+                    for i in range(b):
+                        valid = keep[i] >= 0
+                        logits[i][keep[i][valid]] = logits_k[i][valid]
+                        verifiable[i][keep[i][valid]] = True
 
             pending_accept = []
             committed_rows = []
@@ -113,7 +161,10 @@ async def generate_speculative(
                     committed_rows.append([])
                     continue
                 tree_i = DraftTree(tokens=toks[i], parents=parents)
-                accepted, _ = accept_greedy(tree_i, root_logits[i], logits[i])
+                accepted, _ = accept_greedy(
+                    tree_i, root_logits[i], logits[i],
+                    verifiable=None if verifiable is None else verifiable[i],
+                )
                 assert accepted and accepted[0] == 0
                 # cap so the row lands on EXACTLY max_new_tokens with its
                 # last token an uncommitted bonus — the same resume contract
@@ -127,9 +178,13 @@ async def generate_speculative(
                 new_rows[i].append(nxt)
             # accepted nodes' token ids ARE the committed history
             session.record_history_ids(committed_rows)
+            if prune_threshold is not None:
+                pending_spans = _per_span_accepts(
+                    pending_accept, keep, len(session._spans)
+                )
 
         if pending_accept is not None:
-            await session.send_accept(pending_accept)
+            await session.send_accept(pending_accept, per_span=pending_spans)
         # rows converged to exactly max_new_tokens; every returned token
         # except each row's final bonus is committed server-side
         return np.asarray([rows[i] + new_rows[i] for i in range(b)])

@@ -106,6 +106,7 @@ class BlockServer:
         tp: int = 1,
         kv_quant: str | None = None,  # "int4" -> quantized KV arena
     ):
+        self.model_dir = model_dir
         if params is None:
             from bloombee_tpu.models.checkpoint import load_span_params
 
@@ -161,6 +162,10 @@ class BlockServer:
         )
         self.compute = ComputeQueue()
         self.peers = _PeerPool()
+        # mid-chain draft-tree pruning (reference speculative_pruner/): the
+        # MidLMHead weight lazy-loads from the checkpoint's lm_head
+        self._pruner_manager = None
+        self._pruner_unavailable = False
         self._sessions: dict[str, _Session] = {}
         self._pending_pushes: dict[str, list] = {}
         self.pending_push_ttl = 30.0
@@ -406,7 +411,11 @@ class BlockServer:
             # commit each row to its true length (frees the padding's pages).
             # Safe right after dispatch: slots were assigned in-queue, and
             # freed pages can only be overwritten by later-dispatched steps.
-            self.manager.commit(handle, lengths=[int(x) for x in commit_lens])
+            # `handle` may be a row slice — align lengths to its rows.
+            lens = [int(x) for x in commit_lens]
+            if rows is not None:
+                lens = lens[rows[0]:rows[1]]
+            self.manager.commit(handle, lengths=lens)
         import time as _time
 
         t0 = _time.perf_counter()
@@ -418,6 +427,19 @@ class BlockServer:
             "t_dispatch_ms": t_dispatch_ms,
             "t_fetch_ms": t_fetch_ms,
         }
+
+        # mid-chain tree pruning: score this span's output with the MidLMHead
+        # and return only surviving rows + their indices (reference
+        # backend.py:395-410 last-block prune, :763-775 flatten kept rows)
+        keep = None
+        prune = meta.get("prune")
+        if prune is not None and tree_mask is not None:
+            keep = self._prune_tree(out, prune)
+            if keep is not None:
+                gather = np.where(keep >= 0, keep, 0)
+                out = np.stack(
+                    [out[i][gather[i]] for i in range(out.shape[0])]
+                )
 
         route = meta.get("route") or []
         reply = meta.get("reply", "tensor")
@@ -456,6 +478,8 @@ class BlockServer:
             for key in ("mb", "rows"):
                 if meta.get(key) is not None:
                     resp[key] = meta[key]
+            if keep is not None:
+                resp["keep"] = keep.tolist()
             await stream.send(resp, [out])
 
     def _compute_step(
@@ -487,6 +511,60 @@ class BlockServer:
                 session.id, hidden.shape[1], dt_ms,
             )
         return out, dt_ms
+
+    def _prune_tree(self, out: np.ndarray, prune: dict):
+        """Per-row keep indices from the MidLMHead over this span's output
+        hidden; None if no pruner weight is available (degrade to full)."""
+        mgr = self._ensure_pruner(float(prune.get("threshold", 0.05)))
+        if mgr is None:
+            return None
+        from bloombee_tpu.spec.tree import DraftTree
+
+        tokens = np.asarray(prune["tokens"], dtype=np.int64)  # [B, T]
+        parents = np.asarray(prune["parents"], dtype=np.int32)
+        max_keep = int(prune.get("max_keep", tokens.shape[1]))
+        mgr._pruner.max_keep = max_keep
+        bsz, t = tokens.shape
+        # one batched head call for every row's nodes (per-step hot path)
+        all_probs = mgr._head.probs(
+            np.asarray(out, dtype=np.float32).reshape(bsz * t, -1)
+        ).reshape(bsz, t, -1)
+        rows = []
+        for i in range(bsz):
+            tree = DraftTree(tokens=tokens[i], parents=parents)
+            # node 0 is the certain token: its "root" distribution is a
+            # one-hot so it always survives the threshold
+            root = np.zeros(all_probs.shape[2], dtype=np.float64)
+            root[int(tokens[i, 0])] = 1.0
+            rows.append(mgr._pruner.keep_indices(tree, all_probs[i], root))
+        return np.stack(rows)
+
+    def _ensure_pruner(self, threshold: float):
+        if self._pruner_unavailable:
+            return None
+        if self._pruner_manager is None:
+            if self.model_dir is None:
+                self._pruner_unavailable = True
+                return None
+            try:
+                from bloombee_tpu.models.checkpoint import load_client_params
+                from bloombee_tpu.spec.pruner import PrunerManager
+
+                client = load_client_params(
+                    self.model_dir, dtype=self.compute_dtype
+                )
+                mgr = PrunerManager(threshold=threshold)
+                mgr.ensure_head(
+                    client["lm_head"], client.get("norm"),
+                    self.spec.rms_norm_eps,
+                )
+                self._pruner_manager = mgr
+            except Exception as e:
+                logger.warning("pruner unavailable: %s", e)
+                self._pruner_unavailable = True
+                return None
+        self._pruner_manager._pruner.threshold = threshold
+        return self._pruner_manager
 
     async def _rpc_push(self, meta: dict, tensors) -> None:
         session = self._sessions.get(meta["session_id"])

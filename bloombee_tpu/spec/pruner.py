@@ -29,21 +29,31 @@ from bloombee_tpu.spec.tree import DraftTree
 class MidLMHead:
     """Small linear head over mid-network hidden states (trainable online in
     the reference via lm_head_trainer; here initialized from the real LM
-    head or randomly and updatable by assignment)."""
+    head or randomly and updatable by assignment). An optional RMS norm
+    weight is applied first ("logit lens"): raw mid-layer hidden has a
+    growing scale that makes untrained-head softmaxes uninformative."""
 
-    def __init__(self, weight: jax.Array):  # [D, V]
-        self.weight = weight
+    def __init__(self, weight: jax.Array, norm=None, eps: float = 1e-5):
+        self.weight = weight  # [D, V]
+        self.norm = norm  # [D] or None
+        self.eps = eps
 
     @staticmethod
     @jax.jit
-    def _probs(weight, hidden):
+    def _probs(weight, norm, eps, hidden):
+        if norm is not None:
+            from bloombee_tpu.ops import rms_norm
+
+            hidden = rms_norm(hidden, norm, eps)
         logits = (hidden @ weight).astype(jnp.float32)
         return jax.nn.softmax(logits, axis=-1)
 
     def probs(self, hidden: np.ndarray) -> np.ndarray:
         """hidden [N, D] -> softmax rows [N, V]; per-token gathering against
         the parent's distribution happens in the pruner."""
-        return np.asarray(self._probs(self.weight, jnp.asarray(hidden)))
+        return np.asarray(
+            self._probs(self.weight, self.norm, self.eps, jnp.asarray(hidden))
+        )
 
 
 @dataclasses.dataclass
@@ -100,9 +110,15 @@ class PrunerManager:
         self._head: MidLMHead | None = None
         self._pruner = SimpleProbabilityPruner(threshold=threshold)
 
-    def ensure_head(self, lm_head_weight) -> MidLMHead:
+    def ensure_head(
+        self, lm_head_weight, norm=None, eps: float = 1e-5
+    ) -> MidLMHead:
         if self._head is None:
-            self._head = MidLMHead(jnp.asarray(lm_head_weight))
+            self._head = MidLMHead(
+                jnp.asarray(lm_head_weight),
+                None if norm is None else jnp.asarray(norm),
+                eps,
+            )
         return self._head
 
     def prune(

@@ -70,6 +70,27 @@ def tree_attention_mask(tree: DraftTree) -> np.ndarray:
     return tree.ancestors_or_self()
 
 
+def pruned_step_arrays(
+    mask: np.ndarray,  # [B, T, T] full tree mask
+    depths: np.ndarray,  # [B, T]
+    keep: np.ndarray,  # [B, K] kept linear indices, -1 padded
+) -> tuple[np.ndarray, np.ndarray]:
+    """Tree mask + depths restricted to kept nodes per row (what a pruning
+    span forwards downstream — reference block_functions.py:423-531 works in
+    the inverse direction, restoring pruned rows). Padded entries get an
+    all-False mask row (they still see the committed prefix in the step) and
+    depth 0."""
+    b, k = keep.shape
+    mask_k = np.zeros((b, k, k), dtype=bool)
+    depths_k = np.zeros((b, k), dtype=np.int32)
+    for i in range(b):
+        valid = np.nonzero(keep[i] >= 0)[0]
+        idx = keep[i][valid]
+        mask_k[i][np.ix_(valid, valid)] = mask[i][np.ix_(idx, idx)]
+        depths_k[i][valid] = depths[i][idx]
+    return mask_k, depths_k
+
+
 def chain_tree(tokens: np.ndarray) -> DraftTree:
     """Degenerate tree: a single chain (classic draft-K speculative decode)."""
     t = len(tokens)

@@ -22,6 +22,7 @@ def accept_greedy(
     tree: DraftTree,
     root_logits: np.ndarray,  # [V]
     logits: np.ndarray,  # [T, V]
+    verifiable: np.ndarray | None = None,  # [T] bool: node has real logits
 ) -> tuple[list[int], int]:
     """Returns (accepted_node_indices in path order, bonus_token).
 
@@ -29,6 +30,11 @@ def accept_greedy(
     required token; descend into the child carrying it, else stop. The bonus
     token is the target's argmax after the last accepted node (or at the
     root if nothing was accepted).
+
+    `verifiable` marks nodes whose logits are real (mid-chain pruning drops
+    the rest — reference backend.py:395-410). Descent stops at an
+    unverifiable child, but no token is lost: the bonus IS that child's
+    token (the argmax that selected it).
     """
     accepted: list[int] = []
     cur = -1  # -1 = root level (children of the last committed token)
@@ -38,7 +44,9 @@ def accept_greedy(
         children = tree.children_of(cur)
         nxt = -1
         for c in children:
-            if int(tree.tokens[c]) == want:
+            if int(tree.tokens[c]) == want and (
+                verifiable is None or verifiable[c]
+            ):
                 nxt = int(c)
                 break
         if nxt < 0:

@@ -304,3 +304,86 @@ def test_e2e_speculative_failover_ragged_replay(tmp_path):
         await reg.stop()
 
     asyncio.run(run())
+
+
+def test_e2e_speculative_pruned_midchain(tmp_path):
+    """Mid-chain pruning (reference backend.py:395-410 + client restore):
+    span 0 keeps only MidLMHead survivors, downstream spans verify the
+    smaller tree, the client restores kept logits to original indices —
+    tokens stay exactly equal to plain greedy."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.client.speculative import generate_speculative
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "model")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s1 = BlockServer(model_uid="m", start=0, end=2, model_dir=d,
+                         registry=rc(), compute_dtype=jnp.float32,
+                         num_pages=256, page_size=4)
+        s2 = BlockServer(model_uid="m", start=2, end=3, model_dir=d,
+                         registry=rc(), compute_dtype=jnp.float32,
+                         num_pages=256, page_size=4)
+        await s1.start()
+        await s2.start()
+
+        keeps = []
+        orig_prune = s1._prune_tree
+
+        def spy(out, prune):
+            k = orig_prune(out, prune)
+            keeps.append(k)
+            return k
+
+        s1._prune_tree = spy
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, rc(), model_uid="m", use_push=False
+        )
+        drafter = GreedyTreeDrafter(
+            LocalJaxDraftModel.from_dir(d), branching=(2, 2)
+        )
+        rng = np.random.default_rng(5)
+        input_ids = rng.integers(0, 128, size=(2, 5))
+        n_new = 8
+
+        spec_ids = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=n_new,
+            prune_threshold=0.45,
+        )
+        assert spec_ids.shape == (2, 5 + n_new)
+        plain_ids = await model.generate(input_ids, max_new_tokens=n_new)
+        np.testing.assert_array_equal(spec_ids, plain_ids)
+        # the pruner actually ran and dropped nodes in at least one round
+        assert keeps, "server-side pruner never invoked"
+        assert any(
+            k is not None and (k < 0).any() for k in keeps
+        ), "pruner never dropped a node (threshold too low for this test)"
+
+        await s1.stop()
+        await s2.stop()
+        await reg.stop()
+
+    asyncio.run(run())
