@@ -16,11 +16,12 @@ import random
 import time
 
 from bloombee_tpu.swarm.data import RemoteSpanInfo
+from bloombee_tpu.swarm.ping import DEFAULT_RTT_S, PingAggregator
 from bloombee_tpu.swarm.spans import compute_spans
 
 logger = logging.getLogger(__name__)
 
-DEFAULT_HOP_COST_S = 0.01  # client<->server / server->server RTT estimate
+DEFAULT_HOP_COST_S = DEFAULT_RTT_S  # until a peer has been measured
 CACHE_MISSING_PENALTY_S = 10.0  # reference: +10s if cache won't fit
 
 
@@ -51,6 +52,9 @@ class RemoteSequenceManager:
         self._banned_until: dict[str, float] = {}
         self._last_update = 0.0
         self._rng = rng or random.Random()
+        # measured client->server RTTs (reference ping.py PingAggregator);
+        # server->server edges come from announced next_pings
+        self.pinger = PingAggregator()
 
     # ---------------------------------------------------------------- updates
     async def update(self, force: bool = False) -> None:
@@ -62,6 +66,20 @@ class RemoteSequenceManager:
         )
         self.spans = compute_spans(infos)
         self._last_update = now
+        banned_now = {
+            p for p, until in self._banned_until.items()
+            if until > time.monotonic()
+        }
+        to_ping = [
+            (s.peer_id, s.server_info.host, s.server_info.port)
+            for s in self.spans.values()
+            if s.peer_id not in banned_now
+            and self.pinger.needs_measure(s.peer_id)
+        ]
+        if to_ping:
+            # timeboxed: recovery and session-open must not stall on a dead
+            # peer (its failed ping would only record FAILED_RTT_S anyway)
+            await self.pinger.measure_many(to_ping, overall_timeout=2.0)
 
     def ban_peer(self, peer_id: str) -> None:
         """reference: on_request_failure + ban_timeout backoff."""
@@ -83,18 +101,21 @@ class RemoteSequenceManager:
         end: int | None = None,
         mode: str = "min_latency",
         cache_tokens_needed: int | None = None,
+        relay: bool = False,  # True: hops go server->client->server
     ) -> list[RemoteSpanInfo]:
         end = self.num_blocks if end is None else end
         spans = self._active_spans()
         if mode == "max_throughput":
             return self._random_route(spans, start, end)
-        return self._dijkstra_route(spans, start, end, cache_tokens_needed)
+        return self._dijkstra_route(
+            spans, start, end, cache_tokens_needed, relay
+        )
 
-    def _span_cost(
+    def _compute_cost(
         self, span: RemoteSpanInfo, blocks: int, cache_tokens_needed
     ) -> float:
         rps = span.server_info.inference_rps or span.server_info.throughput or 1.0
-        cost = DEFAULT_HOP_COST_S + blocks / max(rps, 1e-6)
+        cost = blocks / max(rps, 1e-6)
         left = span.server_info.cache_tokens_left
         if (
             cache_tokens_needed is not None
@@ -104,46 +125,81 @@ class RemoteSequenceManager:
             cost += CACHE_MISSING_PENALTY_S
         return cost
 
+    def _hop_cost(
+        self, prev_peer: str | None, span: RemoteSpanInfo, relay: bool
+    ) -> float:
+        """Network edge cost: client->server from measured RTTs; server->
+        server from the previous server's announced next_pings (reference
+        _build_inference_graph, sequence_manager.py:235-296), falling back
+        to the client's measurement of the target. Relay sessions
+        (use_push=False) route every hop through the client, so announced
+        server->server RTTs don't apply — the client's own RTT does."""
+        if prev_peer is not None and not relay:
+            prev = self.spans.get(prev_peer)
+            next_pings = (
+                prev.server_info.next_pings if prev is not None else None
+            ) or {}
+            if span.peer_id in next_pings:
+                return float(next_pings[span.peer_id])
+        return self.pinger.get(span.peer_id, DEFAULT_HOP_COST_S)
+
     def _dijkstra_route(
-        self, spans, start: int, end: int, cache_tokens_needed
+        self, spans, start: int, end: int, cache_tokens_needed,
+        relay: bool = False,
     ) -> list[RemoteSpanInfo]:
-        # nodes = block boundaries; a span [s, e) contributes edges b -> e for
-        # every b in [s, e) (a server can serve a suffix of its span)
-        edges: dict[int, list[tuple[int, float, RemoteSpanInfo]]] = {}
+        # states = (block boundary, arriving peer); a span [s, e) contributes
+        # edges (b, p) -> (e, span.peer) for every b in [s, e) (a server can
+        # serve a suffix of its span), costed with the real measured RTT for
+        # the p -> span hop plus the span's compute time
+        spans_by_block: dict[int, list[RemoteSpanInfo]] = {}
         for span in spans:
             s, e = max(span.start, start), min(span.end, end)
             for b in range(s, e):
-                edges.setdefault(b, []).append(
-                    (e, self._span_cost(span, e - b, cache_tokens_needed), span)
-                )
-        dist = {start: 0.0}
-        prev: dict[int, tuple[int, RemoteSpanInfo]] = {}
-        heap = [(0.0, start)]
+                spans_by_block.setdefault(b, []).append(span)
+        import itertools
+
+        tie = itertools.count()  # heap tiebreaker (peer ids aren't ordered)
+        src = (start, None)
+        dist: dict[tuple, float] = {src: 0.0}
+        prev: dict[tuple, tuple[tuple, RemoteSpanInfo]] = {}
+        heap: list = [(0.0, next(tie), start, None)]
+        goal: tuple | None = None
         while heap:
-            d, node = heapq.heappop(heap)
-            if node == end:
+            d, _, node_b, node_p = heapq.heappop(heap)
+            state = (node_b, node_p)
+            if node_b == end:
+                goal = state
                 break
-            if d > dist.get(node, float("inf")):
+            if d > dist.get(state, float("inf")):
                 continue
-            for nxt, cost, span in edges.get(node, []):
+            for span in spans_by_block.get(node_b, []):
+                e = min(span.end, end)
+                cost = self._hop_cost(node_p, span, relay) + self._compute_cost(
+                    span, e - node_b, cache_tokens_needed
+                )
+                nxt = (e, span.peer_id)
                 nd = d + cost
                 if nd < dist.get(nxt, float("inf")):
                     dist[nxt] = nd
-                    prev[nxt] = (node, span)
-                    heapq.heappush(heap, (nd, nxt))
-        if end not in prev and start != end:
+                    prev[nxt] = (state, span)
+                    heapq.heappush(heap, (nd, next(tie), e, span.peer_id))
+        if goal is None:
+            if start == end:
+                return []
             covered = {b for s in spans for b in range(s.start, s.end)}
             missing = [b for b in range(start, end) if b not in covered]
             raise MissingBlocksError(missing or list(range(start, end)))
         # walk back
         route: list[RemoteSpanInfo] = []
-        node = end
-        while node != start:
-            pnode, span = prev[node]
+        state = goal
+        while state != src:
+            pstate, span = prev[state]
             route.append(
-                RemoteSpanInfo(span.peer_id, pnode, node, span.server_info)
+                RemoteSpanInfo(
+                    span.peer_id, pstate[0], state[0], span.server_info
+                )
             )
-            node = pnode
+            state = pstate
         return list(reversed(route))
 
     def _random_route(self, spans, start: int, end: int):

@@ -104,7 +104,8 @@ class InferenceSession:
     async def __aenter__(self) -> "InferenceSession":
         await self.manager.update(force=True)
         route = self.manager.make_sequence(
-            cache_tokens_needed=self.batch_size * self.max_length
+            cache_tokens_needed=self.batch_size * self.max_length,
+            relay=not self.use_push,
         )
         self._spans = [await self._open_span(s) for s in route]
         return self
@@ -462,7 +463,8 @@ class InferenceSession:
         await self.close()
         await self.manager.update(force=True)
         route = self.manager.make_sequence(
-            cache_tokens_needed=self.batch_size * self.max_length
+            cache_tokens_needed=self.batch_size * self.max_length,
+            relay=not self.use_push,
         )
         spans: list[_SpanSession] = []
         try:

@@ -166,6 +166,13 @@ class BlockServer:
         # MidLMHead weight lazy-loads from the checkpoint's lm_head
         self._pruner_manager = None
         self._pruner_unavailable = False
+        self._pruner_lock: asyncio.Lock | None = None
+        # measured RTTs to servers of the block after this span, announced
+        # in ServerInfo.next_pings for routing (reference server.py:1000-1007
+        # ModuleAnnouncerThread next-block pings)
+        from bloombee_tpu.swarm.ping import PingAggregator
+
+        self.next_pings = PingAggregator()
         self._sessions: dict[str, _Session] = {}
         self._pending_pushes: dict[str, list] = {}
         self.pending_push_ttl = 30.0
@@ -223,6 +230,7 @@ class BlockServer:
             start_block=self.start_block,
             end_block=self.end_block,
             wire_dtype=self.wire_dtype,
+            next_pings=self.next_pings.to_wire() or None,
         )
 
     async def _announce(self, state: ServerState) -> None:
@@ -240,9 +248,36 @@ class BlockServer:
         while True:
             await asyncio.sleep(self.announce_period)
             try:
+                # announce FIRST (liveness must not wait on pings — a slow
+                # successor would expire our registry record); the pings
+                # measured after ride the NEXT announce
                 await self._announce(ServerState.ONLINE)
+                await asyncio.wait_for(
+                    self._measure_next_pings(), self.announce_period
+                )
+            except asyncio.TimeoutError:
+                pass
             except Exception as e:
                 logger.warning("announce failed: %s", e)
+
+    async def _measure_next_pings(self) -> None:
+        """Ping servers holding the block right after this span so routing
+        can cost our push hop with real RTTs."""
+        try:
+            infos = await self.registry.get_module_infos(
+                self.model_uid, [self.end_block]
+            )
+        except Exception:
+            return
+        if not infos or not infos[0].servers:
+            return
+        peers = [
+            (sid, info.host, info.port)
+            for sid, info in infos[0].servers.items()
+            if sid != self.server_id and self.next_pings.needs_measure(sid)
+        ][:8]
+        if peers:
+            await self.next_pings.measure_many(peers)
 
     # ------------------------------------------------------------------- RPCs
     async def _rpc_info(self, meta: dict, tensors):
@@ -434,6 +469,10 @@ class BlockServer:
         keep = None
         prune = meta.get("prune")
         if prune is not None and tree_mask is not None:
+            # first use loads the checkpoint's lm_head OFF the event loop
+            # (a synchronous multi-GB safetensors read would stall every
+            # session and the liveness announce)
+            await self._ensure_pruner_loaded()
             keep = self._prune_tree(out, prune)
             if keep is not None:
                 gather = np.where(keep >= 0, keep, 0)
@@ -539,30 +578,39 @@ class BlockServer:
             rows.append(mgr._pruner.keep_indices(tree, all_probs[i], root))
         return np.stack(rows)
 
-    def _ensure_pruner(self, threshold: float):
-        if self._pruner_unavailable:
-            return None
-        if self._pruner_manager is None:
-            if self.model_dir is None:
-                self._pruner_unavailable = True
-                return None
-            try:
-                from bloombee_tpu.models.checkpoint import load_client_params
-                from bloombee_tpu.spec.pruner import PrunerManager
+    async def _ensure_pruner_loaded(self) -> None:
+        if self._pruner_manager is not None or self._pruner_unavailable:
+            return
+        if self._pruner_lock is None:
+            self._pruner_lock = asyncio.Lock()
+        async with self._pruner_lock:
+            if self._pruner_manager is None and not self._pruner_unavailable:
+                await asyncio.to_thread(self._load_pruner)
 
-                client = load_client_params(
-                    self.model_dir, dtype=self.compute_dtype
-                )
-                mgr = PrunerManager(threshold=threshold)
-                mgr.ensure_head(
-                    client["lm_head"], client.get("norm"),
-                    self.spec.rms_norm_eps,
-                )
-                self._pruner_manager = mgr
-            except Exception as e:
-                logger.warning("pruner unavailable: %s", e)
-                self._pruner_unavailable = True
-                return None
+    def _load_pruner(self) -> None:
+        if self.model_dir is None:
+            self._pruner_unavailable = True
+            return
+        try:
+            from bloombee_tpu.models.checkpoint import load_client_params
+            from bloombee_tpu.spec.pruner import PrunerManager
+
+            client = load_client_params(
+                self.model_dir, dtype=self.compute_dtype
+            )
+            mgr = PrunerManager()
+            mgr.ensure_head(
+                client["lm_head"], client.get("norm"),
+                self.spec.rms_norm_eps,
+            )
+            self._pruner_manager = mgr
+        except Exception as e:
+            logger.warning("pruner unavailable: %s", e)
+            self._pruner_unavailable = True
+
+    def _ensure_pruner(self, threshold: float):
+        if self._pruner_manager is None:
+            return None
         self._pruner_manager._pruner.threshold = threshold
         return self._pruner_manager
 

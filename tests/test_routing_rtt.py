@@ -1,0 +1,126 @@
+"""Measured-RTT routing (reference ping.py:59-100 + sequence_manager
+_build_inference_graph:235-296): client->server edges from EMA pings,
+server->server edges from announced next_pings."""
+
+import asyncio
+
+import numpy as np
+
+from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+from bloombee_tpu.swarm.data import RemoteSpanInfo, ServerInfo
+
+
+def _span(peer, start, end, rps=10.0, next_pings=None):
+    return RemoteSpanInfo(
+        peer, start, end,
+        ServerInfo(
+            host="127.0.0.1", port=1, throughput=rps, inference_rps=rps,
+            start_block=start, end_block=end, next_pings=next_pings,
+        ),
+    )
+
+
+def _manager(spans):
+    m = RemoteSequenceManager(registry=None, model_uid="m", num_blocks=2)
+    m.spans = {s.peer_id: s for s in spans}
+    return m
+
+
+def test_slow_pinged_peer_avoided():
+    """Two identical servers for the whole range; the one with a high
+    measured RTT loses."""
+    m = _manager([_span("fast", 0, 2), _span("slow", 0, 2)])
+    m.pinger.record("fast", 0.002)
+    m.pinger.record("slow", 0.500)
+    for _ in range(5):
+        route = m.make_sequence()
+        assert [s.peer_id for s in route] == ["fast"]
+
+
+def test_next_pings_steer_second_hop():
+    """First span's announced next_pings decide the second span even though
+    the client's own pings say otherwise."""
+    first = _span("a", 0, 1, next_pings={"c2": 0.001, "c1": 0.400})
+    m = _manager([first, _span("c1", 1, 2), _span("c2", 1, 2)])
+    # client's own pings would prefer c1 — the announced server->server RTT
+    # must win for the a->X hop
+    m.pinger.record("a", 0.002)
+    m.pinger.record("c1", 0.001)
+    m.pinger.record("c2", 0.300)
+    route = m.make_sequence()
+    assert [s.peer_id for s in route] == ["a", "c2"]
+
+
+def test_rtt_vs_compute_tradeoff():
+    """A slower-RTT server that covers both blocks beats two fast-RTT hops
+    when the hop cost dominates (fewer hops, same compute)."""
+    m = _manager([
+        _span("whole", 0, 2, rps=10.0),
+        _span("h1", 0, 1, rps=10.0),
+        _span("h2", 1, 2, rps=10.0),
+    ])
+    m.pinger.record("whole", 0.050)
+    m.pinger.record("h1", 0.030)
+    m.pinger.record("h2", 0.030)
+    route = m.make_sequence()
+    # whole: 0.05 + 0.2 compute; h1+h2: 0.03+0.1 + 0.03+0.1 = 0.26
+    assert [s.peer_id for s in route] == ["whole"]
+
+
+def test_e2e_pings_measured_and_next_pings_announced(tmp_path):
+    """Live swarm: the client measures real RTTs on update, and a server
+    announces next_pings for its successor block's servers."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
+        max_position_embeddings=64, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(config).eval().save_pretrained(
+        tmp_path, safe_serialization=True
+    )
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s1 = BlockServer(model_uid="m", start=0, end=1,
+                         model_dir=str(tmp_path), registry=rc(),
+                         compute_dtype=jnp.float32, num_pages=16,
+                         page_size=4, announce_period=0.2)
+        s2 = BlockServer(model_uid="m", start=1, end=2,
+                         model_dir=str(tmp_path), registry=rc(),
+                         compute_dtype=jnp.float32, num_pages=16,
+                         page_size=4, announce_period=0.2)
+        await s1.start()
+        await s2.start()
+        await asyncio.sleep(0.6)  # let announce loops ping + re-announce
+
+        manager = RemoteSequenceManager(rc(), "m", 2)
+        await manager.update(force=True)
+        # client measured both servers
+        assert manager.pinger.get(s1.server_id, -1) > 0
+        assert manager.pinger.get(s2.server_id, -1) > 0
+        # s1 announced a measured RTT toward s2 (its successor block)
+        info1 = manager.spans[s1.server_id].server_info
+        assert info1.next_pings and s2.server_id in info1.next_pings
+        assert 0 < info1.next_pings[s2.server_id] < 1.0
+        route = manager.make_sequence()
+        assert [s.peer_id for s in route] == [s1.server_id, s2.server_id]
+
+        await s1.stop()
+        await s2.stop()
+        await reg.stop()
+
+    asyncio.run(run())
