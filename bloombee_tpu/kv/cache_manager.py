@@ -85,16 +85,30 @@ class CacheManager:
         head_dim: int,
         dtype=None,
         quant: str | None = None,  # None -> BBTPU_KV_QUANT env default
+        hetero_spec=None,  # ModelSpec with per-layer geometry (gemma-4)
+        start_block: int = 0,
     ):
         dtype = dtype or jnp.bfloat16
         if quant is None:
             quant = env.get("BBTPU_KV_QUANT")
         self.quant = None if quant in (None, "none") else quant
         self.table = PagedKVTable(num_pages, page_size)
-        self.arena = arena_ops.make_arena(
-            num_layers, num_pages, page_size, n_kv_heads, head_dim, dtype,
-            quant=self.quant,
-        )
+        if hetero_spec is not None and hetero_spec.heterogeneous:
+            if self.quant:
+                raise ValueError(
+                    "int4 KV + heterogeneous head_dim not supported together"
+                )
+            from bloombee_tpu.runtime.hetero import make_hetero_arena
+
+            self.arena = make_hetero_arena(
+                hetero_spec, num_layers, start_block, num_pages, page_size,
+                dtype,
+            )
+        else:
+            self.arena = arena_ops.make_arena(
+                num_layers, num_pages, page_size, n_kv_heads, head_dim,
+                dtype, quant=self.quant,
+            )
         self.num_layers = num_layers
         self.page_size = page_size
         self.capacity_tokens = num_pages * page_size
@@ -248,7 +262,7 @@ class CacheManager:
         from bloombee_tpu.runtime.executor import next_pow2
 
         n = next_pow2(len(src), floor=4)
-        oob = self.arena["k"].shape[1]
+        oob = self.capacity_tokens  # out-of-bounds slot => dropped scatter
         src_p = np.zeros((n,), np.int32)  # padded gathers read slot 0
         dst_p = np.full((n,), oob, np.int32)  # padded scatters are dropped
         src_p[: len(src)] = src
@@ -270,7 +284,8 @@ class CacheManager:
         slots = self.table.prefix_slots(seq_id, committed_only=False)
         state = self.table.seq(seq_id)
 
-        if self.quant is None and env.get("BBTPU_PARK_QUANT"):
+        hetero = isinstance(self.arena["k"], tuple)
+        if self.quant is None and not hetero and env.get("BBTPU_PARK_QUANT"):
             # dense arena, quantized parking: quantize the still-device-
             # resident slice FIRST so only the int4 planes cross the link —
             # 4x less host DRAM and d2h transfer (the host-side half of the

@@ -99,7 +99,12 @@ def load_span_params(
         for adapter in adapters:
             params = adapter.merge_into(params, i)
         layers.append(params)
-    return stack_params(layers), family.spec_from_config_dict(reader.config)
+    spec = family.spec_from_config_dict(reader.config)
+    if spec.heterogeneous:
+        # per-layer shapes differ (gemma-4): no stacking — the hetero span
+        # step unrolls over a tuple of per-layer param dicts
+        return tuple(layers), spec
+    return stack_params(layers), spec
 
 
 class LoraAdapter:

@@ -92,3 +92,77 @@ register_family(
         client_loader=_load_client,
     )
 )
+
+
+# --------------------------------------------------------------- gemma3
+def gemma3_spec_from_hf(config: Any) -> ModelSpec:
+    """Gemma3 text tower: gemma2 structure + per-head q/k RMSNorm, no
+    softcaps, and sliding layers roped with rope_local_base_freq.
+    Multimodal gemma3 bundles nest the tower under text_config."""
+    import dataclasses
+    from types import SimpleNamespace
+
+    text = getattr(config, "text_config", None)
+    if text is not None:
+        config = SimpleNamespace(**text) if isinstance(text, dict) else text
+    base = gemma2_spec_from_hf(config)
+    return dataclasses.replace(
+        base,
+        family="gemma3",
+        qk_norm=True,
+        logits_soft_cap=0.0,
+        attn_logit_softcap=0.0,
+        rope_theta=getattr(config, "rope_theta", 1_000_000.0),
+        rope_local_theta=getattr(config, "rope_local_base_freq", 10_000.0),
+        sliding_window=getattr(config, "sliding_window", 512),
+    )
+
+
+def _gemma3_prefix(reader) -> str:
+    """Text-only checkpoints use model.*; multimodal bundles nest the tower
+    under language_model.model.*."""
+    if reader.has("model.embed_tokens.weight"):
+        return "model"
+    return "language_model.model"
+
+
+def _load_block_gemma3(reader, layer_idx: int, dtype=None) -> dict:
+    base = _gemma3_prefix(reader)
+    p = f"{base}.layers.{layer_idx}"
+    params = {}
+    for ln in _NORMS:
+        params[ln] = 1.0 + _t(reader, f"{p}.{ln}.weight", dtype)
+    for proj in ("q", "k", "v", "o"):
+        params[f"{proj}_proj"] = _t(
+            reader, f"{p}.self_attn.{proj}_proj.weight", dtype
+        ).T
+    for proj in ("gate", "up", "down"):
+        params[f"{proj}_proj"] = _t(
+            reader, f"{p}.mlp.{proj}_proj.weight", dtype
+        ).T
+    params["q_norm"] = 1.0 + _t(
+        reader, f"{p}.self_attn.q_norm.weight", dtype
+    )
+    params["k_norm"] = 1.0 + _t(
+        reader, f"{p}.self_attn.k_norm.weight", dtype
+    )
+    return params
+
+
+def _load_client_gemma3(reader, dtype=None) -> dict:
+    base = _gemma3_prefix(reader)
+    embed = _t(reader, f"{base}.embed_tokens.weight", dtype)
+    return {
+        "embed": embed,
+        "norm": 1.0 + _t(reader, f"{base}.norm.weight", dtype),
+        "lm_head": embed.T,
+    }
+
+
+for _name in ("gemma3", "gemma3_text"):
+    register_family(
+        Family(
+            _name, gemma3_spec_from_hf, loader=_load_block_gemma3,
+            client_loader=_load_client_gemma3,
+        )
+    )

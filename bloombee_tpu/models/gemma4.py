@@ -1,0 +1,123 @@
+"""Gemma-4 family: heterogeneous per-layer attention geometry.
+
+Reference: /root/reference/src/bloombee/models/gemma4/ + server/backend.py
+:243-306. Key traits beyond the gemma2/3 lineage:
+- `layer_types` alternates sliding/full attention; FULL layers use
+  `global_head_dim` (e.g. 512 vs 256) and `num_global_key_value_heads`,
+  so per-layer KV slabs have per-layer shapes (runtime/hetero.py).
+- Full layers alias V to K (`attention_k_eq_v`): one shared K=V projection,
+  no v_proj weight.
+- Sliding layers rope with `rope_local_base_freq`; full layers with
+  `rope_theta`.
+- Checkpoints are saved by the multimodal wrapper, so every weight lives
+  under `model.language_model.*` (reference gemma4/config.py block_prefix).
+
+Gemma norms store zero-centered weights; converted to (1 + w) at load.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+from bloombee_tpu.models.auto import Family, register_family
+from bloombee_tpu.models.checkpoint import read_tensor as _t
+from bloombee_tpu.models.spec import ModelSpec
+
+_PREFIX = "model.language_model"
+
+_NORMS = (
+    "input_layernorm",
+    "post_attention_layernorm",
+    "pre_feedforward_layernorm",
+    "post_feedforward_layernorm",
+)
+
+
+def gemma4_spec_from_hf(config: Any) -> ModelSpec:
+    # published checkpoints are multimodal bundles: the text tower's
+    # geometry nests under text_config (reference gemma4/config.py
+    # documents exactly this trap)
+    text = getattr(config, "text_config", None)
+    if text is not None:
+        from types import SimpleNamespace
+
+        config = (
+            SimpleNamespace(**text) if isinstance(text, dict) else text
+        )
+    layer_types = getattr(config, "layer_types", None)
+    if layer_types:
+        pattern = tuple(
+            "sliding" if "sliding" in t else "full" for t in layer_types
+        )
+    else:
+        pattern = ("sliding", "full")
+    qpas = getattr(config, "query_pre_attn_scalar", None)
+    return ModelSpec(
+        family="gemma4",
+        hidden_size=config.hidden_size,
+        intermediate_size=config.intermediate_size,
+        num_attention_heads=config.num_attention_heads,
+        num_key_value_heads=config.num_key_value_heads,
+        head_dim=config.head_dim,
+        num_hidden_layers=config.num_hidden_layers,
+        vocab_size=config.vocab_size,
+        rms_norm_eps=getattr(config, "rms_norm_eps", 1e-6),
+        rope_theta=getattr(config, "rope_theta", 1_000_000.0),
+        rope_local_theta=getattr(config, "rope_local_base_freq", 10_000.0),
+        tie_word_embeddings=True,
+        layer_types=pattern,
+        sliding_window=getattr(config, "sliding_window", 1024),
+        attention_multiplier=qpas and qpas**-0.5,
+        embedding_multiplier=math.sqrt(config.hidden_size),
+        mlp_type="gelu_tanh_gated",
+        sandwich_norms=True,
+        qk_norm=bool(getattr(config, "use_qk_norm", True)),
+        global_head_dim=getattr(config, "global_head_dim", 0) or 0,
+        num_global_key_value_heads=(
+            getattr(config, "num_global_key_value_heads", 0) or 0
+        ),
+        k_eq_v_full=bool(getattr(config, "attention_k_eq_v", False)),
+    )
+
+
+def _load_block(reader, layer_idx: int, dtype=None, spec=None) -> dict:
+    p = f"{_PREFIX}.layers.{layer_idx}"
+    params = {}
+    for ln in _NORMS:
+        params[ln] = 1.0 + _t(reader, f"{p}.{ln}.weight", dtype)
+    projs = ["q", "k", "o"]
+    # sliding layers have a real v_proj; full layers alias V to K when
+    # attention_k_eq_v (no v weight exists in the checkpoint)
+    if reader.has(f"{p}.self_attn.v_proj.weight"):
+        projs.append("v")
+    for proj in projs:
+        params[f"{proj}_proj"] = _t(
+            reader, f"{p}.self_attn.{proj}_proj.weight", dtype
+        ).T
+    for name, key in (("q_norm", "q_norm"), ("k_norm", "k_norm")):
+        full = f"{p}.self_attn.{key}.weight"
+        if reader.has(full):
+            params[name] = 1.0 + _t(reader, full, dtype)
+    for proj in ("gate", "up", "down"):
+        params[f"{proj}_proj"] = _t(
+            reader, f"{p}.mlp.{proj}_proj.weight", dtype
+        ).T
+    return params
+
+
+def _load_client(reader, dtype=None) -> dict:
+    embed = _t(reader, f"{_PREFIX}.embed_tokens.weight", dtype)
+    return {
+        "embed": embed,
+        "norm": 1.0 + _t(reader, f"{_PREFIX}.norm.weight", dtype),
+        "lm_head": embed.T,
+    }
+
+
+register_family(
+    Family(
+        "gemma4", gemma4_spec_from_hf, loader=_load_block,
+        client_loader=_load_client,
+    )
+)

@@ -51,6 +51,14 @@ class ModelSpec:
     mlp_type: str = "silu"  # "silu" | "gelu" | "gelu_tanh_gated"
     sandwich_norms: bool = False  # Gemma2-style post-attn/post-ffn norms
     attn_logit_softcap: float = 0.0
+    # Gemma-4-style heterogeneous attention geometry: full-attention layers
+    # use their own head_dim / kv head count (reference backend.py:243-306
+    # per-block-index KV descriptors) and may alias V to K
+    global_head_dim: int = 0  # 0 = same as head_dim
+    num_global_key_value_heads: int = 0  # 0 = same as num_key_value_heads
+    k_eq_v_full: bool = False  # full layers share one K=V projection
+    # this layer's resolved per-layer overrides (set by spec_for_layer)
+    k_eq_v: bool = False
 
     def window_for_layer(self, layer_idx: int) -> int:
         return (
@@ -67,6 +75,52 @@ class ModelSpec:
         if not self.layer_types:
             return "full"
         return self.layer_types[layer_idx % len(self.layer_types)]
+
+    # ------------------------------------------------ per-layer geometry
+    @property
+    def heterogeneous(self) -> bool:
+        """Layers differ in attention geometry (head_dim / kv heads)."""
+        return bool(
+            (self.global_head_dim and self.global_head_dim != self.head_dim)
+            or (
+                self.num_global_key_value_heads
+                and self.num_global_key_value_heads
+                != self.num_key_value_heads
+            )
+        )
+
+    def head_dim_for_layer(self, layer_idx: int) -> int:
+        if self.layer_type(layer_idx) == "full" and self.global_head_dim:
+            return self.global_head_dim
+        return self.head_dim
+
+    def kv_heads_for_layer(self, layer_idx: int) -> int:
+        if (
+            self.layer_type(layer_idx) == "full"
+            and self.num_global_key_value_heads
+        ):
+            return self.num_global_key_value_heads
+        return self.num_key_value_heads
+
+    def theta_for_layer(self, layer_idx: int) -> float:
+        """Sliding layers may use a local rope base (Gemma3/4 style)."""
+        if self.layer_type(layer_idx) == "sliding" and self.rope_local_theta:
+            return self.rope_local_theta
+        return self.rope_theta
+
+    def spec_for_layer(self, layer_idx: int) -> "ModelSpec":
+        """A uniform ModelSpec describing exactly this layer (static, so
+        per-layer variants are jit cache keys like the base spec)."""
+        full = self.layer_type(layer_idx) == "full"
+        return dataclasses.replace(
+            self,
+            head_dim=self.head_dim_for_layer(layer_idx),
+            num_key_value_heads=self.kv_heads_for_layer(layer_idx),
+            rope_theta=self.theta_for_layer(layer_idx),
+            k_eq_v=self.k_eq_v_full and full,
+            global_head_dim=0,
+            num_global_key_value_heads=0,
+        )
 
     @classmethod
     def from_hf_config(cls, config: Any) -> "ModelSpec":

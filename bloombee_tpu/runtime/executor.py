@@ -52,6 +52,10 @@ class SpanExecutor:
         mesh=None,  # jax.sharding.Mesh with a "tp" axis: TP-sharded serving
     ):
         self.mesh = mesh
+        if spec.heterogeneous and mesh is not None:
+            raise ValueError(
+                "TP serving + heterogeneous head_dim not supported together"
+            )
         if mesh is not None:
             from bloombee_tpu.parallel import serving as tp_serving
 
@@ -69,6 +73,7 @@ class SpanExecutor:
         )
         self.max_chunk_tokens = max_chunk_tokens
         self.compute_dtype = compute_dtype
+        self.start_block = start_block
         # ship hidden states over the host link at half width when computing
         # in bf16 (transfer latency/bandwidth is the bottleneck; SURVEY.md
         # section 3.3 timing decomposition)
@@ -152,7 +157,7 @@ class SpanExecutor:
         # equals T (tree shapes are already bucketed by the drafter)
         bb = next_pow2(b)
         tb = t if (t == 1 or tree_mask is not None) else next_pow2(t)
-        arena_tokens = self.manager.arena["k"].shape[1]
+        arena_tokens = self.manager.capacity_tokens
         pages_needed = int(
             max(-(-int(l) // self.page_size) for l in total_lens)
         )
@@ -195,6 +200,7 @@ class SpanExecutor:
         s_ctx = pb * self.page_size
         use_flash = bool(
             self.mesh is None  # Pallas kernels don't GSPMD-partition
+            and not self.spec.heterogeneous
             and tree_mask is None
             and tb >= 128
             and tb % 128 == 0
@@ -211,6 +217,29 @@ class SpanExecutor:
 
         arena = self.manager.arena
         payload = pack_step_payload(h_pad, plan)
+        if self.spec.heterogeneous:
+            from bloombee_tpu.runtime.hetero import span_step_hetero
+
+            out, new_k, new_v = span_step_hetero(
+                self.params,
+                arena["k"],
+                arena["v"],
+                jnp.asarray(payload),
+                jnp.asarray(tm_pad) if tm_pad is not None else None,
+                spec=spec,
+                b=bb,
+                t=tb,
+                page_size=self.page_size,
+                max_pages=pb,
+                use_tree_mask=tree_mask is not None,
+                start_block=self.start_block,
+                layer_active=tuple(int(x) for x in layer_active),
+            )
+            self.manager.arena = {"k": new_k, "v": new_v}
+            out = out[:b, :t]
+            if not fetch:
+                return out
+            return np.asarray(out).astype(self.transfer_dtype)
         if self.mesh is not None:
             from bloombee_tpu.parallel import serving as tp_serving
 

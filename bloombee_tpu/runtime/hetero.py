@@ -1,0 +1,118 @@
+"""Heterogeneous span step: per-layer attention geometry (Gemma-4 style).
+
+The stacked `lax.scan` in runtime/step.py requires every layer's params and
+KV slab to share shapes. Gemma-4 breaks that: full-attention layers use
+`global_head_dim` (512) and their own KV head count while sliding layers use
+the base geometry (reference server/backend.py:243-306 threads a per-block
+head_dim into the cache descriptors). Here the span unrolls at trace time —
+a Python loop over per-layer params and per-layer slabs inside one jit, each
+layer driven by its own static `spec_for_layer` — so XLA still sees one
+fused program per bucket, just without the scan's shape uniformity.
+
+The paged control plane is untouched: all layers share ONE PagedKVTable slot
+space; each layer simply owns a slab of its own [S_tot, Hkv_l, hd_l] shape
+(leading dim of 1 keeps every manager operation — reorder, park, unpark —
+uniform with stacked slabs).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.ops.rotary import rotary_cos_sin
+from bloombee_tpu.runtime.layer_body import layer_body
+from bloombee_tpu.runtime.step import unpack_plan
+
+
+def make_hetero_arena(
+    spec: ModelSpec,
+    num_layers: int,
+    start_block: int,
+    num_pages: int,
+    page_size: int,
+    dtype=jnp.bfloat16,
+) -> dict:
+    """Per-layer slabs [1, S_tot, Hkv_l, hd_l] as tuples (a jax pytree);
+    layer geometry indexed by ABSOLUTE block id (span offset matters)."""
+    s_tot = num_pages * page_size
+    ks, vs = [], []
+    for i in range(num_layers):
+        a = start_block + i
+        shape = (
+            1, s_tot, spec.kv_heads_for_layer(a), spec.head_dim_for_layer(a)
+        )
+        ks.append(jnp.zeros(shape, dtype))
+        vs.append(jnp.zeros(shape, dtype))
+    return {"k": tuple(ks), "v": tuple(vs)}
+
+
+def span_step_hetero_impl(
+    layer_params: tuple,  # per-layer param dicts
+    arena_k: tuple,  # per-layer [1, S_tot, Hkv_l, hd_l]
+    arena_v: tuple,
+    payload: jax.Array,  # pack_step_payload buffer
+    tree_mask: jax.Array | None = None,
+    *,
+    spec: ModelSpec,
+    b: int,
+    t: int,
+    page_size: int,
+    max_pages: int,
+    use_tree_mask: bool = False,
+    start_block: int = 0,
+    layer_active: tuple | None = None,  # static 0/1 per layer (sub-spans)
+):
+    """Unrolled heterogeneous span step; returns (hidden, arena_k, arena_v).
+
+    `layer_active` is static here (unlike the scanned path's traced gate):
+    inactive layers are simply skipped at trace time.
+    """
+    from bloombee_tpu.runtime.step import unpack_step_payload
+
+    num_layers = len(arena_k)
+    hidden, plan = unpack_step_payload(payload, b, t, spec.hidden_size)
+    slots, page_table, q_positions, total_lens, _ = unpack_plan(
+        plan, b, t, max_pages, num_layers
+    )
+    tm = tree_mask if use_tree_mask else None
+
+    # one rotary table per distinct (head_dim, theta)
+    cos_sin: dict[tuple, tuple] = {}
+    new_k, new_v = list(arena_k), list(arena_v)
+    for i in range(num_layers):
+        if layer_active is not None and not layer_active[i]:
+            continue
+        abs_idx = start_block + i
+        spec_l = spec.spec_for_layer(abs_idx)
+        key = (spec_l.head_dim, spec_l.rope_theta)
+        if key not in cos_sin:
+            cos, sin = rotary_cos_sin(
+                q_positions, spec_l.head_dim, spec_l.rope_theta
+            )
+            cos_sin[key] = (
+                cos.astype(hidden.dtype), sin.astype(hidden.dtype)
+            )
+        cos, sin = cos_sin[key]
+        hidden, k_l, v_l = layer_body(
+            spec_l, page_size, hidden, layer_params[i],
+            new_k[i][0], new_v[i][0], cos, sin, slots, page_table,
+            q_positions, total_lens, tm,
+            jnp.int32(spec.window_for_layer(abs_idx)),
+        )
+        new_k[i] = k_l[None]
+        new_v[i] = v_l[None]
+    return hidden, tuple(new_k), tuple(new_v)
+
+
+span_step_hetero = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "spec", "b", "t", "page_size", "max_pages", "use_tree_mask",
+        "start_block", "layer_active",
+    ),
+    donate_argnames=("arena_k", "arena_v"),
+)(span_step_hetero_impl)

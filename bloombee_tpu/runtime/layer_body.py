@@ -153,7 +153,12 @@ def layer_body(
     x = _norm(hidden, params, "input_layernorm", spec)
     q = _proj(x, params, "q_proj").reshape(b, t, h_heads, hd)
     k = _proj(x, params, "k_proj").reshape(b, t, kv_heads, hd)
-    v = _proj(x, params, "v_proj").reshape(b, t, kv_heads, hd)
+    if spec.k_eq_v:
+        # gemma-4 full-attention layers alias V to K (one shared
+        # projection; reference gemma4/block.py attention_k_eq_v)
+        v = k
+    else:
+        v = _proj(x, params, "v_proj").reshape(b, t, kv_heads, hd)
     if spec.qk_norm:
         q = rms_norm(q, params["q_norm"], spec.rms_norm_eps)
         k = rms_norm(k, params["k_norm"], spec.rms_norm_eps)

@@ -78,6 +78,23 @@ def pack_step_payload(h_pad, plan):
     return np.concatenate([h_pad.view(lane).ravel(), plan.view(lane).ravel()])
 
 
+def unpack_step_payload(payload: jax.Array, b: int, t: int, d: int):
+    """Device side of pack_step_payload: split one uint16/uint32 buffer back
+    into (hidden [b, t, d], plan int32). uint16 lanes are bf16 hidden +
+    int32 plan as low/high half pairs (little-endian, matching numpy views
+    on both CPU and TPU)."""
+    n_h = b * t * d
+    if payload.dtype == jnp.uint16:
+        hidden = lax.bitcast_convert_type(payload[:n_h], jnp.bfloat16)
+        plan = lax.bitcast_convert_type(
+            payload[n_h:].reshape(-1, 2), jnp.int32
+        )
+    else:
+        hidden = lax.bitcast_convert_type(payload[:n_h], jnp.float32)
+        plan = lax.bitcast_convert_type(payload[n_h:], jnp.int32)
+    return hidden.reshape(b, t, d), plan
+
+
 def span_step_packed_impl(
     stacked_params: dict,
     arena_k: jax.Array,
@@ -95,16 +112,7 @@ def span_step_packed_impl(
     use_flash: bool = False,
 ):
     """span_step over a pack_step_payload buffer (one h2d per step)."""
-    n_h = b * t * spec.hidden_size
-    if payload.dtype == jnp.uint16:
-        hidden = lax.bitcast_convert_type(payload[:n_h], jnp.bfloat16)
-        plan = lax.bitcast_convert_type(
-            payload[n_h:].reshape(-1, 2), jnp.int32
-        )
-    else:
-        hidden = lax.bitcast_convert_type(payload[:n_h], jnp.float32)
-        plan = lax.bitcast_convert_type(payload[n_h:], jnp.int32)
-    hidden = hidden.reshape(b, t, spec.hidden_size)
+    hidden, plan = unpack_step_payload(payload, b, t, spec.hidden_size)
     return span_step_impl(
         stacked_params, arena_k, arena_v, hidden, plan, tree_mask,
         spec=spec, page_size=page_size, max_pages=max_pages,
@@ -150,6 +158,16 @@ def span_step_impl(
     cos, sin = rotary_cos_sin(q_positions, spec.head_dim, spec.rope_theta)
     cos = cos.astype(hidden.dtype)
     sin = sin.astype(hidden.dtype)
+    if spec.rope_local_theta and spec.rope_local_theta != spec.rope_theta:
+        # gemma3-style: sliding layers rope with the local base frequency;
+        # the per-layer window (already riding the scan) selects the pair
+        cos_loc, sin_loc = rotary_cos_sin(
+            q_positions, spec.head_dim, spec.rope_local_theta
+        )
+        cos_loc = cos_loc.astype(hidden.dtype)
+        sin_loc = sin_loc.astype(hidden.dtype)
+    else:
+        cos_loc, sin_loc = cos, sin
 
     tm = tree_mask if use_tree_mask else None
     windows_arr = jnp.asarray(
@@ -158,10 +176,13 @@ def span_step_impl(
 
     def body(h, xs):
         params_l, k_l, v_l, active, window_l = xs
+        use_local = window_l > 0
+        cos_l = jnp.where(use_local, cos_loc, cos)
+        sin_l = jnp.where(use_local, sin_loc, sin)
 
         def run(h, k_l, v_l):
             return layer_body(
-                spec, page_size, h, params_l, k_l, v_l, cos, sin, slots,
+                spec, page_size, h, params_l, k_l, v_l, cos_l, sin_l, slots,
                 page_table, q_positions, total_lens, tm, window_l,
                 use_flash=use_flash,
             )

@@ -136,6 +136,8 @@ class BlockServer:
             head_dim=spec.head_dim,
             dtype=compute_dtype,
             quant=kv_quant,
+            hetero_spec=spec if spec.heterogeneous else None,
+            start_block=start,
         )
         mesh = None
         if tp > 1:
@@ -154,12 +156,15 @@ class BlockServer:
             mesh=mesh,
         )
         self.wire_dtype = name_for_dtype(self.executor.transfer_dtype)
-        from bloombee_tpu.runtime.training import TrainingExecutor
+        if spec.heterogeneous:
+            self.training = None  # hetero training path not implemented
+        else:
+            from bloombee_tpu.runtime.training import TrainingExecutor
 
-        self.training = TrainingExecutor(
-            params, spec, windows=self.executor.windows,
-            compute_dtype=compute_dtype,
-        )
+            self.training = TrainingExecutor(
+                params, spec, windows=self.executor.windows,
+                compute_dtype=compute_dtype,
+            )
         self.compute = ComputeQueue()
         self.peers = _PeerPool()
         # mid-chain draft-tree pruning (reference speculative_pruner/): the
@@ -648,6 +653,8 @@ class BlockServer:
     async def _rpc_forward(self, meta: dict, tensors):
         """Span forward without a session (training / one-shot),
         reference block_functions.py:247 run_rpc_forward."""
+        if self.training is None:
+            raise RuntimeError("training path unavailable for this family")
         hidden = np.asarray(tensors[0], dtype=np.float32)
         layers = self._resolve_layers(meta)
         out = await self.compute.submit(
@@ -658,6 +665,8 @@ class BlockServer:
     async def _rpc_backward(self, meta: dict, tensors):
         """Gradient w.r.t. span inputs (blocks frozen; backward recomputes
         the forward — reference block_functions.py:357 run_rpc_backward)."""
+        if self.training is None:
+            raise RuntimeError("training path unavailable for this family")
         hidden_in = np.asarray(tensors[0], dtype=np.float32)
         grad_out = np.asarray(tensors[1], dtype=np.float32)
         layers = self._resolve_layers(meta)
