@@ -43,6 +43,9 @@ def main(argv=None):
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel degree over local chips "
                         "(reference --tensor_parallel_devices)")
+    parser.add_argument("--warmup-batches", default="1",
+                        help="comma-separated batch buckets to pre-compile "
+                        "at startup ('' = skip)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
     logging.basicConfig(level=args.log_level)
@@ -91,6 +94,13 @@ def main(argv=None):
             kv_quant=args.kv_quant,
         )
         await server.start()
+        if args.warmup_batches:
+            batches = tuple(
+                int(x) for x in args.warmup_batches.split(",") if x
+            )
+            server._warmup_task = asyncio.create_task(
+                server.warmup(batches)
+            )
         from bloombee_tpu.server.throughput import measure_and_announce
 
         # keep a strong reference: the loop holds tasks only weakly

@@ -1,0 +1,29 @@
+"""ClientConfig: one dataclass for every client-side knob.
+
+Port of /root/reference/src/bloombee/client/config.py:19-42 (timeouts,
+retries/backoff, push-only downstream decode, allowed/blocked servers) —
+round 1 scattered these across constructor kwargs; this consolidates them
+and threads one object through model -> sequence manager -> sessions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class ClientConfig:
+    # transport topology (reference use_server_to_server +
+    # push_only_downstream_decode)
+    use_push: bool = True
+    # within-stage micro-batch count; None -> BBTPU_MICROBATCH env default
+    microbatch: int | None = None
+    # per-step failure handling (reference retries/backoff + ban_timeout)
+    max_retries: int = 3
+    step_timeout: float = 120.0
+    ban_timeout: float = 15.0
+    # routing view refresh (reference _SequenceManagerUpdateThread period)
+    update_period: float = 5.0
+    # server filters (reference allowed_servers / blocked_servers)
+    allowed_servers: list[str] | None = None
+    blocked_servers: list[str] | None = None

@@ -70,11 +70,25 @@ class DistributedModelForCausalLM:
         client_params: dict,
         manager: RemoteSequenceManager,
         use_push: bool = True,
+        config=None,
     ):
+        from bloombee_tpu.client.config import ClientConfig
+
         self.spec = spec
         self.params = client_params
         self.manager = manager
-        self.use_push = use_push
+        if config is not None:
+            # a pre-built manager must still honor the config's routing
+            # knobs (from_pretrained applies them at construction)
+            manager.update_period = config.update_period
+            manager.ban_timeout = config.ban_timeout
+            manager.allowed_servers = (
+                set(config.allowed_servers)
+                if config.allowed_servers else None
+            )
+            manager.blocked_servers = set(config.blocked_servers or ())
+        self.config = config or ClientConfig(use_push=use_push)
+        self.use_push = self.config.use_push
 
     @classmethod
     def from_pretrained(
@@ -84,20 +98,27 @@ class DistributedModelForCausalLM:
         model_uid: str | None = None,
         dtype=None,
         use_push: bool = True,
+        config=None,
     ) -> "DistributedModelForCausalLM":
+        from bloombee_tpu.client.config import ClientConfig
         from bloombee_tpu.models.checkpoint import (
             load_client_params,
             load_spec,
         )
 
+        config = config or ClientConfig(use_push=use_push)
         spec = load_spec(model_dir)
         params = load_client_params(model_dir, dtype=dtype)
         manager = RemoteSequenceManager(
             registry,
             model_uid or model_dir.rstrip("/").split("/")[-1],
             spec.num_hidden_layers,
+            update_period=config.update_period,
+            ban_timeout=config.ban_timeout,
+            allowed_servers=config.allowed_servers,
+            blocked_servers=config.blocked_servers,
         )
-        return cls(spec, params, manager, use_push=use_push)
+        return cls(spec, params, manager, config=config)
 
     # ------------------------------------------------------------- components
     def embed(self, input_ids: np.ndarray) -> np.ndarray:
@@ -125,9 +146,14 @@ class DistributedModelForCausalLM:
         self, max_length: int, batch_size: int = 1,
         microbatch: int | None = None,
     ) -> InferenceSession:
+        cfg = self.config
         return InferenceSession(
-            self.manager, max_length, batch_size, use_push=self.use_push,
-            microbatch=microbatch, embed_fn=self.embed,
+            self.manager, max_length, batch_size, use_push=cfg.use_push,
+            max_retries=cfg.max_retries, step_timeout=cfg.step_timeout,
+            microbatch=(
+                microbatch if microbatch is not None else cfg.microbatch
+            ),
+            embed_fn=self.embed,
         )
 
     # --------------------------------------------------------------- generate

@@ -42,12 +42,18 @@ class RemoteSequenceManager:
         update_period: float = 5.0,
         ban_timeout: float = 15.0,
         rng: random.Random | None = None,
+        allowed_servers: list[str] | None = None,
+        blocked_servers: list[str] | None = None,
     ):
         self.registry = registry
         self.model_uid = model_uid
         self.num_blocks = num_blocks
         self.update_period = update_period
         self.ban_timeout = ban_timeout
+        self.allowed_servers = (
+            set(allowed_servers) if allowed_servers else None
+        )
+        self.blocked_servers = set(blocked_servers or ())
         self.spans: dict[str, RemoteSpanInfo] = {}
         self._banned_until: dict[str, float] = {}
         self._last_update = 0.0
@@ -92,6 +98,11 @@ class RemoteSequenceManager:
             s
             for s in self.spans.values()
             if self._banned_until.get(s.peer_id, 0.0) <= now
+            and s.peer_id not in self.blocked_servers
+            and (
+                self.allowed_servers is None
+                or s.peer_id in self.allowed_servers
+            )
         ]
 
     # ---------------------------------------------------------------- routing

@@ -46,31 +46,45 @@ def ring_attention(
         src = (rank - i) % n  # who produced the block currently held
         kv_pos = src * c + jnp.arange(c)
 
-        k_r = repeat_kv(k_cur, n_rep)
-        v_r = repeat_kv(v_cur, n_rep)
-        logits = (
-            jnp.einsum("bqhd,bkhd->bhqk", qf, k_r).astype(jnp.float32) * scale
-        )
-        if causal:
-            mask = kv_pos[None, :] <= q_pos[:, None]  # [Cq, Ck]
-            logits = jnp.where(mask[None, None], logits, NEG)
-            pmask = mask[None, None].astype(jnp.float32)
-        else:
-            pmask = jnp.ones((1, 1, c, c), jnp.float32)
+        def attend(m, l, acc):
+            k_r = repeat_kv(k_cur, n_rep)
+            v_r = repeat_kv(v_cur, n_rep)
+            logits = (
+                jnp.einsum("bqhd,bkhd->bhqk", qf, k_r).astype(jnp.float32)
+                * scale
+            )
+            if causal:
+                mask = kv_pos[None, :] <= q_pos[:, None]  # [Cq, Ck]
+                logits = jnp.where(mask[None, None], logits, NEG)
+                pmask = mask[None, None].astype(jnp.float32)
+            else:
+                pmask = jnp.ones((1, 1, c, c), jnp.float32)
 
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        p = jnp.exp(logits - m_new[..., None]) * pmask  # finite everywhere
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + p.sum(axis=-1)
-        acc_new = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p.astype(q.dtype), v_r
-        ).astype(jnp.float32)
+            m_new = jnp.maximum(m, logits.max(axis=-1))
+            p = jnp.exp(logits - m_new[..., None]) * pmask
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + p.sum(axis=-1)
+            acc_new = acc * corr[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p.astype(q.dtype), v_r
+            ).astype(jnp.float32)
+            return m_new, l_new, acc_new
+
+        if causal:
+            # skip blocks entirely in this rank's causal future (half of all
+            # (rank, src) pairs): the ppermute still runs every step —
+            # collectives must stay uniform across the ring — but the
+            # logits/softmax FLOPs are branched away
+            m, l, acc = lax.cond(
+                src <= rank, attend, lambda m, l, acc: (m, l, acc), m, l, acc
+            )
+        else:
+            m, l, acc = attend(m, l, acc)
 
         # rotate KV to the next rank on the ring
         perm = [(j, (j + 1) % n) for j in range(n)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+        return (m, l, acc, k_nxt, v_nxt), None
 
     m0 = jnp.full((b, h, c), NEG, jnp.float32)
     l0 = jnp.zeros((b, h, c), jnp.float32)

@@ -235,38 +235,36 @@ class SpanExecutor:
                 start_block=self.start_block,
                 layer_active=tuple(int(x) for x in layer_active),
             )
-            self.manager.arena = {"k": new_k, "v": new_v}
-            out = out[:b, :t]
-            if not fetch:
-                return out
-            return np.asarray(out).astype(self.transfer_dtype)
-        if self.mesh is not None:
-            from bloombee_tpu.parallel import serving as tp_serving
-
-            payload_dev = tp_serving.replicated(payload, self.mesh)
-            tm_dev = (
-                tp_serving.replicated(tm_pad, self.mesh)
-                if tm_pad is not None
-                else None
-            )
         else:
-            payload_dev = jnp.asarray(payload)
-            tm_dev = jnp.asarray(tm_pad) if tm_pad is not None else None
-        out, new_k, new_v = span_step_packed(
-            self.params,
-            arena["k"],
-            arena["v"],
-            payload_dev,
-            tm_dev,
-            spec=spec,
-            b=bb,
-            t=tb,
-            page_size=self.page_size,
-            max_pages=pb,
-            use_tree_mask=tree_mask is not None,
-            windows=self.windows,
-            use_flash=use_flash,
-        )
+            if self.mesh is not None:
+                from bloombee_tpu.parallel import serving as tp_serving
+
+                payload_dev = tp_serving.replicated(payload, self.mesh)
+                tm_dev = (
+                    tp_serving.replicated(tm_pad, self.mesh)
+                    if tm_pad is not None
+                    else None
+                )
+            else:
+                payload_dev = jnp.asarray(payload)
+                tm_dev = (
+                    jnp.asarray(tm_pad) if tm_pad is not None else None
+                )
+            out, new_k, new_v = span_step_packed(
+                self.params,
+                arena["k"],
+                arena["v"],
+                payload_dev,
+                tm_dev,
+                spec=spec,
+                b=bb,
+                t=tb,
+                page_size=self.page_size,
+                max_pages=pb,
+                use_tree_mask=tree_mask is not None,
+                windows=self.windows,
+                use_flash=use_flash,
+            )
         self.manager.arena = {"k": new_k, "v": new_v}
         out = out[:b, :t]
         if not fetch:

@@ -43,6 +43,16 @@ from bloombee_tpu.wire.tensor_codec import name_for_dtype
 
 logger = logging.getLogger(__name__)
 
+env.declare(
+    "BBTPU_DUMP_ACTIVATIONS", str, "",
+    "directory to dump per-step hidden in/out as .npz (reference "
+    "real_activation_dumper); empty = off",
+)
+env.declare(
+    "BBTPU_DUMP_LIMIT", int, 100,
+    "max activation dumps per server process",
+)
+
 
 class _Session:
     def __init__(self, session_id: str, handle, batch_size: int,
@@ -53,6 +63,13 @@ class _Session:
         self.layers = layers  # relative (l0, l1) within this server's span
         self.push_inbox: asyncio.Queue = asyncio.Queue()
         self.step_tasks: set[asyncio.Task] = set()  # in-flight mb chunks
+        # per-session timing accumulators (server half of the reference's
+        # [TIMING_TABLE] decomposition, handler.py:1276-1605)
+        self.n_steps = 0
+        self.sum_tokens = 0
+        self.sum_dispatch_ms = 0.0
+        self.sum_fetch_ms = 0.0
+        self.opened_at = 0.0
 
 
 class _PeerPool:
@@ -224,6 +241,36 @@ class BlockServer:
         await self.peers.close()
         await self.rpc.stop()
 
+    async def warmup(
+        self, batch_sizes=(1,), prefill_tokens: int = 128
+    ) -> None:
+        """Pre-compile the hot (batch, tokens, pages) buckets so the first
+        real request skips multi-second XLA compiles (the role of the
+        reference's CUDA-graph warmup + startup throughput measurement,
+        throughput.py:244-345). Runs at training priority so any real
+        inference outranks it."""
+        for b in batch_sizes:
+            try:
+                async with self.manager.allocate(
+                    b, prefill_tokens + 1, timeout=5.0
+                ) as handle:
+                    hidden = np.zeros(
+                        (b, prefill_tokens, self.spec.hidden_size), np.float32
+                    )
+                    out = await self.compute.submit(
+                        PRIORITY_TRAINING, self.executor.prefill,
+                        handle, hidden, True, None, False,
+                    )
+                    await asyncio.to_thread(self.executor.fetch, out)
+                    step = np.zeros((b, 1, self.spec.hidden_size), np.float32)
+                    out = await self.compute.submit(
+                        PRIORITY_TRAINING, self.executor.decode,
+                        handle, step,
+                    )
+                logger.info("warmed buckets for batch %d", b)
+            except Exception as e:
+                logger.warning("warmup(batch=%d) failed: %s", b, e)
+
     def server_info(self) -> ServerInfo:
         return ServerInfo(
             state=ServerState.ONLINE,
@@ -300,13 +347,27 @@ class BlockServer:
         async with self.manager.allocate(
             batch, max_length, timeout=self.alloc_timeout
         ) as handle:
+            import time as _time
+
             session = _Session(session_id, handle, batch, layers)
+            session.opened_at = _time.monotonic()
             self._sessions[session_id] = session
             self._drain_pending_pushes(session)
             try:
                 await self._session_loop(session, stream)
             finally:
                 self._sessions.pop(session_id, None)
+                if session.n_steps:
+                    wall = _time.monotonic() - session.opened_at
+                    logger.info(
+                        "[TIMING_TABLE] session=%s steps=%d tokens=%d "
+                        "mean_dispatch_ms=%.2f mean_fetch_ms=%.2f "
+                        "wall_s=%.2f steps_per_s=%.2f",
+                        session.id, session.n_steps, session.sum_tokens,
+                        session.sum_dispatch_ms / session.n_steps,
+                        session.sum_fetch_ms / session.n_steps,
+                        wall, session.n_steps / max(wall, 1e-9),
+                    )
 
     def _resolve_layers(self, meta: dict) -> tuple[int, int] | None:
         """Honor a requested sub-span (the router may enter this server's span
@@ -467,6 +528,13 @@ class BlockServer:
             "t_dispatch_ms": t_dispatch_ms,
             "t_fetch_ms": t_fetch_ms,
         }
+        session.n_steps += 1
+        session.sum_tokens += int(hidden.shape[0]) * int(hidden.shape[1])
+        session.sum_dispatch_ms += t_dispatch_ms
+        session.sum_fetch_ms += t_fetch_ms
+        dump_dir = env.get("BBTPU_DUMP_ACTIVATIONS")
+        if dump_dir:
+            self._dump_activations(dump_dir, session, meta, hidden, out)
 
         # mid-chain tree pruning: score this span's output with the MidLMHead
         # and return only surviving rows + their indices (reference
@@ -555,6 +623,34 @@ class BlockServer:
                 session.id, hidden.shape[1], dt_ms,
             )
         return out, dt_ms
+
+    def _dump_activations(
+        self, dump_dir: str, session: _Session, meta: dict, hidden, out
+    ) -> None:
+        """Capture real per-step hidden states for compression research
+        (reference utils/real_activation_dumper.py, hooked at
+        backend.inference_step:500)."""
+        import os
+
+        n = getattr(self, "_dump_count", 0)
+        if n >= env.get("BBTPU_DUMP_LIMIT"):
+            return
+        self._dump_count = n + 1
+        os.makedirs(dump_dir, exist_ok=True)
+        rows = meta.get("rows")
+        suffix = f"_rows{rows[0]}-{rows[1]}" if rows else ""
+        path = os.path.join(
+            dump_dir,
+            f"{self.server_id}_{session.id}_step{meta.get('step')}"
+            f"{suffix}.npz",
+        )
+        np.savez(
+            path,
+            hidden_in=np.asarray(hidden, dtype=np.float32),
+            hidden_out=np.asarray(out, dtype=np.float32),
+            start_block=self.start_block,
+            end_block=self.end_block,
+        )
 
     def _prune_tree(self, out: np.ndarray, prune: dict):
         """Per-row keep indices from the MidLMHead over this span's output

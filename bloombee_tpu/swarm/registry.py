@@ -15,6 +15,8 @@ tests. The registry speaks the normal wire RPC so any peer can also proxy it.
 from __future__ import annotations
 
 import asyncio
+import json
+import os
 import time
 
 from bloombee_tpu.swarm.data import ModuleInfo, ServerInfo
@@ -28,6 +30,23 @@ class _Store:
 
     def store(self, key: str, subkey: str, value: dict, expiration: float):
         self._data.setdefault(key, {})[subkey] = (value, expiration)
+
+    # --------------------------------------------------------- persistence
+    def snapshot(self) -> list:
+        """Live records as a JSON-serializable list."""
+        now = time.time()
+        return [
+            {"key": k, "subkey": sk, "value": v, "expiration": exp}
+            for k, sub in self._data.items()
+            for sk, (v, exp) in sub.items()
+            if exp > now
+        ]
+
+    def load_snapshot(self, records: list) -> None:
+        now = time.time()
+        for r in records:
+            if r["expiration"] > now:
+                self.store(r["key"], r["subkey"], r["value"], r["expiration"])
 
     def get(self, key: str) -> dict[str, dict]:
         now = time.time()
@@ -52,10 +71,27 @@ class _Store:
 
 
 class RegistryServer:
-    """Standalone registry node (bootstrap peer)."""
+    """Standalone registry node (bootstrap peer).
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    `persist_path` makes the record store survive restarts: records are
+    snapshotted to disk every `persist_period` seconds (and on stop) and
+    reloaded at start — a restarted registry immediately knows the swarm
+    instead of waiting an announce period for every server (the reference's
+    DHT survives via peer replication; a single-node registry needs a disk
+    snapshot instead).
+    """
+
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        persist_path: str | None = None,
+        persist_period: float = 5.0,
+    ):
         self._store = _Store()
+        self.persist_path = persist_path
+        self.persist_period = persist_period
+        self._persist_task: asyncio.Task | None = None
         self.rpc = RpcServer(
             unary_handlers={
                 "registry_store": self._rpc_store,
@@ -71,10 +107,42 @@ class RegistryServer:
         return self.rpc.port
 
     async def start(self):
+        if self.persist_path and os.path.exists(self.persist_path):
+            try:
+                with open(self.persist_path) as f:
+                    self._store.load_snapshot(json.load(f))
+            except Exception:
+                pass  # a corrupt snapshot must not block bootstrap
         await self.rpc.start()
+        if self.persist_path:
+            self._persist_task = asyncio.create_task(self._persist_loop())
 
     async def stop(self):
+        if self._persist_task is not None:
+            self._persist_task.cancel()
+            try:
+                # an in-flight to_thread write keeps running through
+                # cancel(); await it so the final write can't race it on
+                # the same .tmp file
+                await self._persist_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._write_snapshot()
         await self.rpc.stop()
+
+    def _write_snapshot(self) -> None:
+        tmp = f"{self.persist_path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._store.snapshot(), f)
+        os.replace(tmp, self.persist_path)
+
+    async def _persist_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.persist_period)
+            try:
+                await asyncio.to_thread(self._write_snapshot)
+            except Exception:
+                pass
 
     async def _rpc_store(self, meta: dict, tensors):
         now = time.time()
