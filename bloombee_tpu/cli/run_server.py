@@ -63,6 +63,9 @@ def main(argv=None):
 
     host, port = args.registry.rsplit(":", 1)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    from bloombee_tpu.models.hub import resolve_model_dir
+
+    args.model_dir = resolve_model_dir(args.model_dir)
     spec = load_spec(args.model_dir)
     model_uid = args.model_uid or args.model_dir.rstrip("/").split("/")[-1]
 

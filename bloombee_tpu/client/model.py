@@ -106,7 +106,10 @@ class DistributedModelForCausalLM:
             load_spec,
         )
 
+        from bloombee_tpu.models.hub import resolve_model_dir
+
         config = config or ClientConfig(use_push=use_push)
+        model_dir = resolve_model_dir(model_dir)
         spec = load_spec(model_dir)
         params = load_client_params(model_dir, dtype=dtype)
         manager = RemoteSequenceManager(

@@ -32,18 +32,22 @@ class RemoteSpanChain:
         self.manager = manager
         self.max_retries = max_retries
 
-    async def _call_span(self, span, method, tensors):
+    async def _call_span(self, span, method, tensors, deep_prompts=False):
         conn = await connect(span.server_info.host, span.server_info.port)
         try:
             meta = {"start": span.start, "end": span.end}
+            if deep_prompts:
+                meta["deep_prompts"] = True
             _, out = await conn.call(method, meta, tensors)
             return out
         finally:
             await conn.close()
 
-    async def forward(self, hidden: np.ndarray):
+    async def forward(self, hidden: np.ndarray, deep_prompts=None):
         """Returns (output, ctx) where ctx holds per-span inputs for backward
-        (reference sequential_forward's intermediate activation capture)."""
+        (reference sequential_forward's intermediate activation capture).
+        `deep_prompts` [L_total, P, D] adds per-layer trainable prompts
+        (reference ptune.py deep mode); each span receives its layer rows."""
         attempt = 0
         while True:
             await self.manager.update()
@@ -53,7 +57,18 @@ class RemoteSpanChain:
                 h = hidden
                 for span in route:
                     inputs.append(h)
-                    (h,) = await self._call_span(span, "rpc_forward", [h])
+                    tensors = [h]
+                    if deep_prompts is not None:
+                        tensors.append(
+                            np.asarray(
+                                deep_prompts[span.start:span.end],
+                                dtype=np.float32,
+                            )
+                        )
+                    (h,) = await self._call_span(
+                        span, "rpc_forward", tensors,
+                        deep_prompts=deep_prompts is not None,
+                    )
                 return h, (route, inputs)
             except (RpcError, OSError, asyncio.TimeoutError) as e:
                 attempt += 1
@@ -62,18 +77,37 @@ class RemoteSpanChain:
                 logger.warning("chain forward failed (%s); retrying", e)
                 await self.manager.update(force=True)
 
-    async def backward(self, ctx, grad_out: np.ndarray) -> np.ndarray:
+    async def backward(self, ctx, grad_out: np.ndarray, deep_prompts=None):
         """Reversed-span gradient chain; retries re-route the failed span
-        only (its input is captured in ctx)."""
+        only (its input is captured in ctx). With deep_prompts, also
+        returns the full [L_total, P, D] prompt gradient."""
         route, inputs = ctx
         g = grad_out
+        g_deep = (
+            np.zeros_like(np.asarray(deep_prompts, dtype=np.float32))
+            if deep_prompts is not None
+            else None
+        )
         for span, h_in in zip(reversed(route), reversed(inputs)):
             attempt = 0
             while True:
                 try:
-                    (g,) = await self._call_span(
-                        span, "rpc_backward", [h_in, g]
-                    )
+                    tensors = [h_in, g]
+                    if deep_prompts is not None:
+                        tensors.append(
+                            np.asarray(
+                                deep_prompts[span.start:span.end],
+                                dtype=np.float32,
+                            )
+                        )
+                        g, g_p = await self._call_span(
+                            span, "rpc_backward", tensors, deep_prompts=True
+                        )
+                        g_deep[span.start:span.end] += g_p
+                    else:
+                        (g,) = await self._call_span(
+                            span, "rpc_backward", tensors
+                        )
                     break
                 except (RpcError, OSError, asyncio.TimeoutError) as e:
                     attempt += 1
@@ -89,6 +123,8 @@ class RemoteSpanChain:
                             f"[{span.start},{span.end})"
                         )
                     span = new_route[0]
+        if deep_prompts is not None:
+            return g, g_deep
         return g
 
 
@@ -129,6 +165,7 @@ class PTuneTrainer:
         n_prompt: int = 8,
         lr: float = 0.05,
         seed: int = 0,
+        deep: bool = False,  # per-layer prompts (reference ptune deep mode)
     ):
         self.model = model
         self.chain = RemoteSpanChain(model.manager)
@@ -138,6 +175,13 @@ class PTuneTrainer:
         rng = np.random.default_rng(seed)
         self.prompts = jnp.asarray(
             rng.normal(size=(n_prompt, d)).astype(np.float32) * 0.02
+        )
+        self.deep_prompts = (
+            np.zeros(
+                (model.spec.num_hidden_layers, n_prompt, d), np.float32
+            )
+            if deep
+            else None
         )
         self.lm_head = model.params["lm_head"].astype(jnp.float32)
 
@@ -157,7 +201,9 @@ class PTuneTrainer:
             axis=1,
         ).astype(np.float32)
 
-        chain_out, ctx = await self.chain.forward(h_in)
+        chain_out, ctx = await self.chain.forward(
+            h_in, deep_prompts=self.deep_prompts
+        )
 
         target_full = np.full((b, self.n_prompt + s), -100, np.int64)
         target_full[:, self.n_prompt :] = target_ids
@@ -173,7 +219,13 @@ class PTuneTrainer:
             norm_type=self.model.spec.norm_type,
         )
 
-        g_in = await self.chain.backward(ctx, np.asarray(g_out))
+        if self.deep_prompts is not None:
+            g_in, g_deep = await self.chain.backward(
+                ctx, np.asarray(g_out), deep_prompts=self.deep_prompts
+            )
+            self.deep_prompts = self.deep_prompts - self.lr * g_deep
+        else:
+            g_in = await self.chain.backward(ctx, np.asarray(g_out))
         g_prompts = jnp.asarray(g_in[:, : self.n_prompt]).sum(axis=0)
 
         self.prompts = self.prompts - self.lr * g_prompts

@@ -1,0 +1,145 @@
+"""Model resolution: local dirs, or HF-hub download with a disk LRU cache.
+
+Capability port of /root/reference/src/bloombee/server/from_pretrained.py
+:168-308 (per-block hub state-dict loading) + utils/disk_cache.py:41 (cache
+locking + LRU disk eviction), restructured for this framework's local-dir
+loaders: `resolve_model_dir` turns a model NAME into a local snapshot
+directory (downloading into the cache on first use), after which every
+existing checkpoint reader works unchanged.
+
+Offline note: this environment has zero egress, so the download path is
+exercised in tests through a local `fetch_fn` injection; the default uses
+huggingface_hub when importable.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import pathlib
+import shutil
+import time
+
+from bloombee_tpu.utils import env
+
+env.declare(
+    "BBTPU_CACHE_DIR", str, os.path.expanduser("~/.cache/bloombee_tpu"),
+    "disk cache for downloaded model snapshots (reference BLOOMBEE_CACHE)",
+)
+env.declare(
+    "BBTPU_CACHE_MAX_BYTES", int, 0,
+    "LRU-evict cached model snapshots beyond this total size (0 = no limit)",
+)
+
+
+def _dir_size(path: pathlib.Path) -> int:
+    return sum(
+        f.stat().st_size for f in path.rglob("*") if f.is_file()
+    )
+
+
+def _touch_access(path: pathlib.Path) -> None:
+    (path / ".last_access").write_text(str(time.time()))
+
+
+def _last_access(path: pathlib.Path) -> float:
+    marker = path / ".last_access"
+    try:
+        return float(marker.read_text())
+    except Exception:
+        try:
+            return path.stat().st_mtime
+        except OSError:
+            return 0.0
+
+
+def evict_lru(cache_dir: str, max_bytes: int, keep: str | None = None) -> int:
+    """Delete least-recently-used snapshot dirs until under budget
+    (reference disk_cache.py `_remove_old_models`). Returns bytes freed."""
+    root = pathlib.Path(cache_dir)
+    if max_bytes <= 0 or not root.exists():
+        return 0
+    # global eviction lock: per-model locks don't serialize evictors, and
+    # another process's in-flight .partial must never be collected (dotted
+    # names are locks/partials, not snapshots)
+    with open(root / ".evict.lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        entries = [
+            p for p in root.iterdir()
+            if p.is_dir()
+            and not p.name.startswith(".")
+            and (keep is None or p.name != keep)
+        ]
+        sizes = {p: _dir_size(p) for p in entries}
+        total = sum(sizes.values())
+        if keep is not None and (root / keep).exists():
+            total += _dir_size(root / keep)
+        freed = 0
+        for p in sorted(entries, key=_last_access):
+            if total <= max_bytes:
+                break
+            sz = sizes[p]
+            shutil.rmtree(p, ignore_errors=True)
+            total -= sz
+            freed += sz
+        fcntl.flock(lock, fcntl.LOCK_UN)
+    return freed
+
+
+def _default_fetch(name: str, dest: str) -> None:
+    """Download a hub snapshot into dest (weights + config only)."""
+    from huggingface_hub import snapshot_download
+
+    snapshot_download(
+        repo_id=name,
+        local_dir=dest,
+        allow_patterns=[
+            "config.json", "*.safetensors", "model.safetensors.index.json",
+            "tokenizer*", "generation_config.json",
+        ],
+    )
+
+
+def resolve_model_dir(
+    name_or_path: str,
+    cache_dir: str | None = None,
+    max_cache_bytes: int | None = None,
+    fetch_fn=None,
+) -> str:
+    """Local directory for a model: existing paths pass through; hub names
+    download once into the LRU cache (file-locked against concurrent
+    servers on one host — reference disk_cache lock)."""
+    if os.path.isdir(name_or_path):
+        return name_or_path
+    cache_dir = cache_dir or env.get("BBTPU_CACHE_DIR")
+    max_bytes = (
+        max_cache_bytes
+        if max_cache_bytes is not None
+        else env.get("BBTPU_CACHE_MAX_BYTES")
+    )
+    safe = name_or_path.replace("/", "--")
+    root = pathlib.Path(cache_dir)
+    root.mkdir(parents=True, exist_ok=True)
+    dest = root / safe
+    lock_path = root / f".{safe}.lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if (dest / "config.json").exists():
+                _touch_access(dest)
+                return str(dest)
+            evict_lru(cache_dir, max_bytes, keep=safe)
+            tmp = root / f".{safe}.partial"
+            shutil.rmtree(tmp, ignore_errors=True)
+            (fetch_fn or _default_fetch)(name_or_path, str(tmp))
+            # a killed previous attempt can leave a config-less dest dir;
+            # os.replace cannot overwrite a non-empty directory
+            shutil.rmtree(dest, ignore_errors=True)
+            os.replace(tmp, dest)
+            _touch_access(dest)
+            # enforce the budget again now that the new snapshot's size is
+            # known (the pre-download pass can't account for it)
+            evict_lru(cache_dir, max_bytes, keep=safe)
+            return str(dest)
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)

@@ -137,6 +137,7 @@ def span_step_impl(
     hidden: jax.Array,  # [B, T, D]
     plan: jax.Array,  # packed int32 (see unpack_plan)
     tree_mask: jax.Array | None = None,  # [B, T, T] bool
+    prompts: jax.Array | None = None,  # [L, P, D] deep p-tuning prompts
     *,
     spec: ModelSpec,
     page_size: int,
@@ -148,7 +149,10 @@ def span_step_impl(
     """Run all local blocks over one step; returns (hidden, arena_k, arena_v).
 
     Rotary cos/sin are computed on-device from the plan's positions (no
-    per-step host tables), in fp32 like HF.
+    per-step host tables), in fp32 like HF. `prompts` adds a trainable
+    per-layer vector to the first P positions of each ACTIVE layer's input
+    (deep p-tuning — reference ptune.py:21-80 deep mode); inactive layers'
+    rows are ignored.
     """
     b, t, _ = hidden.shape
     num_layers = arena_k.shape[0]
@@ -174,13 +178,24 @@ def span_step_impl(
         windows if windows is not None else (0,) * num_layers, jnp.int32
     )
 
+    xs = (stacked_params, arena_k, arena_v, layer_active, windows_arr)
+    if prompts is not None:
+        xs = xs + (prompts,)
+
     def body(h, xs):
-        params_l, k_l, v_l, active, window_l = xs
+        if prompts is not None:
+            params_l, k_l, v_l, active, window_l, prompt_l = xs
+        else:
+            params_l, k_l, v_l, active, window_l = xs
+            prompt_l = None
         use_local = window_l > 0
         cos_l = jnp.where(use_local, cos_loc, cos)
         sin_l = jnp.where(use_local, sin_loc, sin)
 
         def run(h, k_l, v_l):
+            if prompt_l is not None:
+                p = prompt_l.shape[0]
+                h = h.at[:, :p].add(prompt_l[None].astype(h.dtype))
             return layer_body(
                 spec, page_size, h, params_l, k_l, v_l, cos_l, sin_l, slots,
                 page_table, q_positions, total_lens, tm, window_l,
@@ -193,9 +208,7 @@ def span_step_impl(
         h, k_l, v_l = lax.cond(active > 0, run, skip, h, k_l, v_l)
         return h, (k_l, v_l)
 
-    hidden, (arena_k, arena_v) = lax.scan(
-        body, hidden, (stacked_params, arena_k, arena_v, layer_active, windows_arr)
-    )
+    hidden, (arena_k, arena_v) = lax.scan(body, hidden, xs)
     return hidden, arena_k, arena_v
 
 

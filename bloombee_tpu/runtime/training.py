@@ -43,7 +43,7 @@ def _train_plan(
     return pack_plan(slots, page_table, positions, total_lens, layer_active)
 
 
-def _dense_forward(stacked_params, hidden, plan, spec, windows):
+def _dense_forward(stacked_params, hidden, plan, spec, windows, prompts=None):
     b, t, _ = hidden.shape
     num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
     arena_shape = (
@@ -52,6 +52,7 @@ def _dense_forward(stacked_params, hidden, plan, spec, windows):
     zeros = jnp.zeros(arena_shape, hidden.dtype)
     out, _, _ = span_step_impl(
         stacked_params, zeros, jnp.zeros_like(zeros), hidden, plan, None,
+        prompts,
         spec=spec, page_size=t, max_pages=1, windows=windows,
     )
     return out
@@ -59,23 +60,35 @@ def _dense_forward(stacked_params, hidden, plan, spec, windows):
 
 @functools.partial(jax.jit, static_argnames=("spec", "windows"))
 def span_train_forward(
-    stacked_params, hidden, plan, *, spec: ModelSpec, windows=None
+    stacked_params, hidden, plan, prompts=None, *,
+    spec: ModelSpec, windows=None,
 ):
-    return _dense_forward(stacked_params, hidden, plan, spec, windows)
+    return _dense_forward(stacked_params, hidden, plan, spec, windows, prompts)
 
 
 @functools.partial(jax.jit, static_argnames=("spec", "windows"))
 def span_train_backward(
-    stacked_params, hidden_in, grad_out, plan, *,
+    stacked_params, hidden_in, grad_out, plan, prompts=None, *,
     spec: ModelSpec, windows=None,
 ):
-    """Returns (forward_output, grad_wrt_input)."""
+    """Returns (forward_output, grad_wrt_input[, grad_wrt_prompts])."""
+    if prompts is None:
+        out, vjp = jax.vjp(
+            lambda h: _dense_forward(
+                stacked_params, h, plan, spec, windows
+            ),
+            hidden_in,
+        )
+        (g_in,) = vjp(grad_out)
+        return out, g_in, None
     out, vjp = jax.vjp(
-        lambda h: _dense_forward(stacked_params, h, plan, spec, windows),
-        hidden_in,
+        lambda h, p: _dense_forward(
+            stacked_params, h, plan, spec, windows, p
+        ),
+        hidden_in, prompts,
     )
-    (g_in,) = vjp(grad_out)
-    return out, g_in
+    g_in, g_prompts = vjp(grad_out)
+    return out, g_in, g_prompts
 
 
 class TrainingExecutor:
@@ -89,13 +102,28 @@ class TrainingExecutor:
         self.compute_dtype = compute_dtype
         self.num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
 
+    def _expand_prompts(self, prompts, layers):
+        """Received prompts cover the ACTIVE sub-span only; embed them at
+        the right rows of a full [num_layers, P, D] array."""
+        if prompts is None:
+            return None
+        prompts = jnp.asarray(prompts, self.compute_dtype)
+        if layers is None or prompts.shape[0] == self.num_layers:
+            return prompts
+        full = jnp.zeros(
+            (self.num_layers, *prompts.shape[1:]), prompts.dtype
+        )
+        return full.at[layers[0]:layers[1]].set(prompts)
+
     def forward(
-        self, hidden: np.ndarray, layers: tuple[int, int] | None = None
+        self, hidden: np.ndarray, layers: tuple[int, int] | None = None,
+        prompts: np.ndarray | None = None,
     ) -> np.ndarray:
         b, t, _ = hidden.shape
         plan = jnp.asarray(_train_plan(b, t, self.num_layers, layers))
         out = span_train_forward(
             self.params, jnp.asarray(hidden, self.compute_dtype), plan,
+            self._expand_prompts(prompts, layers),
             spec=self.spec, windows=self.windows,
         )
         return np.asarray(out, dtype=np.float32)
@@ -105,15 +133,25 @@ class TrainingExecutor:
         hidden_in: np.ndarray,
         grad_out: np.ndarray,
         layers: tuple[int, int] | None = None,
-    ) -> np.ndarray:
+        prompts: np.ndarray | None = None,
+    ):
+        """Returns g_in, or (g_in, g_prompts) when prompts are given
+        (g_prompts covers only the active sub-span rows)."""
         b, t, _ = hidden_in.shape
         plan = jnp.asarray(_train_plan(b, t, self.num_layers, layers))
-        _, g_in = span_train_backward(
+        _, g_in, g_prompts = span_train_backward(
             self.params,
             jnp.asarray(hidden_in, self.compute_dtype),
             jnp.asarray(grad_out, self.compute_dtype),
             plan,
+            self._expand_prompts(prompts, layers),
             spec=self.spec,
             windows=self.windows,
         )
-        return np.asarray(g_in, dtype=np.float32)
+        g_in = np.asarray(g_in, dtype=np.float32)
+        if g_prompts is None:
+            return g_in
+        g_p = np.asarray(g_prompts, dtype=np.float32)
+        if layers is not None and g_p.shape[0] == self.num_layers:
+            g_p = g_p[layers[0]:layers[1]]
+        return g_in, g_p

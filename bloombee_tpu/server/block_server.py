@@ -752,9 +752,14 @@ class BlockServer:
         if self.training is None:
             raise RuntimeError("training path unavailable for this family")
         hidden = np.asarray(tensors[0], dtype=np.float32)
+        prompts = (
+            np.asarray(tensors[1], dtype=np.float32)
+            if meta.get("deep_prompts") and len(tensors) > 1
+            else None
+        )
         layers = self._resolve_layers(meta)
         out = await self.compute.submit(
-            PRIORITY_TRAINING, self.training.forward, hidden, layers
+            PRIORITY_TRAINING, self.training.forward, hidden, layers, prompts
         )
         return {"ok": True}, [out]
 
@@ -765,9 +770,17 @@ class BlockServer:
             raise RuntimeError("training path unavailable for this family")
         hidden_in = np.asarray(tensors[0], dtype=np.float32)
         grad_out = np.asarray(tensors[1], dtype=np.float32)
-        layers = self._resolve_layers(meta)
-        g_in = await self.compute.submit(
-            PRIORITY_TRAINING, self.training.backward, hidden_in, grad_out,
-            layers,
+        prompts = (
+            np.asarray(tensors[2], dtype=np.float32)
+            if meta.get("deep_prompts") and len(tensors) > 2
+            else None
         )
-        return {"ok": True}, [g_in]
+        layers = self._resolve_layers(meta)
+        result = await self.compute.submit(
+            PRIORITY_TRAINING, self.training.backward, hidden_in, grad_out,
+            layers, prompts,
+        )
+        if prompts is not None:
+            g_in, g_prompts = result
+            return {"ok": True}, [g_in, g_prompts]
+        return {"ok": True}, [result]

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Production worker launcher (role of the reference's cli/run_prod_server.sh):
+# env-driven configuration, restart-on-crash loop, logs to a file.
+#
+# Required:
+#   BBTPU_MODEL       model directory or hub name
+#   BBTPU_REGISTRY    host:port of the registry bootstrap node
+# Optional:
+#   BBTPU_BLOCKS      "start:end" block span (default: auto-select)
+#   BBTPU_TP          tensor-parallel degree over local chips (default 1)
+#   BBTPU_KV_QUANT    none | int4
+#   BBTPU_NUM_PAGES   KV pages (default 256)
+#   BBTPU_PUBLIC_HOST address to announce (default: first hostname -I entry)
+#   BBTPU_LOG_DIR     log directory (default ./logs)
+set -euo pipefail
+
+: "${BBTPU_MODEL:?set BBTPU_MODEL}"
+: "${BBTPU_REGISTRY:?set BBTPU_REGISTRY}"
+LOG_DIR="${BBTPU_LOG_DIR:-./logs}"
+mkdir -p "$LOG_DIR"
+PUBLIC_HOST="${BBTPU_PUBLIC_HOST:-$(hostname -I 2>/dev/null | awk '{print $1}' || true)}"
+
+ARGS=(
+  "$BBTPU_MODEL"
+  --registry "$BBTPU_REGISTRY"
+  --public-host "${PUBLIC_HOST:-127.0.0.1}"
+  --num-pages "${BBTPU_NUM_PAGES:-256}"
+  --tp "${BBTPU_TP:-1}"
+)
+[ -n "${BBTPU_BLOCKS:-}" ] && ARGS+=(--blocks "$BBTPU_BLOCKS")
+[ -n "${BBTPU_KV_QUANT:-}" ] && ARGS+=(--kv-quant "$BBTPU_KV_QUANT")
+
+# restart on crash (the reference Server loop restarts its container;
+# process-level restart covers hard crashes too)
+while true; do
+  echo "[run_prod_server] starting worker: ${ARGS[*]}"
+  python -m bloombee_tpu.cli.run_server "${ARGS[@]}" \
+    2>&1 | tee -a "$LOG_DIR/server.log" && break
+  echo "[run_prod_server] worker exited abnormally; restarting in 5s"
+  sleep 5
+done

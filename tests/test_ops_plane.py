@@ -154,3 +154,42 @@ def test_env_describe_lists_declared_flags():
                  "BBTPU_FLASH_ATTENTION", "BBTPU_DUMP_ACTIVATIONS",
                  "BBTPU_MIN_COMPRESS_BYTES"):
         assert name in table
+
+
+def test_hub_resolve_download_cache_and_lru(tmp_path):
+    """Hub-name resolution (reference from_pretrained.py:168-308 +
+    disk_cache.py LRU): first use downloads via fetch_fn, second use hits
+    the cache, and the LRU evicts the stalest snapshot under a byte budget."""
+    from bloombee_tpu.models.hub import evict_lru, resolve_model_dir
+
+    cache = str(tmp_path / "cache")
+    calls = []
+
+    def fake_fetch(name, dest):
+        calls.append(name)
+        os.makedirs(dest, exist_ok=True)
+        with open(os.path.join(dest, "config.json"), "w") as f:
+            json.dump({"model_type": "llama", "name": name}, f)
+        with open(os.path.join(dest, "model.safetensors"), "wb") as f:
+            f.write(b"x" * 1000)
+
+    d1 = resolve_model_dir("org/model-a", cache_dir=cache,
+                           max_cache_bytes=0, fetch_fn=fake_fetch)
+    assert json.load(open(os.path.join(d1, "config.json")))["name"] == "org/model-a"
+    d1_again = resolve_model_dir("org/model-a", cache_dir=cache,
+                                 max_cache_bytes=0, fetch_fn=fake_fetch)
+    assert d1 == d1_again and calls == ["org/model-a"]  # cache hit
+
+    # local paths pass through untouched
+    assert resolve_model_dir(d1, fetch_fn=fake_fetch) == d1
+
+    # second model + a tight budget evicts the least recently used
+    import time as _t
+
+    _t.sleep(0.01)
+    resolve_model_dir("org/model-b", cache_dir=cache, max_cache_bytes=0,
+                      fetch_fn=fake_fetch)
+    freed = evict_lru(cache, max_bytes=1500)
+    assert freed > 0
+    assert not os.path.exists(d1)  # model-a was stalest
+    assert os.path.exists(os.path.join(cache, "org--model-b"))

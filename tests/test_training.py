@@ -127,3 +127,93 @@ def test_ptune_loss_decreases(env):
         await reg.stop()
 
     asyncio.run(run())
+
+
+def test_deep_ptune_grads_match_local(env):
+    """Deep per-layer prompts (reference ptune.py deep mode): the 2-server
+    chain's prompt gradients must match one local VJP over all layers."""
+    d, config = env
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        servers = [
+            BlockServer(model_uid="m", start=0, end=2, model_dir=d,
+                        registry=RegistryClient("127.0.0.1", reg.port),
+                        compute_dtype=jnp.float32, num_pages=64, page_size=4),
+            BlockServer(model_uid="m", start=2, end=3, model_dir=d,
+                        registry=RegistryClient("127.0.0.1", reg.port),
+                        compute_dtype=jnp.float32, num_pages=64, page_size=4),
+        ]
+        for s in servers:
+            await s.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, RegistryClient("127.0.0.1", reg.port), model_uid="m"
+        )
+        chain = RemoteSpanChain(model.manager)
+
+        rng = np.random.default_rng(0)
+        h_in = rng.normal(size=(2, 6, 64)).astype(np.float32)
+        g_out = rng.normal(size=(2, 6, 64)).astype(np.float32)
+        deep = rng.normal(size=(3, 2, 64)).astype(np.float32) * 0.02
+
+        out, ctx = await chain.forward(h_in, deep_prompts=deep)
+        g_in, g_deep = await chain.backward(
+            ctx, g_out, deep_prompts=deep
+        )
+
+        # local reference: all 3 layers in one span
+        from bloombee_tpu.models.checkpoint import load_span_params
+        from bloombee_tpu.runtime.training import (
+            _train_plan,
+            span_train_backward,
+            span_train_forward,
+        )
+
+        params, spec = load_span_params(d, 0, 3, dtype=jnp.float32)
+        plan = jnp.asarray(_train_plan(2, 6, 3))
+        ref_out = span_train_forward(
+            params, jnp.asarray(h_in), plan, jnp.asarray(deep), spec=spec
+        )
+        _, ref_g_in, ref_g_deep = span_train_backward(
+            params, jnp.asarray(h_in), jnp.asarray(g_out), plan,
+            jnp.asarray(deep), spec=spec,
+        )
+        np.testing.assert_allclose(out, np.asarray(ref_out), atol=1e-4,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(g_in, np.asarray(ref_g_in), atol=1e-4,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(g_deep, np.asarray(ref_g_deep),
+                                   atol=1e-4, rtol=1e-4)
+
+        for s in servers:
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_deep_ptune_loss_decreases(env):
+    d, config = env
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s = BlockServer(model_uid="m", start=0, end=3, model_dir=d,
+                        registry=RegistryClient("127.0.0.1", reg.port),
+                        compute_dtype=jnp.float32, num_pages=64, page_size=4)
+        await s.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, RegistryClient("127.0.0.1", reg.port), model_uid="m"
+        )
+        trainer = PTuneTrainer(model, n_prompt=4, lr=0.1, deep=True)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, config.vocab_size, size=(2, 6))
+        tgt = rng.integers(0, config.vocab_size, size=(2, 6))
+        losses = [await trainer.train_step(ids, tgt) for _ in range(6)]
+        assert losses[-1] < losses[0], losses
+        assert np.abs(trainer.deep_prompts).sum() > 0  # actually trained
+        await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
