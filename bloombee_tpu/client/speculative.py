@@ -151,6 +151,7 @@ async def generate_speculative(
 
             pending_accept = []
             committed_rows = []
+            drafted_accepts = []  # acceptance feedback for tree shaping
             for i in range(b):
                 room = max_new_tokens - len(new_rows[i])
                 if room <= 0:
@@ -166,6 +167,7 @@ async def generate_speculative(
                     verifiable=None if verifiable is None else verifiable[i],
                 )
                 assert accepted and accepted[0] == 0
+                drafted_accepts.append(len(accepted) - 1)  # excl. node 0
                 # cap so the row lands on EXACTLY max_new_tokens with its
                 # last token an uncommitted bonus — the same resume contract
                 # as plain generate (last returned token not yet stepped)
@@ -178,6 +180,13 @@ async def generate_speculative(
                 new_rows[i].append(nxt)
             # accepted nodes' token ids ARE the committed history
             session.record_history_ids(committed_rows)
+            if (
+                drafted_accepts
+                and prune_threshold is None  # pruner-induced stops would
+                # read as draft misses and bias shaping toward shallow trees
+                and hasattr(drafter, "observe")
+            ):
+                drafter.observe(drafted_accepts)  # adaptive tree shaping
             if prune_threshold is not None:
                 pending_spans = _per_span_accepts(
                     pending_accept, keep, len(session._spans)

@@ -65,36 +65,144 @@ class LocalJaxDraftModel:
         h_last = rms_norm(h_last, self.client["norm"], spec.rms_norm_eps)
         return (h_last @ self.client["lm_head"]).astype(jnp.float32)
 
+    # ------------------------------------------------- prefix-KV cached path
+    @functools.partial(jax.jit, static_argnums=0)
+    def _prefill_cache(self, ids: jax.Array, last: jax.Array):
+        """One pass over the context: per-layer KV caches + last logits.
+        Each tree level then reruns only its short path suffix against the
+        cache instead of the whole context (the drafter half of the
+        reference's threaded small-model drafting, drafter.py:67-110,
+        which keeps HF KV caches the same way)."""
+        spec = self.spec
+        h = self.client["embed"][ids]
+        b, s, _ = h.shape
+        positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        cos, sin = rotary_cos_sin(positions, spec.head_dim, spec.rope_theta)
+        caches = []
+        for p in self.blocks:
+            h, (k, v) = block_forward(p, spec, h, cos, sin, dense_attend())
+            caches.append((k, v))  # [N, Sb, Hkv, hd]
+        h_last = h[jnp.arange(b), last]
+        h_last = rms_norm(h_last, self.client["norm"], spec.rms_norm_eps)
+        logits = (h_last @ self.client["lm_head"]).astype(jnp.float32)
+        return tuple(caches), logits
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _suffix_logits(
+        self,
+        caches,  # per-layer (k, v) [N, Sb, Hkv, hd]
+        ctx_lens: jax.Array,  # [N]
+        row_of: jax.Array,  # [M] which cache row each path uses
+        suffix_ids: jax.Array,  # [M, d] path tokens beyond the context
+    ) -> jax.Array:
+        """Logits after each path's last suffix token, attending to its
+        row's cached prefix (masked to ctx_len) plus the suffix causally."""
+        from bloombee_tpu.ops.attention import masked_attention
+
+        spec = self.spec
+        m, d = suffix_ids.shape
+        lens = ctx_lens[row_of]  # [M]
+        h = self.client["embed"][suffix_ids]
+        positions = lens[:, None] + jnp.arange(d)[None, :]
+        cos, sin = rotary_cos_sin(positions, spec.head_dim, spec.rope_theta)
+
+        sb = jax.tree.leaves(caches)[0].shape[1]
+        col = jnp.arange(sb + d)[None, None, :]  # [1, 1, Sb+d]
+        q_idx = jnp.arange(d)[None, :, None]  # [1, d, 1]
+        prefix_ok = (col < sb) & (col < lens[:, None, None])
+        suffix_ok = (col >= sb) & ((col - sb) <= q_idx)
+        mask = prefix_ok | suffix_ok  # [M, d, Sb+d]
+
+        def attend_for(pk, pv):
+            def attend(q, k, v):
+                k_all = jnp.concatenate([pk, k], axis=1)
+                v_all = jnp.concatenate([pv, v], axis=1)
+                return masked_attention(q, k_all, v_all, mask), None
+
+            return attend
+
+        for p, (k_c, v_c) in zip(self.blocks, caches):
+            h, _ = block_forward(
+                p, spec, h, cos, sin,
+                attend_for(k_c[row_of], v_c[row_of]),
+            )
+        h_last = h[:, -1]
+        h_last = rms_norm(h_last, self.client["norm"], spec.rms_norm_eps)
+        return (h_last @ self.client["lm_head"]).astype(jnp.float32)
+
+    def prefill_ragged(self, seqs: list[list[int]]):
+        """(caches, ctx_lens, last_logits) for ragged contexts (pow2
+        bucket)."""
+        padded, lens = self._pad_ragged(seqs)
+        caches, logits = self._prefill_cache(
+            jnp.asarray(padded), jnp.asarray(lens - 1)
+        )
+        return caches, lens, np.asarray(logits)
+
     def last_logits(self, ids: np.ndarray) -> np.ndarray:
         """Bucket the context length (pow2) so round-over-round growth reuses
         compiled shapes instead of retracing every round."""
         return self.last_logits_ragged([list(row) for row in ids])
+
+    @staticmethod
+    def _pad_ragged(seqs: list[list[int]]):
+        """Right-pad ragged sequences to a pow2 bucket (the shared shape
+        discipline of the cached and uncached drafter paths)."""
+        from bloombee_tpu.runtime.executor import next_pow2
+
+        n = len(seqs)
+        lens = np.asarray([len(q) for q in seqs], np.int32)
+        sb = next_pow2(int(lens.max()), floor=8)
+        padded = np.zeros((n, sb), dtype=np.int64)
+        for i, q in enumerate(seqs):
+            padded[i, : len(q)] = q
+        return padded, lens
 
     def last_logits_ragged(self, seqs: list[list[int]]) -> np.ndarray:
         """Per-sequence next-token logits for ragged contexts (batched
         speculative rows have per-row lengths); right-padded to a pow2
         bucket, with the per-row `last` index selecting the true end (the
         causal mask keeps padding invisible)."""
-        from bloombee_tpu.runtime.executor import next_pow2
-
-        n = len(seqs)
-        lens = [len(q) for q in seqs]
-        sb = next_pow2(max(lens), floor=8)
-        padded = np.zeros((n, sb), dtype=np.int64)
-        for i, q in enumerate(seqs):
-            padded[i, : len(q)] = q
-        last = np.asarray([ln - 1 for ln in lens], dtype=np.int32)
+        padded, lens = self._pad_ragged(seqs)
         return np.asarray(
-            self._last_logits(jnp.asarray(padded), jnp.asarray(last))
+            self._last_logits(jnp.asarray(padded), jnp.asarray(lens - 1))
         )
 
 
 class GreedyTreeDrafter:
-    """Top-k tree expansion with static branching per depth."""
+    """Top-k tree expansion with static branching per depth.
 
-    def __init__(self, model: LocalJaxDraftModel, branching=(2, 2, 1)):
+    `adaptive=True` retunes the branching tuple every few rounds from the
+    observed per-depth acceptance histogram, under the initial tree's node
+    budget (reference spec_decoding_tree_shape.py:116-250 Sequoia-style
+    width optimization)."""
+
+    def __init__(
+        self, model: LocalJaxDraftModel, branching=(2, 2, 1),
+        adaptive: bool = False, retune_every: int = 8,
+    ):
+        from bloombee_tpu.spec.shape import AcceptanceStats, tree_nodes
+
         self.model = model
         self.branching = tuple(branching)
+        self.adaptive = adaptive
+        self.retune_every = retune_every
+        self.stats = AcceptanceStats()
+        self._budget_nodes = tree_nodes(self.branching)
+        self._rounds = 0
+
+    def observe(self, accepted_lens: list[int]) -> None:
+        """Feed per-row accepted DRAFTED-level counts from a verify round;
+        periodically re-choose the branching when adaptive."""
+        from bloombee_tpu.spec.shape import choose_branching
+
+        for a in accepted_lens:
+            self.stats.observe(int(a), self.branching)
+        self._rounds += 1
+        if self.adaptive and self._rounds % self.retune_every == 0:
+            self.branching = choose_branching(
+                self.stats, budget_nodes=self._budget_nodes
+            )
 
     def build(self, context_ids: np.ndarray) -> tuple[DraftTree, np.ndarray]:
         """context_ids [S] -> (tree, draft_probs [T, V])."""
@@ -116,27 +224,40 @@ class GreedyTreeDrafter:
         tokens = [[] for _ in range(bsz)]
         parents: list[int] = []  # shared across rows
         probs = [[] for _ in range(bsz)]
-        # per-row frontier: list of (parent_index, path_ids)
-        frontiers = [[(-1, list(c))] for c in contexts]
-        for width in self.branching:
-            n = len(frontiers[0])
-            seqs = [f[1] for fr in frontiers for f in fr]  # [bsz*n] ragged
-            logits = self.model.last_logits_ragged(seqs).reshape(
-                bsz, n, -1
-            )  # [bsz, n, V]
+        # one context pass builds per-layer KV caches; each level reruns
+        # only its short path suffix against them
+        caches, ctx_lens, logits0 = self.model.prefill_ragged(contexts)
+        logits = logits0[:, None, :]  # [bsz, 1, V]: level-0 frontier
+        # per-row frontier: list of (parent_index, suffix_token_list)
+        frontiers = [[(-1, [])] for _ in range(bsz)]
+        for level, width in enumerate(self.branching):
             p = _softmax(logits)
             top = np.argsort(-logits, axis=-1)[..., :width]  # [bsz, n, w]
             for r in range(bsz):
                 new_frontier = []
-                for fi, (parent, path) in enumerate(frontiers[r]):
+                for fi, (parent, suffix) in enumerate(frontiers[r]):
                     for tok in top[r, fi]:
                         idx = len(tokens[r])
                         tokens[r].append(int(tok))
                         probs[r].append(p[r, fi])
-                        new_frontier.append((idx, path + [int(tok)]))
+                        new_frontier.append((idx, suffix + [int(tok)]))
                         if r == 0:
                             parents.append(parent)  # structure shared
                 frontiers[r] = new_frontier
+            if level + 1 < len(self.branching):
+                n = len(frontiers[0])
+                suffix_ids = np.asarray(
+                    [f[1] for fr in frontiers for f in fr], np.int64
+                )  # [bsz*n, level+1]
+                row_of = np.repeat(np.arange(bsz), n)
+                logits = np.asarray(
+                    self.model._suffix_logits(
+                        caches,
+                        jnp.asarray(ctx_lens),
+                        jnp.asarray(row_of),
+                        jnp.asarray(suffix_ids),
+                    )
+                ).reshape(bsz, n, -1)
         par = np.asarray(parents, dtype=np.int32)
         trees = [
             DraftTree(tokens=np.asarray(tokens[r]), parents=par.copy())

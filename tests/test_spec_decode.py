@@ -387,3 +387,140 @@ def test_e2e_speculative_pruned_midchain(tmp_path):
         await reg.stop()
 
     asyncio.run(run())
+
+
+def test_drafter_cached_matches_uncached():
+    """The prefix-KV cached drafter must build exactly the trees the
+    recompute-everything path built (same top-k expansions)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bloombee_tpu.models.spec import ModelSpec
+    from bloombee_tpu.models.llama.block import init_block_params
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+    from bloombee_tpu.utils.tree import unstack_params
+
+    spec = ModelSpec(
+        family="llama", hidden_size=32, intermediate_size=64,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        num_hidden_layers=2, vocab_size=64,
+    )
+    blocks = [
+        init_block_params(jax.random.PRNGKey(i), spec) for i in range(2)
+    ]
+    rng = jax.random
+    client = {
+        "embed": rng.normal(rng.PRNGKey(7), (64, 32)) * 0.1,
+        "norm": jnp.ones((32,)),
+        "lm_head": rng.normal(rng.PRNGKey(8), (32, 64)) * 0.1,
+    }
+    model = LocalJaxDraftModel(spec, blocks, client)
+    drafter = GreedyTreeDrafter(model, branching=(2, 2, 1))
+    contexts = [[1, 5, 9, 2], [3, 3, 3, 3, 3, 7]]
+
+    trees, probs = drafter.build_batch(contexts)
+
+    # uncached reference: full recompute per level via last_logits_ragged
+    def build_uncached(ctx):
+        tokens, parents = [], []
+        frontier = [(-1, list(ctx))]
+        for width in drafter.branching:
+            seqs = [f[1] for f in frontier]
+            logits = model.last_logits_ragged(seqs)
+            top = np.argsort(-logits, axis=-1)[:, :width]
+            new_frontier = []
+            for fi, (parent, path) in enumerate(frontier):
+                for tok in top[fi]:
+                    idx = len(tokens)
+                    tokens.append(int(tok))
+                    parents.append(parent)
+                    new_frontier.append((idx, path + [int(tok)]))
+            frontier = new_frontier
+        return tokens, parents
+
+    # numerical agreement first (the robust contract: cached and uncached
+    # attention reduce in different orders, so logits match to tolerance)
+    l_cached = model.prefill_ragged(contexts)[2]
+    l_uncached = model.last_logits_ragged(contexts)
+    np.testing.assert_allclose(l_cached, l_uncached, atol=1e-4, rtol=1e-4)
+    for r, ctx in enumerate(contexts):
+        ref_tokens, ref_parents = build_uncached(ctx)
+        np.testing.assert_array_equal(trees[r].tokens, ref_tokens)
+        np.testing.assert_array_equal(trees[r].parents, ref_parents)
+
+
+def test_shape_chooser_prefers_depth_when_accepts_are_high():
+    from bloombee_tpu.spec.shape import (
+        AcceptanceStats,
+        choose_branching,
+        expected_accepted,
+        tree_nodes,
+    )
+
+    assert tree_nodes((2, 2, 1)) == 11
+
+    hot = AcceptanceStats()
+    cold = AcceptanceStats()
+    for _ in range(200):
+        hot.observe(3, (2, 2, 2))   # everything accepts
+        cold.observe(0, (2, 2, 2))  # nothing ever accepts
+    deep, shallow = (2, 2, 2), (4,)
+    assert expected_accepted(deep, hot) > expected_accepted(shallow, hot)
+    chosen_hot = choose_branching(hot, budget_nodes=15)
+    chosen_cold = choose_branching(cold, budget_nodes=15)
+    assert len(chosen_hot) >= 2  # deep pays off when accepts are high
+    assert tree_nodes(chosen_cold) <= tree_nodes(chosen_hot)
+
+
+def test_e2e_adaptive_drafter_stays_exact(tmp_path):
+    """Adaptive tree shaping retunes branching mid-generation; tokens must
+    stay exactly greedy."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.client.speculative import generate_speculative
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "model")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s = BlockServer(model_uid="m", start=0, end=3, model_dir=d,
+                        registry=RegistryClient("127.0.0.1", reg.port),
+                        compute_dtype=jnp.float32, num_pages=256,
+                        page_size=4)
+        await s.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, RegistryClient("127.0.0.1", reg.port), model_uid="m"
+        )
+        drafter = GreedyTreeDrafter(
+            LocalJaxDraftModel.from_dir(d), branching=(2, 2),
+            adaptive=True, retune_every=2,
+        )
+        input_ids = np.arange(5)[None, :]
+        n_new = 14
+        spec_ids = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=n_new
+        )
+        plain_ids = await model.generate(input_ids, max_new_tokens=n_new)
+        np.testing.assert_array_equal(spec_ids, plain_ids)
+        assert drafter.stats.tries.sum() > 0  # feedback actually flowed
+        await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
