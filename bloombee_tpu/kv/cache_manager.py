@@ -40,6 +40,11 @@ env.declare(
     "quantize host-parked KV of dense arenas to int4 (4x less host DRAM)",
 )
 env.declare(
+    "BBTPU_DISK_DIR", str, "",
+    "directory for disk-parked KV memmaps (empty = system temp dir); the "
+    "reference's TorchDisk tier",
+)
+env.declare(
     "BBTPU_KV_QUANT", str, "none",
     "KV cache quantization: none | int4 (group-wise 4-bit device arena + "
     "quantized host parking, ~3.2x token capacity; reference "
@@ -273,9 +278,13 @@ class CacheManager:
         )
 
     # ------------------------------------------------------- host tiering
-    def park_sequence(self, seq_id: int) -> None:
-        """Move one sequence's KV to host DRAM and free its device pages.
+    def park_sequence(self, seq_id: int, tier: str = "host") -> None:
+        """Move one sequence's KV off the device and free its pages.
 
+        tier="host": KV lands in host DRAM numpy (optionally int4 via
+        BBTPU_PARK_QUANT). tier="disk": KV lands in a memmapped file under
+        BBTPU_DISK_DIR — the third tier of the reference's FlexGen substrate
+        (pytorch_backend.py TorchDisk, np.memmap-backed tensors).
         Lengths are preserved; `unpark_sequence` restores (possibly to
         different pages). This is the paged equivalent of the reference's
         micro-batch KV offload to CPU staging
@@ -305,11 +314,42 @@ class CacheManager:
 
             k_host = jax.tree.map(take, self.arena["k"])  # [L, n, kv, hd]
             v_host = jax.tree.map(take, self.arena["v"])
+        if tier == "disk":
+            k_host = jax.tree.map(
+                lambda a, tag=("k", seq_id): self._to_disk(a, *tag), k_host
+            )
+            v_host = jax.tree.map(
+                lambda a, tag=("v", seq_id): self._to_disk(a, *tag), v_host
+            )
+        elif tier != "host":
+            raise ValueError(f"unknown park tier {tier!r}")
         self._parked[seq_id] = (k_host, v_host, state.l_acc, state.l_seq)
         # free device pages but keep the seq registered with zero length
         state.l_acc = 0
         state.l_seq = 0
         self.table.rollback(seq_id)
+
+    _disk_counter = itertools.count()
+
+    def _to_disk(self, arr: np.ndarray, kind: str, seq_id: int) -> np.ndarray:
+        """Spill one parked leaf to a memmapped file (deleted when the
+        memmap is garbage-collected via the unlink-after-open trick on
+        POSIX: the file keeps living until the mapping drops)."""
+        import os
+        import tempfile
+
+        disk_dir = env.get("BBTPU_DISK_DIR") or tempfile.gettempdir()
+        os.makedirs(disk_dir, exist_ok=True)
+        path = os.path.join(
+            disk_dir,
+            f"bbtpu_kv_{os.getpid()}_{kind}{seq_id}_"
+            f"{next(self._disk_counter)}.bin",
+        )
+        mm = np.memmap(path, dtype=arr.dtype, mode="w+", shape=arr.shape)
+        mm[:] = arr
+        mm.flush()
+        os.unlink(path)  # POSIX: mapping keeps the data until released
+        return mm
 
     def unpark_sequence(self, seq_id: int) -> None:
         k_host, v_host, l_acc, l_seq = self._parked[seq_id]

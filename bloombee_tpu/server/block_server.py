@@ -333,7 +333,13 @@ class BlockServer:
 
     # ------------------------------------------------------------------- RPCs
     async def _rpc_info(self, meta: dict, tensors):
-        return {"server_id": self.server_id, **self.server_info().to_wire()}, []
+        import time as _time
+
+        return {
+            "server_id": self.server_id,
+            "server_time": _time.time(),  # NTP-style clock sync anchor
+            **self.server_info().to_wire(),
+        }, []
 
     async def _rpc_inference(self, stream: Stream) -> None:
         """One decode session. Open meta: {session_id, batch_size, max_length,

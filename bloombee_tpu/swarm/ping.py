@@ -22,6 +22,14 @@ class PingAggregator:
         self.stale_after = stale_after
         self._rtt: dict[str, float] = {}
         self._measured_at: dict[str, float] = {}
+        # NTP-style peer clock offsets (reference handler.py:498-575): lets
+        # timing tables attribute ONE-WAY wire time across machines
+        self._clock_offset: dict[str, float] = {}
+
+    def clock_offset(self, peer_id: str) -> float | None:
+        """Estimated peer_clock - local_clock in seconds (None until the
+        peer has replied with a timestamp)."""
+        return self._clock_offset.get(peer_id)
 
     def record(self, peer_id: str, rtt: float) -> None:
         old = self._rtt.get(peer_id)
@@ -56,13 +64,22 @@ class PingAggregator:
         from bloombee_tpu.wire.rpc import connect
 
         t0 = time.perf_counter()
+        t0_wall = time.time()
         try:
             conn = await asyncio.wait_for(connect(host, port), timeout)
             try:
-                await asyncio.wait_for(conn.call("rpc_info", {}, []), timeout)
+                meta, _ = await asyncio.wait_for(
+                    conn.call("rpc_info", {}, []), timeout
+                )
             finally:
                 await conn.close()
             rtt = time.perf_counter() - t0
+            server_time = meta.get("server_time")
+            if server_time is not None:
+                # NTP midpoint: the server stamped ~rtt/2 after our send
+                self._clock_offset[peer_id] = float(server_time) - (
+                    t0_wall + rtt / 2.0
+                )
         except Exception:
             rtt = FAILED_RTT_S
         self.record(peer_id, rtt)

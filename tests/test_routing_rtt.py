@@ -124,3 +124,44 @@ def test_e2e_pings_measured_and_next_pings_announced(tmp_path):
         await reg.stop()
 
     asyncio.run(run())
+
+
+def test_clock_offset_measured(tmp_path):
+    """NTP-style clock sync (reference handler.py:498-575): pings record a
+    per-peer clock offset near zero on one host."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
+        max_position_embeddings=64, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(config).eval().save_pretrained(
+        tmp_path, safe_serialization=True
+    )
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s = BlockServer(model_uid="m", start=0, end=2,
+                        model_dir=str(tmp_path),
+                        registry=RegistryClient("127.0.0.1", reg.port),
+                        compute_dtype=jnp.float32, num_pages=16, page_size=4)
+        await s.start()
+        m = RemoteSequenceManager(
+            RegistryClient("127.0.0.1", reg.port), "m", 2
+        )
+        await m.update(force=True)
+        off = m.pinger.clock_offset(s.server_id)
+        assert off is not None and abs(off) < 0.5, off  # same host clock
+        await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
