@@ -290,6 +290,9 @@ class CacheManager:
         micro-batch KV offload to CPU staging
         (memory_cache_manager.py:972-1335).
         """
+        if tier not in ("host", "disk"):
+            # before the expensive d2h copy, not after
+            raise ValueError(f"unknown park tier {tier!r}")
         slots = self.table.prefix_slots(seq_id, committed_only=False)
         state = self.table.seq(seq_id)
 
@@ -321,8 +324,6 @@ class CacheManager:
             v_host = jax.tree.map(
                 lambda a, tag=("v", seq_id): self._to_disk(a, *tag), v_host
             )
-        elif tier != "host":
-            raise ValueError(f"unknown park tier {tier!r}")
         self._parked[seq_id] = (k_host, v_host, state.l_acc, state.l_seq)
         # free device pages but keep the seq registered with zero length
         state.l_acc = 0
@@ -338,6 +339,8 @@ class CacheManager:
         import os
         import tempfile
 
+        if arr.size == 0:
+            return arr  # np.memmap cannot map an empty file
         disk_dir = env.get("BBTPU_DISK_DIR") or tempfile.gettempdir()
         os.makedirs(disk_dir, exist_ok=True)
         path = os.path.join(

@@ -54,6 +54,7 @@ class PingAggregator:
         ]:
             self._rtt.pop(pid, None)
             self._measured_at.pop(pid, None)
+            self._clock_offset.pop(pid, None)
         return dict(self._rtt)
 
     async def measure(
@@ -64,21 +65,24 @@ class PingAggregator:
         from bloombee_tpu.wire.rpc import connect
 
         t0 = time.perf_counter()
-        t0_wall = time.time()
         try:
             conn = await asyncio.wait_for(connect(host, port), timeout)
             try:
+                # stamp AFTER connect: the NTP midpoint must halve only the
+                # rpc round trip, not the TCP handshake
+                t_call = time.perf_counter()
+                t_call_wall = time.time()
                 meta, _ = await asyncio.wait_for(
                     conn.call("rpc_info", {}, []), timeout
                 )
+                call_rtt = time.perf_counter() - t_call
             finally:
                 await conn.close()
             rtt = time.perf_counter() - t0
             server_time = meta.get("server_time")
             if server_time is not None:
-                # NTP midpoint: the server stamped ~rtt/2 after our send
                 self._clock_offset[peer_id] = float(server_time) - (
-                    t0_wall + rtt / 2.0
+                    t_call_wall + call_rtt / 2.0
                 )
         except Exception:
             rtt = FAILED_RTT_S
