@@ -27,7 +27,18 @@ import numpy as np
 from bloombee_tpu.client.model import DistributedModelForCausalLM
 from bloombee_tpu.spec.drafter import GreedyTreeDrafter
 from bloombee_tpu.spec.tree import DraftTree, tree_attention_mask
-from bloombee_tpu.spec.verify import accept_greedy
+from bloombee_tpu.spec.verify import _softmax, accept_greedy, accept_sampling
+
+
+def _pick(
+    logits: np.ndarray, do_sample: bool, temperature: float,
+    rng: np.random.Generator,
+) -> np.ndarray:
+    """Per-row token choice: delegates to the model's selection logic so
+    speculative and plain generate can never drift. [B, V] -> [B]."""
+    return DistributedModelForCausalLM._select(
+        logits, do_sample, temperature, 1.0, rng
+    )
 
 
 def _per_span_accepts(
@@ -51,6 +62,9 @@ async def generate_speculative(
     session=None,
     prune_threshold: float | None = None,  # mid-chain pruning (relay mode)
     prune_max_keep: int | None = None,
+    do_sample: bool = False,  # SpecInfer rejection sampling per row
+    temperature: float = 1.0,
+    seed: int = 0,
 ) -> np.ndarray:
     input_ids = np.asarray(input_ids)
     b, s = input_ids.shape
@@ -59,6 +73,12 @@ async def generate_speculative(
         for i in range(len(drafter.branching))
     )
     max_length = s + max_new_tokens + (tree_size + 1) * 2  # tree spike room
+    if do_sample and prune_threshold is not None:
+        raise ValueError(
+            "sampling accept needs real logits at every node; mid-chain "
+            "pruning zeroes pruned rows — use one or the other"
+        )
+    rng = np.random.default_rng(seed)
     own = session is None
     if own:
         session = model.inference_session(max_length, b)
@@ -73,7 +93,7 @@ async def generate_speculative(
         # prefill -> logits at each row's last prompt token
         out = await session.step(model.embed(input_ids), ids=input_ids)
         root_logits = np.array(model.logits(out[:, -1:])[:, 0])  # [B, V]
-        bonus = np.argmax(root_logits, axis=-1)  # [B]
+        bonus = _pick(root_logits, do_sample, temperature, rng)  # [B]
         new_rows = [[int(bonus[i])] for i in range(b)]
         pending_accept = None  # original-space accepts per row
         pending_spans = None  # per-span accepts for pruned chains
@@ -161,18 +181,41 @@ async def generate_speculative(
                     pending_accept.append(np.asarray([], dtype=np.int64))
                     committed_rows.append([])
                     continue
-                tree_i = DraftTree(tokens=toks[i], parents=parents)
-                accepted, _ = accept_greedy(
-                    tree_i, root_logits[i], logits[i],
-                    verifiable=None if verifiable is None else verifiable[i],
-                )
+                if do_sample:
+                    # SpecInfer rejection sampling over the drafter's
+                    # sub-tree (node 0 is the committed bonus; targets at
+                    # its children come from logits[0])
+                    accepted_sub, nxt = accept_sampling(
+                        subs[i], logits[i][0], logits[i][1:], _probs[i],
+                        rng, temperature,
+                    )
+                    accepted = [0] + [a + 1 for a in accepted_sub]
+                else:
+                    tree_i = DraftTree(tokens=toks[i], parents=parents)
+                    accepted, _ = accept_greedy(
+                        tree_i, root_logits[i], logits[i],
+                        verifiable=(
+                            None if verifiable is None else verifiable[i]
+                        ),
+                    )
                 assert accepted and accepted[0] == 0
                 drafted_accepts.append(len(accepted) - 1)  # excl. node 0
                 # cap so the row lands on EXACTLY max_new_tokens with its
                 # last token an uncommitted bonus — the same resume contract
                 # as plain generate (last returned token not yet stepped)
+                full_len = len(accepted)
                 accepted = accepted[: 1 + max(room - 1, 0)]
-                nxt = int(np.argmax(logits[i][accepted[-1]]))
+                if do_sample:
+                    if len(accepted) < full_len:
+                        # truncated: the discarded children were never
+                        # rejected, so the bonus is a plain sample from the
+                        # last kept node's target distribution
+                        nxt = int(_pick(
+                            logits[i][accepted[-1]][None], True,
+                            temperature, rng,
+                        )[0])
+                else:
+                    nxt = int(np.argmax(logits[i][accepted[-1]]))
                 pending_accept.append(np.asarray(accepted))
                 committed_rows.append([int(toks[i][a]) for a in accepted])
                 root_logits[i] = logits[i][accepted[-1]]

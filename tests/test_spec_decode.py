@@ -524,3 +524,70 @@ def test_e2e_adaptive_drafter_stays_exact(tmp_path):
         await reg.stop()
 
     asyncio.run(run())
+
+
+def test_e2e_speculative_sampling(tmp_path):
+    """Sampling-mode speculative decode (SpecInfer rejection sampling): at
+    near-zero temperature it equals greedy; at temperature 1 it runs and is
+    reproducible per seed."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.client.speculative import generate_speculative
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "model")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s = BlockServer(model_uid="m", start=0, end=3, model_dir=d,
+                        registry=RegistryClient("127.0.0.1", reg.port),
+                        compute_dtype=jnp.float32, num_pages=256,
+                        page_size=4)
+        await s.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, RegistryClient("127.0.0.1", reg.port), model_uid="m"
+        )
+        drafter = GreedyTreeDrafter(
+            LocalJaxDraftModel.from_dir(d), branching=(2, 1)
+        )
+        input_ids = np.arange(2 * 5).reshape(2, 5) % 120
+        n_new = 6
+
+        cold = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=n_new,
+            do_sample=True, temperature=1e-4, seed=0,
+        )
+        greedy = await model.generate(input_ids, max_new_tokens=n_new)
+        np.testing.assert_array_equal(cold, greedy)
+
+        hot1 = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=n_new,
+            do_sample=True, temperature=1.0, seed=7,
+        )
+        hot2 = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=n_new,
+            do_sample=True, temperature=1.0, seed=7,
+        )
+        assert hot1.shape == (2, 5 + n_new)
+        np.testing.assert_array_equal(hot1, hot2)  # seed-reproducible
+
+        await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
