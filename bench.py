@@ -32,7 +32,38 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _require_backend(timeout_s: float = 180.0):
+    """Fail fast (instead of hanging forever) when the TPU tunnel is down:
+    backend init on a dead tunnel blocks indefinitely inside PJRT."""
+    import threading
+
+    devices = []
+    err = []
+
+    def probe():
+        try:
+            import jax
+
+            devices.extend(jax.devices())
+        except Exception as e:  # pragma: no cover
+            err.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        log(f"FATAL: jax backend init did not finish in {timeout_s:.0f}s "
+            "(TPU tunnel down?)")
+        import os
+
+        os._exit(3)
+    if err:
+        log(f"FATAL: jax backend init failed: {err[0]}")
+        raise SystemExit(3)
+
+
 def main():
+    _require_backend()
     import jax
     import jax.numpy as jnp
     from jax import lax

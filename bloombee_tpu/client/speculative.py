@@ -27,7 +27,7 @@ import numpy as np
 from bloombee_tpu.client.model import DistributedModelForCausalLM
 from bloombee_tpu.spec.drafter import GreedyTreeDrafter
 from bloombee_tpu.spec.tree import DraftTree, tree_attention_mask
-from bloombee_tpu.spec.verify import _softmax, accept_greedy, accept_sampling
+from bloombee_tpu.spec.verify import accept_greedy, accept_sampling
 
 
 def _pick(

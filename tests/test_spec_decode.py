@@ -591,3 +591,36 @@ def test_e2e_speculative_sampling(tmp_path):
         await reg.stop()
 
     asyncio.run(run())
+
+
+def test_accept_sampling_preserves_target_distribution():
+    """The emitted token (accepted draft or bonus) must be distributed
+    exactly as softmax(target/T), with DETERMINISTIC top-k proposals — the
+    way our drafter actually proposes (the SpecInfer min(1,p/q) rule would
+    be biased here)."""
+    from bloombee_tpu.spec.verify import _softmax
+
+    vocab = 6
+    rng0 = np.random.default_rng(42)
+    target_logits = rng0.normal(size=vocab) * 1.5
+    drafter_logits = rng0.normal(size=vocab) * 1.5
+    top2 = np.argsort(-drafter_logits)[:2]  # deterministic proposals
+    for temperature in (1.0, 0.5):
+        counts = np.zeros(vocab)
+        n = 40000
+        rng = np.random.default_rng(0)
+        tree = DraftTree(
+            tokens=np.asarray(top2), parents=np.asarray([-1, -1])
+        )
+        dummy = np.zeros((2, vocab), np.float32)
+        for _ in range(n):
+            accepted, bonus = accept_sampling(
+                tree, target_logits, dummy, _softmax(drafter_logits[None]),
+                rng, temperature=temperature,
+            )
+            tok = int(tree.tokens[accepted[0]]) if accepted else bonus
+            counts[tok] += 1
+        emp = counts / n
+        tgt = _softmax(target_logits[None] / temperature)[0]
+        tv = 0.5 * np.abs(emp - tgt).sum()
+        assert tv < 0.02, (temperature, tv, emp.round(3), tgt.round(3))
