@@ -52,6 +52,16 @@ env.declare(
     "BBTPU_DUMP_LIMIT", int, 100,
     "max activation dumps per server process",
 )
+env.declare(
+    "BBTPU_PRUNER_TRAIN", bool, False,
+    "train the MidLMHead online from accepted speculative paths (reference "
+    "lm_head_trainer)",
+)
+env.declare(
+    "BBTPU_PRUNER_CKPT", str, "",
+    "pruner-head checkpoint path: loaded at init if present, saved every "
+    "50 train steps",
+)
 
 
 class _Session:
@@ -70,6 +80,9 @@ class _Session:
         self.sum_dispatch_ms = 0.0
         self.sum_fetch_ms = 0.0
         self.opened_at = 0.0
+        # last pruned tree step's (hidden, tokens, parents) for online
+        # pruner-head training when its accept arrives
+        self.last_tree = None
 
 
 class _PeerPool:
@@ -460,6 +473,14 @@ class BlockServer:
         # rows onto the committed prefix before this step's compute
         accept = meta.get("accept")
         if accept is not None:
+            if session.last_tree is not None:
+                # background: training must never stall the event loop or
+                # delay this accept's own step
+                task = asyncio.create_task(
+                    self._train_pruner_head(session, accept)
+                )
+                session.step_tasks.add(task)
+                task.add_done_callback(session.step_tasks.discard)
             await self.compute.submit(
                 PRIORITY_INFERENCE,
                 self.manager.accept_speculative,
@@ -553,6 +574,15 @@ class BlockServer:
             # session and the liveness announce)
             await self._ensure_pruner_loaded()
             keep = self._prune_tree(out, prune)
+            if env.get("BBTPU_PRUNER_TRAIN"):
+                # retain this tree's mid hidden; the accept that names the
+                # full model's true path arrives with the NEXT step and
+                # becomes the head's training signal
+                session.last_tree = (
+                    np.asarray(out, dtype=np.float32),
+                    np.asarray(prune["tokens"], dtype=np.int64),
+                    np.asarray(prune["parents"], dtype=np.int32),
+                )
             if keep is not None:
                 gather = np.where(keep >= 0, keep, 0)
                 out = np.stack(
@@ -658,6 +688,46 @@ class BlockServer:
             end_block=self.end_block,
         )
 
+    async def _train_pruner_head(self, session: _Session, accept: list):
+        """Online MidLMHead training (reference lm_head_trainer): each
+        accepted (parent -> child) edge supplies (mid_hidden[parent],
+        token[child]) — the full model chose token[child] there, so the
+        head learns to predict it from mid-network state. Device work runs
+        on the compute queue at training priority; file I/O off-loop."""
+        mgr = self._pruner_manager
+        if mgr is None or getattr(mgr, "trainer", None) is None:
+            session.last_tree = None
+            return
+        hidden, tokens, _parents = session.last_tree
+        session.last_tree = None
+        feats, targets = [], []
+        for i, acc in enumerate(accept):
+            path = [int(a) for a in np.asarray(acc).ravel()]
+            for parent, child in zip(path, path[1:]):
+                feats.append(hidden[i, parent])
+                targets.append(int(tokens[i, child]))
+        if not feats:
+            return
+        try:
+            loss = await self.compute.submit(
+                PRIORITY_TRAINING, mgr.trainer.train_step,
+                np.stack(feats), np.asarray(targets, dtype=np.int64),
+            )
+        except Exception as e:
+            logger.warning("pruner-head train step failed: %s", e)
+            return
+        if env.log_channel_enabled("spec"):
+            logger.info(
+                "[pruner-train] step=%d pairs=%d loss=%.3f",
+                mgr.trainer.steps, len(targets), loss,
+            )
+        ckpt = env.get("BBTPU_PRUNER_CKPT")
+        if ckpt and mgr.trainer.steps % 50 == 0:
+            try:
+                await asyncio.to_thread(mgr.trainer.save, ckpt)
+            except Exception as e:
+                logger.warning("pruner checkpoint save failed: %s", e)
+
     def _prune_tree(self, out: np.ndarray, prune: dict):
         """Per-row keep indices from the MidLMHead over this span's output
         hidden; None if no pruner weight is available (degrade to full)."""
@@ -699,17 +769,40 @@ class BlockServer:
             self._pruner_unavailable = True
             return
         try:
-            from bloombee_tpu.models.checkpoint import load_client_params
-            from bloombee_tpu.spec.pruner import PrunerManager
+            import os
 
-            client = load_client_params(
-                self.model_dir, dtype=self.compute_dtype
-            )
+            from bloombee_tpu.spec.pruner import MidHeadTrainer, PrunerManager
+
             mgr = PrunerManager()
-            mgr.ensure_head(
-                client["lm_head"], client.get("norm"),
-                self.spec.rms_norm_eps,
-            )
+            ckpt = env.get("BBTPU_PRUNER_CKPT")
+            trainer = None
+            if ckpt and os.path.exists(MidHeadTrainer.ckpt_path(ckpt)):
+                try:
+                    # resume a previously trained head (reference
+                    # adaptive_neural_pruner.load_model)
+                    trainer = MidHeadTrainer.load(
+                        ckpt, dtype=self.compute_dtype
+                    )
+                    mgr._head = trainer.head
+                except Exception as e:
+                    # a torn checkpoint must degrade to fresh init, never
+                    # disable pruning outright
+                    logger.warning(
+                        "pruner checkpoint unreadable (%s); fresh init", e
+                    )
+                    trainer = None
+            if trainer is None:
+                from bloombee_tpu.models.checkpoint import load_client_params
+
+                client = load_client_params(
+                    self.model_dir, dtype=self.compute_dtype
+                )
+                mgr.ensure_head(
+                    client["lm_head"], client.get("norm"),
+                    self.spec.rms_norm_eps,
+                )
+                trainer = MidHeadTrainer(mgr._head)
+            mgr.trainer = trainer
             self._pruner_manager = mgr
         except Exception as e:
             logger.warning("pruner unavailable: %s", e)

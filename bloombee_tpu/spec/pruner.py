@@ -102,6 +102,91 @@ class SimpleProbabilityPruner:
         return out
 
 
+class MidHeadTrainer:
+    """Online trainer for the MidLMHead (reference lm_head_trainer.py): SGD
+    on cross-entropy between the head's prediction at a node's mid hidden
+    and the token the FULL model actually chose there (the accepted child).
+    Save/load round-trips the weight as .npz (reference
+    adaptive_neural_pruner.save_model/load_model:497-515)."""
+
+    def __init__(self, head: MidLMHead, lr: float = 1e-3):
+        self.head = head
+        self.lr = lr
+        self.steps = 0
+
+    @staticmethod
+    @jax.jit
+    def _step(weight, norm, eps, lr, hidden, targets):
+        """targets == -1 marks padding rows (batches are padded to pow2
+        buckets so live serving doesn't recompile per pair count)."""
+
+        def loss_fn(w):
+            h = hidden
+            if norm is not None:
+                from bloombee_tpu.ops import rms_norm
+
+                h = rms_norm(h, norm, eps)
+            logits = (h @ w).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            valid = targets >= 0
+            safe = jnp.where(valid, targets, 0)
+            token_lp = jnp.take_along_axis(logp, safe[:, None], axis=-1)[:, 0]
+            return -(token_lp * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+        loss, g = jax.value_and_grad(loss_fn)(weight)
+        return weight - lr * g, loss
+
+    def train_step(self, hidden: np.ndarray, targets: np.ndarray) -> float:
+        """hidden [N, D] mid states, targets [N] full-model tokens."""
+        n = len(targets)
+        if n == 0:
+            return 0.0
+        from bloombee_tpu.runtime.executor import next_pow2
+
+        nb = next_pow2(n, floor=4)
+        h_pad = np.zeros((nb, hidden.shape[1]), dtype=np.float32)
+        h_pad[:n] = hidden
+        t_pad = np.full((nb,), -1, dtype=np.int32)
+        t_pad[:n] = targets
+        w, loss = self._step(
+            self.head.weight, self.head.norm, self.head.eps, self.lr,
+            jnp.asarray(h_pad), jnp.asarray(t_pad),
+        )
+        self.head.weight = w
+        self.steps += 1
+        return float(loss)
+
+    @staticmethod
+    def ckpt_path(path: str) -> str:
+        """np.savez appends .npz when missing — normalize so save and the
+        resume-existence check agree on one file name."""
+        return path if path.endswith(".npz") else path + ".npz"
+
+    def save(self, path: str) -> None:
+        import os
+
+        path = self.ckpt_path(path)
+        arrays = {"weight": np.asarray(self.head.weight)}
+        if self.head.norm is not None:
+            arrays["norm"] = np.asarray(self.head.norm)
+        tmp = f"{path}.tmp.npz"
+        np.savez(tmp, steps=self.steps, eps=self.head.eps, **arrays)
+        os.replace(tmp, path)  # atomic: a crash can't leave a torn file
+
+    @classmethod
+    def load(cls, path: str, lr: float = 1e-3, dtype=None) -> "MidHeadTrainer":
+        data = np.load(cls.ckpt_path(path))
+        weight = jnp.asarray(data["weight"])
+        norm = jnp.asarray(data["norm"]) if "norm" in data else None
+        if dtype is not None:
+            weight = weight.astype(dtype)
+            norm = norm.astype(dtype) if norm is not None else None
+        head = MidLMHead(weight, norm, float(data["eps"]))
+        trainer = cls(head, lr=lr)
+        trainer.steps = int(data["steps"])
+        return trainer
+
+
 class PrunerManager:
     """Lazy-init + method dispatch (reference pruner_manager.py): owns the
     MidLMHead and the active pruning strategy."""

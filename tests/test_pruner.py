@@ -58,3 +58,98 @@ def test_keep_indices_padding_and_cap():
         tree, probs, root
     )
     assert kept.tolist() == [0, 1, 2]  # single children renormalize to 1.0
+
+def test_mid_head_trainer_learns_and_checkpoints(tmp_path):
+    """Online MidLMHead training (reference lm_head_trainer): CE drops on a
+    fixed batch, and save/load round-trips the trained weight."""
+    import jax
+    import jax.numpy as jnp
+
+    from bloombee_tpu.spec.pruner import MidHeadTrainer, MidLMHead
+
+    rng = np.random.default_rng(0)
+    d, v, n = 16, 32, 64
+    head = MidLMHead(
+        jnp.asarray(rng.normal(size=(d, v)).astype(np.float32) * 0.1),
+        jnp.ones((d,), jnp.float32),
+    )
+    trainer = MidHeadTrainer(head, lr=0.5)
+    hidden = rng.normal(size=(n, d)).astype(np.float32)
+    targets = rng.integers(0, v, size=(n,))
+    losses = [trainer.train_step(hidden, targets) for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.8, losses[:3] + losses[-3:]
+
+    path = str(tmp_path / "pruner_head.npz")
+    trainer.save(path)
+    loaded = MidHeadTrainer.load(path)
+    np.testing.assert_array_equal(
+        np.asarray(loaded.head.weight), np.asarray(trainer.head.weight)
+    )
+    assert loaded.steps == trainer.steps
+
+
+def test_e2e_pruner_online_training(tmp_path, monkeypatch):
+    """Pruned speculative decode with BBTPU_PRUNER_TRAIN: the head trains
+    on accepted paths while tokens stay exactly greedy."""
+    import asyncio
+
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.client.speculative import generate_speculative
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    monkeypatch.setenv("BBTPU_PRUNER_TRAIN", "1")
+    ckpt = str(tmp_path / "head.npz")
+    monkeypatch.setenv("BBTPU_PRUNER_CKPT", ckpt)
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "model")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s1 = BlockServer(model_uid="m", start=0, end=2, model_dir=d,
+                         registry=RegistryClient("127.0.0.1", reg.port),
+                         compute_dtype=jnp.float32, num_pages=256,
+                         page_size=4)
+        s2 = BlockServer(model_uid="m", start=2, end=3, model_dir=d,
+                         registry=RegistryClient("127.0.0.1", reg.port),
+                         compute_dtype=jnp.float32, num_pages=256,
+                         page_size=4)
+        await s1.start()
+        await s2.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, RegistryClient("127.0.0.1", reg.port), model_uid="m",
+            use_push=False,
+        )
+        drafter = GreedyTreeDrafter(
+            LocalJaxDraftModel.from_dir(d), branching=(2, 2)
+        )
+        input_ids = np.arange(5)[None, :]
+        spec_ids = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=8,
+            prune_threshold=0.45,
+        )
+        plain = await model.generate(input_ids, max_new_tokens=8)
+        np.testing.assert_array_equal(spec_ids, plain)
+        trainer = s1._pruner_manager.trainer
+        assert trainer is not None and trainer.steps > 0
+        await s1.stop()
+        await s2.stop()
+        await reg.stop()
+
+    asyncio.run(run())
