@@ -71,6 +71,28 @@ def read_tensor(reader: CheckpointReader, name: str, dtype=None):
     return w.astype(dtype) if dtype is not None else w
 
 
+def stack_expert_weights(
+    reader, expert_fmt: str, gate_name: str, up_name: str, down_name: str,
+    n_experts: int, dtype=None,
+) -> dict:
+    """Stack per-expert gate/up/down matrices into [E, D, I] / [E, I, D]
+    tensors (the dense-over-experts MoE layout shared by Mixtral and
+    Qwen3-MoE loaders). expert_fmt receives the expert index."""
+    import jax.numpy as jnp
+
+    gates, ups, downs = [], [], []
+    for e in range(n_experts):
+        p = expert_fmt.format(e)
+        gates.append(read_tensor(reader, f"{p}.{gate_name}.weight", dtype).T)
+        ups.append(read_tensor(reader, f"{p}.{up_name}.weight", dtype).T)
+        downs.append(read_tensor(reader, f"{p}.{down_name}.weight", dtype).T)
+    return {
+        "experts_gate": jnp.stack(gates),
+        "experts_up": jnp.stack(ups),
+        "experts_down": jnp.stack(downs),
+    }
+
+
 def load_spec(model_dir: str) -> ModelSpec:
     """ModelSpec from a local model dir via the family registry."""
     from bloombee_tpu.models.auto import spec_from_config_dict

@@ -20,16 +20,21 @@ from bloombee_tpu.models.spec import ModelSpec
 def falcon_spec_from_hf(config: Any) -> ModelSpec:
     n_head = config.num_attention_heads
     hidden = config.hidden_size
-    if getattr(config, "new_decoder_architecture", False):
-        raise NotImplementedError(
-            "falcon new_decoder_architecture (grouped fused-QKV layout) is "
-            "not supported yet; falcon-7b-style checkpoints only"
-        )
     if getattr(config, "alibi", False) or getattr(config, "bias", False):
         raise NotImplementedError(
             "falcon-rw variants (alibi/bias) are not supported yet"
         )
-    n_kv = 1 if getattr(config, "multi_query", True) else n_head
+    new_arch = bool(getattr(config, "new_decoder_architecture", False))
+    if new_arch:
+        # falcon-40b/180b: grouped GQA fused QKV + (usually) two parallel
+        # LayerNorms (ln_attn feeds attention, ln_mlp feeds the MLP)
+        n_kv = getattr(config, "num_kv_heads", None) or n_head
+        n_ln = getattr(config, "num_ln_in_parallel_attn", None)
+        if n_ln is None:
+            n_ln = 2
+    else:
+        n_kv = 1 if getattr(config, "multi_query", True) else n_head
+        n_ln = 1
     return ModelSpec(
         family="falcon",
         hidden_size=hidden,
@@ -44,28 +49,55 @@ def falcon_spec_from_hf(config: Any) -> ModelSpec:
         tie_word_embeddings=True,
         norm_type="ln",
         mlp_type="gelu",
-        parallel_attn=getattr(config, "parallel_attn", True),
+        parallel_attn=getattr(config, "parallel_attn", True) or new_arch,
+        num_ln_in_parallel_attn=n_ln,
         alibi=getattr(config, "alibi", False),
     )
 
 
 def _load_block(reader, layer_idx: int, dtype=None) -> dict:
     p = f"transformer.h.{layer_idx}"
-    params = {
-        "input_layernorm": _t(reader, f"{p}.input_layernorm.weight", dtype),
-        "input_layernorm_bias": _t(reader, f"{p}.input_layernorm.bias", dtype),
-    }
     n_head = reader.config["num_attention_heads"]
     d = reader.config["hidden_size"]
     head_dim = d // n_head
-    n_kv = 1 if reader.config.get("multi_query", True) else n_head
+    new_arch = bool(reader.config.get("new_decoder_architecture", False))
+    params = {}
+    if reader.has(f"{p}.ln_attn.weight"):
+        # falcon new-arch dual norms: ln_attn feeds attention (our shared
+        # "input_layernorm" slot), ln_mlp feeds the MLP
+        params["input_layernorm"] = _t(reader, f"{p}.ln_attn.weight", dtype)
+        params["input_layernorm_bias"] = _t(
+            reader, f"{p}.ln_attn.bias", dtype
+        )
+        params["mlp_layernorm"] = _t(reader, f"{p}.ln_mlp.weight", dtype)
+        params["mlp_layernorm_bias"] = _t(reader, f"{p}.ln_mlp.bias", dtype)
+    else:
+        params["input_layernorm"] = _t(
+            reader, f"{p}.input_layernorm.weight", dtype
+        )
+        params["input_layernorm_bias"] = _t(
+            reader, f"{p}.input_layernorm.bias", dtype
+        )
     w = _t(reader, f"{p}.self_attention.query_key_value.weight", dtype)
-    # rows: H query heads, then n_kv k heads, then n_kv v heads
-    q_rows = n_head * head_dim
-    kv_rows = n_kv * head_dim
-    params["q_proj"] = w[:q_rows].T
-    params["k_proj"] = w[q_rows : q_rows + kv_rows].T
-    params["v_proj"] = w[q_rows + kv_rows :].T
+    if new_arch:
+        # grouped layout: per kv group [n_rep q rows | 1 k row | 1 v row]
+        # (HF Falcon _split_heads for new_decoder_architecture)
+        n_kv = reader.config.get("num_kv_heads") or n_head
+        n_rep = n_head // n_kv
+        grouped = w.reshape(n_kv, n_rep + 2, head_dim, d)
+        params["q_proj"] = (
+            grouped[:, :-2].reshape(n_kv * n_rep * head_dim, d).T
+        )
+        params["k_proj"] = grouped[:, -2].reshape(n_kv * head_dim, d).T
+        params["v_proj"] = grouped[:, -1].reshape(n_kv * head_dim, d).T
+    else:
+        n_kv = 1 if reader.config.get("multi_query", True) else n_head
+        # rows: H query heads, then n_kv k heads, then n_kv v heads
+        q_rows = n_head * head_dim
+        kv_rows = n_kv * head_dim
+        params["q_proj"] = w[:q_rows].T
+        params["k_proj"] = w[q_rows : q_rows + kv_rows].T
+        params["v_proj"] = w[q_rows + kv_rows :].T
     params["o_proj"] = _t(reader, f"{p}.self_attention.dense.weight", dtype).T
     params["up_proj"] = _t(reader, f"{p}.mlp.dense_h_to_4h.weight", dtype).T
     params["down_proj"] = _t(reader, f"{p}.mlp.dense_4h_to_h.weight", dtype).T

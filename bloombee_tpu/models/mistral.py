@@ -1,0 +1,46 @@
+"""Mistral family: Llama structure + (optional) all-layer sliding windows.
+
+The reference covers Mistral implicitly through HF wrappers; here it is the
+llama weight layout (identical parameter names) with every layer sliding
+when config.sliding_window is set. The window mask semantics match HF
+(each query attends to at most `sliding_window` keys including itself).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from bloombee_tpu.models.auto import Family, register_family
+from bloombee_tpu.models.llama.block import (
+    HF_BLOCK_KEYS,
+    convert_hf_block_params,
+)
+from bloombee_tpu.models.spec import ModelSpec
+
+
+def mistral_spec_from_hf(config: Any) -> ModelSpec:
+    sliding = getattr(config, "sliding_window", None)
+    return ModelSpec(
+        family="mistral",
+        hidden_size=config.hidden_size,
+        intermediate_size=config.intermediate_size,
+        num_attention_heads=config.num_attention_heads,
+        num_key_value_heads=config.num_key_value_heads,
+        head_dim=getattr(config, "head_dim", None)
+        or config.hidden_size // config.num_attention_heads,
+        num_hidden_layers=config.num_hidden_layers,
+        vocab_size=config.vocab_size,
+        rms_norm_eps=config.rms_norm_eps,
+        rope_theta=getattr(config, "rope_theta", 10000.0),
+        tie_word_embeddings=getattr(config, "tie_word_embeddings", False),
+        layer_types=("sliding",) if sliding else (),
+        sliding_window=sliding or 0,
+    )
+
+
+register_family(
+    Family(
+        "mistral", mistral_spec_from_hf, HF_BLOCK_KEYS,
+        convert_block=convert_hf_block_params,
+    )
+)

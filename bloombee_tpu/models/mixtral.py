@@ -51,16 +51,15 @@ def _load_block(reader, layer_idx: int, dtype=None) -> dict:
     params["router"] = _t(
         reader, f"{p}.block_sparse_moe.gate.weight", dtype
     ).T  # [D, E]
-    n_experts = params["router"].shape[1]
-    gates, ups, downs = [], [], []
-    for e in range(n_experts):
-        ep = f"{p}.block_sparse_moe.experts.{e}"
-        gates.append(_t(reader, f"{ep}.w1.weight", dtype).T)  # [D, I]
-        downs.append(_t(reader, f"{ep}.w2.weight", dtype).T)  # [I, D]
-        ups.append(_t(reader, f"{ep}.w3.weight", dtype).T)  # [D, I]
-    params["experts_gate"] = jnp.stack(gates)
-    params["experts_up"] = jnp.stack(ups)
-    params["experts_down"] = jnp.stack(downs)
+    from bloombee_tpu.models.checkpoint import stack_expert_weights
+
+    # mixtral names: w1 = gate, w3 = up, w2 = down
+    params.update(
+        stack_expert_weights(
+            reader, f"{p}.block_sparse_moe.experts.{{}}", "w1", "w3", "w2",
+            params["router"].shape[1], dtype,
+        )
+    )
     return params
 
 

@@ -46,3 +46,62 @@ def _load_block(reader, layer_idx: int, dtype=None) -> dict:
 register_family(
     Family("qwen3", qwen3_spec_from_hf, HF_BLOCK_KEYS, loader=_load_block)
 )
+
+
+# ---------------------------------------------------------------- qwen3-moe
+def qwen3_moe_spec_from_hf(config: Any) -> ModelSpec:
+    """Qwen3 attention (qk norms) + sparse MoE MLP. Router semantics are
+    softmax-over-all-then-top-k, renormalized iff norm_topk_prob (HF
+    Qwen3MoeSparseMoeBlock) — unlike Mixtral's mask-then-softmax."""
+    import dataclasses
+
+    if getattr(config, "mlp_only_layers", None) or getattr(
+        config, "decoder_sparse_step", 1
+    ) != 1:
+        raise NotImplementedError(
+            "qwen3-moe with dense interleaved layers (mlp_only_layers / "
+            "decoder_sparse_step != 1) is not supported yet"
+        )
+    base = qwen3_spec_from_hf(config)
+    return dataclasses.replace(
+        base,
+        family="qwen3_moe",
+        intermediate_size=config.moe_intermediate_size,
+        num_experts=config.num_experts,
+        num_experts_per_tok=config.num_experts_per_tok,
+        moe_pre_softmax=True,
+        moe_norm_topk=bool(getattr(config, "norm_topk_prob", False)),
+    )
+
+
+def _load_block_moe(reader, layer_idx: int, dtype=None) -> dict:
+    p = f"model.layers.{layer_idx}"
+    from bloombee_tpu.models.checkpoint import read_tensor as _t
+
+    params = {
+        "input_layernorm": _t(reader, f"{p}.input_layernorm.weight", dtype),
+        "post_attention_layernorm": _t(
+            reader, f"{p}.post_attention_layernorm.weight", dtype
+        ),
+    }
+    for proj in ("q", "k", "v", "o"):
+        params[f"{proj}_proj"] = _t(
+            reader, f"{p}.self_attn.{proj}_proj.weight", dtype
+        ).T
+    for name in ("q_norm", "k_norm"):
+        params[name] = _t(reader, f"{p}.self_attn.{name}.weight", dtype)
+    params["router"] = _t(reader, f"{p}.mlp.gate.weight", dtype).T  # [D, E]
+    from bloombee_tpu.models.checkpoint import stack_expert_weights
+
+    params.update(
+        stack_expert_weights(
+            reader, f"{p}.mlp.experts.{{}}", "gate_proj", "up_proj",
+            "down_proj", params["router"].shape[1], dtype,
+        )
+    )
+    return params
+
+
+register_family(
+    Family("qwen3_moe", qwen3_moe_spec_from_hf, loader=_load_block_moe)
+)

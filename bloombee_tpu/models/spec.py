@@ -30,6 +30,11 @@ class ModelSpec:
     # MoE (Mixtral-style); 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 0
+    # router semantics: Mixtral masks then softmaxes over the top-k;
+    # Qwen3-MoE softmaxes over ALL experts first, selects top-k, and
+    # optionally renormalizes (norm_topk_prob)
+    moe_pre_softmax: bool = False
+    moe_norm_topk: bool = False
     # Qwen3-style per-head q/k RMSNorm
     qk_norm: bool = False
     # Gemma-style sliding-window layers: pattern of layer types, e.g.

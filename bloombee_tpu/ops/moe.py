@@ -15,10 +15,25 @@ import jax.numpy as jnp
 
 
 def router_topk_weights(
-    logits: jax.Array, top_k: int  # [B, T, E]
+    logits: jax.Array,  # [B, T, E]
+    top_k: int,
+    pre_softmax: bool = False,
+    norm_topk: bool = False,
 ) -> jax.Array:
-    """Top-k router weights, softmaxed over the selected experts, zero
-    elsewhere (HF Mixtral semantics: softmax AFTER top-k selection)."""
+    """Top-k router weights, zero off the selected experts.
+
+    pre_softmax=False: HF Mixtral semantics — mask to the top-k logits,
+    then softmax over them. pre_softmax=True: HF Qwen3-MoE semantics —
+    softmax over ALL experts, select top-k, renormalize iff norm_topk."""
+    if pre_softmax:
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top_vals, _ = jax.lax.top_k(probs, top_k)
+        kept = jnp.where(probs >= top_vals[..., -1:], probs, 0.0)
+        if norm_topk:
+            kept = kept / jnp.maximum(
+                kept.sum(axis=-1, keepdims=True), 1e-20
+            )
+        return kept.astype(logits.dtype)
     top_vals, _ = jax.lax.top_k(logits, top_k)
     thresh = top_vals[..., -1:]
     neg = jnp.finfo(jnp.float32).min
@@ -34,6 +49,8 @@ def moe_mlp(
     down_w: jax.Array,  # [E, I, D]
     top_k: int,
     router_weights: jax.Array | None = None,  # precomputed [B, T, E]
+    pre_softmax: bool = False,
+    norm_topk: bool = False,
 ) -> jax.Array:
     """Dense-over-experts gated MLP weighted by top-k router probabilities.
 
@@ -43,7 +60,9 @@ def moe_mlp(
     """
     if router_weights is None:
         logits = x @ router_w
-        router_weights = router_topk_weights(logits, top_k)
+        router_weights = router_topk_weights(
+            logits, top_k, pre_softmax=pre_softmax, norm_topk=norm_topk
+        )
     g = jnp.einsum("btd,edi->btei", x, gate_w)
     u = jnp.einsum("btd,edi->btei", x, up_w)
     h = jax.nn.silu(g) * u

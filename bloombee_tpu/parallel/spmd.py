@@ -108,7 +108,8 @@ def spmd_block_forward(
         from bloombee_tpu.ops.moe import moe_mlp, router_topk_weights
 
         weights = router_topk_weights(
-            x @ params_l["router"], spec.num_experts_per_tok
+            x @ params_l["router"], spec.num_experts_per_tok,
+            pre_softmax=spec.moe_pre_softmax, norm_topk=spec.moe_norm_topk,
         )  # [b, c, E] full
         e_local = params_l["experts_gate"].shape[0]
         rank = lax.axis_index(tp_axis)

@@ -57,6 +57,8 @@ def _mlp(x, params, spec):
             params["experts_up"],
             params["experts_down"],
             spec.num_experts_per_tok,
+            pre_softmax=spec.moe_pre_softmax,
+            norm_topk=spec.moe_norm_topk,
         )
     if spec.mlp_type == "silu":
         return silu_mlp(
@@ -192,8 +194,14 @@ def layer_body(
     attn_out = _proj(attn.reshape(b, t, h_heads * hd), params, "o_proj")
 
     if spec.parallel_attn:
-        # falcon-7b style: one shared input norm feeds attention AND MLP
-        hidden = hidden + attn_out + _mlp(x, params, spec)
+        # falcon: parallel residual. 7b shares one input norm for attention
+        # AND the MLP; 40b/180b new-arch uses two (ln_attn already fed the
+        # projections above; ln_mlp feeds the MLP)
+        if spec.num_ln_in_parallel_attn == 2:
+            x_mlp = _norm(hidden, params, "mlp_layernorm", spec)
+        else:
+            x_mlp = x
+        hidden = hidden + attn_out + _mlp(x_mlp, params, spec)
         return hidden, k_slab, v_slab
 
     if spec.sandwich_norms:

@@ -61,6 +61,39 @@ def _tiny(family):
             final_logit_softcapping=30.0,
         )
         cls = tf.Gemma2ForCausalLM
+    elif family == "falcon40b":
+        # new_decoder_architecture: grouped GQA fused QKV + dual parallel
+        # LayerNorms (the layout this framework previously rejected loudly)
+        config = tf.FalconConfig(
+            hidden_size=64, num_attention_heads=4, num_kv_heads=2,
+            num_hidden_layers=2, vocab_size=128, bias=False,
+            new_decoder_architecture=True, alibi=False,
+            layer_norm_epsilon=1e-5,
+        )
+        cls = tf.FalconForCausalLM
+    elif family == "mistral":
+        config = tf.MistralConfig(
+            hidden_size=64, intermediate_size=128, num_attention_heads=4,
+            num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
+            rms_norm_eps=1e-5, sliding_window=6,  # < prompt: window active
+            tie_word_embeddings=False,
+        )
+        cls = tf.MistralForCausalLM
+    elif family == "qwen3_moe":
+        from transformers.models.qwen3_moe import (
+            Qwen3MoeConfig,
+            Qwen3MoeForCausalLM,
+        )
+
+        config = Qwen3MoeConfig(
+            hidden_size=64, intermediate_size=128,
+            moe_intermediate_size=96, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16, num_hidden_layers=2,
+            vocab_size=128, rms_norm_eps=1e-5, num_experts=4,
+            num_experts_per_tok=2, norm_topk_prob=True,
+            decoder_sparse_step=1, tie_word_embeddings=False,
+        )
+        cls = Qwen3MoeForCausalLM
     else:
         raise KeyError(family)
     torch.manual_seed(0)
@@ -69,7 +102,9 @@ def _tiny(family):
 
 
 @pytest.mark.parametrize(
-    "family", ["qwen3", "mixtral", "bloom", "falcon", "gemma2"]
+    "family",
+    ["qwen3", "mixtral", "bloom", "falcon", "gemma2", "falcon40b",
+     "mistral", "qwen3_moe"],
 )
 def test_family_full_chain_parity(family, tmp_path):
     hf, config = _tiny(family)
