@@ -103,6 +103,7 @@ def _register_builtins() -> None:
     import bloombee_tpu.models.gemma4  # noqa: F401
     import bloombee_tpu.models.mistral  # noqa: F401
     import bloombee_tpu.models.mixtral  # noqa: F401
+    import bloombee_tpu.models.qwen2  # noqa: F401
     import bloombee_tpu.models.qwen3  # noqa: F401
 
 

@@ -1,0 +1,57 @@
+"""Qwen2/Qwen2.5 family: Llama structure + biased q/k/v projections.
+
+The reference serves Qwen-family checkpoints through HF wrappers; here it is
+the llama weight layout plus the attention biases Qwen2 adds (layer_body's
+projection helper already applies `{q,k,v}_bias` when present).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from bloombee_tpu.models.auto import Family, register_family
+from bloombee_tpu.models.checkpoint import read_tensor as _t
+from bloombee_tpu.models.llama.block import (
+    HF_BLOCK_KEYS,
+    convert_hf_block_params,
+)
+from bloombee_tpu.models.spec import ModelSpec
+
+
+def qwen2_spec_from_hf(config: Any) -> ModelSpec:
+    if getattr(config, "use_sliding_window", False):
+        # released Qwen2/2.5 checkpoints ship use_sliding_window=false; the
+        # partial-depth SWA variant (max_window_layers) is not mapped yet
+        raise NotImplementedError(
+            "qwen2 with use_sliding_window=true is not supported yet"
+        )
+    return ModelSpec(
+        family="qwen2",
+        hidden_size=config.hidden_size,
+        intermediate_size=config.intermediate_size,
+        num_attention_heads=config.num_attention_heads,
+        num_key_value_heads=config.num_key_value_heads,
+        head_dim=getattr(config, "head_dim", None)
+        or config.hidden_size // config.num_attention_heads,
+        num_hidden_layers=config.num_hidden_layers,
+        vocab_size=config.vocab_size,
+        rms_norm_eps=config.rms_norm_eps,
+        rope_theta=getattr(config, "rope_theta", 1_000_000.0),
+        tie_word_embeddings=getattr(config, "tie_word_embeddings", False),
+    )
+
+
+def _load_block(reader, layer_idx: int, dtype=None) -> dict:
+    prefix = f"model.layers.{layer_idx}"
+    tensors = {k: reader.tensor(f"{prefix}.{k}") for k in HF_BLOCK_KEYS}
+    params = convert_hf_block_params(tensors, dtype=dtype)
+    for proj in ("q", "k", "v"):
+        name = f"{prefix}.self_attn.{proj}_proj.bias"
+        if reader.has(name):
+            params[f"{proj}_bias"] = _t(reader, name, dtype)
+    return params
+
+
+register_family(
+    Family("qwen2", qwen2_spec_from_hf, HF_BLOCK_KEYS, loader=_load_block)
+)

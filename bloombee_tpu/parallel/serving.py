@@ -39,6 +39,8 @@ SERVING_PARAM_SPECS = {
     "input_layernorm_bias": P(None, None),
     "post_attention_layernorm": P(None, None),
     "post_attention_layernorm_bias": P(None, None),
+    "mlp_layernorm": P(None, None),
+    "mlp_layernorm_bias": P(None, None),
     "pre_feedforward_layernorm": P(None, None),
     "post_feedforward_layernorm": P(None, None),
     "q_proj": P(None, None, "tp"),

@@ -69,6 +69,11 @@ def spmd_block_forward(
     tp_axis: str = "tp",
 ) -> jax.Array:
     b, c, d = hidden.shape
+    if spec.layer_types and "sliding" in spec.layer_types:
+        raise NotImplementedError(
+            "ring attention in the spmd path is full-causal; sliding-window "
+            "families (mistral/gemma) aren't supported here yet"
+        )
     tp = lax.axis_size(tp_axis)
     if spec.num_attention_heads % tp or spec.num_key_value_heads % tp:
         raise ValueError(

@@ -79,6 +79,13 @@ def _tiny(family):
             tie_word_embeddings=False,
         )
         cls = tf.MistralForCausalLM
+    elif family == "qwen2":
+        config = tf.Qwen2Config(
+            hidden_size=64, intermediate_size=128, num_attention_heads=4,
+            num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
+            rms_norm_eps=1e-5, tie_word_embeddings=False,
+        )
+        cls = tf.Qwen2ForCausalLM
     elif family == "qwen3_moe":
         from transformers.models.qwen3_moe import (
             Qwen3MoeConfig,
@@ -104,7 +111,7 @@ def _tiny(family):
 @pytest.mark.parametrize(
     "family",
     ["qwen3", "mixtral", "bloom", "falcon", "gemma2", "falcon40b",
-     "mistral", "qwen3_moe"],
+     "mistral", "qwen2", "qwen3_moe"],
 )
 def test_family_full_chain_parity(family, tmp_path):
     hf, config = _tiny(family)
