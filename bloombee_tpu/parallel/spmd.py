@@ -74,6 +74,20 @@ def spmd_block_forward(
             "ring attention in the spmd path is full-causal; sliding-window "
             "families (mistral/gemma) aren't supported here yet"
         )
+    if (
+        spec.norm_type != "rms"
+        or spec.alibi
+        or spec.parallel_attn
+        or spec.sandwich_norms
+        or spec.mlp_type != "silu"
+    ):
+        # this body implements the llama/qwen3/mixtral shape only; biased
+        # or structurally different families must fail loudly, not run with
+        # silently dropped terms
+        raise NotImplementedError(
+            f"spmd block body doesn't cover family {spec.family!r} "
+            "(ln/alibi/parallel-attn/sandwich/gelu variants)"
+        )
     tp = lax.axis_size(tp_axis)
     if spec.num_attention_heads % tp or spec.num_key_value_heads % tp:
         raise ValueError(

@@ -1,0 +1,129 @@
+"""CLI surface end-to-end: run_registry + run_server as REAL subprocesses
+(the documented deployment flow), then a client generate and the health
+probe against them. The reference's equivalent is the manual live-swarm
+tier (SURVEY.md §4: run_dht + run_server processes + pytest)."""
+
+import asyncio
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+import torch
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+_BOOT = (
+    "import jax; jax.config.update('jax_platforms', 'cpu'); "
+    "from bloombee_tpu.cli.{mod} import main; main({args!r})"
+)
+
+
+def _spawn(mod: str, args: list[str]) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-c", _BOOT.format(mod=mod, args=args)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def test_cli_registry_server_client_health(tmp_path):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
+        max_position_embeddings=128, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "model")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    reg_port = _free_port()
+    procs = [
+        _spawn("run_registry", ["--host", "127.0.0.1", "--port",
+                                str(reg_port)]),
+    ]
+    try:
+        time.sleep(1.0)
+        for blocks in ("0:1", "1:2"):
+            procs.append(
+                _spawn(
+                    "run_server",
+                    [d, "--model-uid", "tiny", "--registry",
+                     f"127.0.0.1:{reg_port}", "--blocks", blocks,
+                     "--host", "127.0.0.1", "--public-host", "127.0.0.1",
+                     "--num-pages", "32", "--page-size", "4",
+                     "--dtype", "float32", "--warmup-batches", ""],
+                )
+            )
+
+        # wait until the swarm covers both blocks
+        from bloombee_tpu.swarm.registry import RegistryClient
+
+        async def wait_complete():
+            client = RegistryClient("127.0.0.1", reg_port)
+            for _ in range(120):
+                for p in procs:
+                    assert p.poll() is None, p.communicate()[0][-2000:]
+                try:
+                    infos = await client.get_module_infos("tiny", range(2))
+                    if all(mi.servers for mi in infos):
+                        await client.close()
+                        return
+                except Exception:
+                    pass
+                await asyncio.sleep(0.5)
+            raise TimeoutError("swarm never became complete")
+
+        asyncio.run(wait_complete())
+
+        # health probe sees a complete swarm
+        health = subprocess.run(
+            [sys.executable, "-c",
+             _BOOT.format(
+                 mod="health",
+                 args=["tiny", "--num-blocks", "2", "--registry",
+                       f"127.0.0.1:{reg_port}"],
+             )],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert "COMPLETE" in health.stdout, health.stdout + health.stderr
+
+        # client generate through the CLI-launched swarm == HF greedy
+        async def client_generate():
+            from bloombee_tpu.client.model import DistributedModelForCausalLM
+
+            model = DistributedModelForCausalLM.from_pretrained(
+                d, RegistryClient("127.0.0.1", reg_port), model_uid="tiny"
+            )
+            ids_in = np.arange(6)[None, :] % config.vocab_size
+            return await model.generate(ids_in, max_new_tokens=5)
+
+        ids = asyncio.run(client_generate())
+        with torch.no_grad():
+            prompt = torch.tensor(np.arange(6)[None, :] % config.vocab_size)
+            ref = hf.generate(
+                prompt, attention_mask=torch.ones_like(prompt),
+                max_new_tokens=5, do_sample=False,
+            ).numpy()
+        # HF may stop early at its eos token; the generated prefix must match
+        assert ref.shape[1] > prompt.shape[1]
+        np.testing.assert_array_equal(ids[:, : ref.shape[1]], ref)
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
