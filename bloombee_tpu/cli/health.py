@@ -15,12 +15,23 @@ import asyncio
 
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("model_uid")
-    parser.add_argument("--num-blocks", type=int, required=True)
+    parser.add_argument("model_uid", nargs="?", default=None)
+    parser.add_argument("--num-blocks", type=int)
     parser.add_argument("--registry", default="127.0.0.1:7700")
     parser.add_argument("--probe", action="store_true",
                         help="also call rpc_info on every server")
+    parser.add_argument("--switches", action="store_true",
+                        help="print the BBTPU_* env switch table and exit "
+                        "(reference README.environment-switches.md)")
     args = parser.parse_args(argv)
+    if args.switches:
+        from bloombee_tpu.utils import env
+
+        env.import_declaring_modules()
+        print(env.describe())
+        return
+    if args.model_uid is None or args.num_blocks is None:
+        parser.error("model_uid and --num-blocks are required")
 
     async def run():
         from bloombee_tpu.swarm.registry import RegistryClient

@@ -88,6 +88,11 @@ def spmd_block_forward(
             f"spmd block body doesn't cover family {spec.family!r} "
             "(ln/alibi/parallel-attn/sandwich/gelu variants)"
         )
+    if any(k.endswith("_bias") for k in params_l):
+        raise NotImplementedError(
+            "spmd block body is bias-free; biased families (qwen2/bloom) "
+            "aren't supported here yet"
+        )
     tp = lax.axis_size(tp_axis)
     if spec.num_attention_heads % tp or spec.num_key_value_heads % tp:
         raise ValueError(

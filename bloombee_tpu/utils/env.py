@@ -61,6 +61,18 @@ def get(name: str):
     return _parse(flag, raw)
 
 
+def import_declaring_modules() -> None:
+    """Import every module that declares switches so describe() is complete
+    (kept here, next to the registry, so new declare() sites only need to
+    be added in one place)."""
+    import bloombee_tpu.client.session  # noqa: F401
+    import bloombee_tpu.kv.cache_manager  # noqa: F401
+    import bloombee_tpu.models.hub  # noqa: F401
+    import bloombee_tpu.runtime.executor  # noqa: F401
+    import bloombee_tpu.server.block_server  # noqa: F401
+    import bloombee_tpu.wire.tensor_codec  # noqa: F401
+
+
 def describe() -> str:
     """Authoritative flag table (reference README.environment-switches.md)."""
     lines = ["| switch | type | default | description |", "|---|---|---|---|"]

@@ -26,13 +26,29 @@ _BOOT = (
 )
 
 
-def _spawn(mod: str, args: list[str]) -> subprocess.Popen:
-    return subprocess.Popen(
+def _spawn(mod: str, args: list[str], log_path) -> subprocess.Popen:
+    # log to a FILE, not a pipe: an undrained pipe blocks a chatty child
+    # after ~64KB and stalls the swarm
+    log = open(log_path, "w")
+    proc = subprocess.Popen(
         [sys.executable, "-c", _BOOT.format(mod=mod, args=args)],
-        stdout=subprocess.PIPE,
+        stdout=log,
         stderr=subprocess.STDOUT,
         text=True,
     )
+    proc._log_path = log_path
+    return proc
+
+
+def _wait_port(port: int, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"port {port} never came up")
 
 
 def test_cli_registry_server_client_health(tmp_path):
@@ -50,11 +66,12 @@ def test_cli_registry_server_client_health(tmp_path):
 
     reg_port = _free_port()
     procs = [
-        _spawn("run_registry", ["--host", "127.0.0.1", "--port",
-                                str(reg_port)]),
+        _spawn("run_registry",
+               ["--host", "127.0.0.1", "--port", str(reg_port)],
+               tmp_path / "registry.log"),
     ]
     try:
-        time.sleep(1.0)
+        _wait_port(reg_port)  # registry must accept before servers announce
         for blocks in ("0:1", "1:2"):
             procs.append(
                 _spawn(
@@ -64,7 +81,15 @@ def test_cli_registry_server_client_health(tmp_path):
                      "--host", "127.0.0.1", "--public-host", "127.0.0.1",
                      "--num-pages", "32", "--page-size", "4",
                      "--dtype", "float32", "--warmup-batches", ""],
+                    tmp_path / f"server{blocks.replace(':', '-')}.log",
                 )
+            )
+
+        def _logs() -> str:
+            return "\n".join(
+                f"--- {p._log_path} ---\n"
+                + open(p._log_path).read()[-2000:]
+                for p in procs
             )
 
         # wait until the swarm covers both blocks
@@ -72,18 +97,24 @@ def test_cli_registry_server_client_health(tmp_path):
 
         async def wait_complete():
             client = RegistryClient("127.0.0.1", reg_port)
-            for _ in range(120):
-                for p in procs:
-                    assert p.poll() is None, p.communicate()[0][-2000:]
-                try:
-                    infos = await client.get_module_infos("tiny", range(2))
-                    if all(mi.servers for mi in infos):
-                        await client.close()
-                        return
-                except Exception:
-                    pass
-                await asyncio.sleep(0.5)
-            raise TimeoutError("swarm never became complete")
+            try:
+                for _ in range(120):
+                    for p in procs:
+                        assert p.poll() is None, _logs()
+                    try:
+                        infos = await client.get_module_infos(
+                            "tiny", range(2)
+                        )
+                        if all(mi.servers for mi in infos):
+                            return
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.5)
+                raise TimeoutError(
+                    "swarm never became complete\n" + _logs()
+                )
+            finally:
+                await client.close()
 
         asyncio.run(wait_complete())
 
@@ -103,11 +134,15 @@ def test_cli_registry_server_client_health(tmp_path):
         async def client_generate():
             from bloombee_tpu.client.model import DistributedModelForCausalLM
 
-            model = DistributedModelForCausalLM.from_pretrained(
-                d, RegistryClient("127.0.0.1", reg_port), model_uid="tiny"
-            )
-            ids_in = np.arange(6)[None, :] % config.vocab_size
-            return await model.generate(ids_in, max_new_tokens=5)
+            reg_client = RegistryClient("127.0.0.1", reg_port)
+            try:
+                model = DistributedModelForCausalLM.from_pretrained(
+                    d, reg_client, model_uid="tiny"
+                )
+                ids_in = np.arange(6)[None, :] % config.vocab_size
+                return await model.generate(ids_in, max_new_tokens=5)
+            finally:
+                await reg_client.close()
 
         ids = asyncio.run(client_generate())
         with torch.no_grad():
