@@ -247,3 +247,50 @@ def test_failover_rereoute_and_replay(tiny_model_dir):
         await reg.stop()
 
     asyncio.run(run())
+
+
+def test_feature_combo_int4_microbatch_push(tiny_model_dir):
+    """Cross-feature interaction: int4 KV arena + within-stage micro-batching
+    + push-mode pipelining in one 2-server chain — generation stays coherent
+    and deterministic."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s1 = _server(model_dir, rc(), 0, 2, kv_quant="int4")
+        s2 = _server(model_dir, rc(), 2, 3, kv_quant="int4")
+        await s1.start()
+        await s2.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", use_push=True
+        )
+        rng = np.random.default_rng(4)
+        input_ids = rng.integers(0, config.vocab_size, size=(4, 6))
+        sess = model.inference_session(24, 4, microbatch=2)
+        await sess.__aenter__()
+        a = await model.generate(input_ids, max_new_tokens=6, session=sess)
+        await sess.__aexit__(None, None, None)
+        sess2 = model.inference_session(24, 4, microbatch=2)
+        await sess2.__aenter__()
+        b = await model.generate(input_ids, max_new_tokens=6, session=sess2)
+        await sess2.__aexit__(None, None, None)
+        np.testing.assert_array_equal(a, b)  # deterministic under the combo
+        assert a.shape == (4, 12)
+        # int4 KV drifts logits slightly; GENERATED tokens (prompt columns
+        # excluded — they match by construction) still broadly agree with
+        # the fp32 HF chain on a short horizon
+        ref = _hf_greedy(hf_model, input_ids, 6)
+        s = input_ids.shape[1]
+        assert (a[:, s:] == ref[:, s:]).mean() > 0.5
+
+        await s1.stop()
+        await s2.stop()
+        await reg.stop()
+
+    asyncio.run(run())
