@@ -73,7 +73,10 @@ def ring_attention(
             # skip blocks entirely in this rank's causal future (half of all
             # (rank, src) pairs): the ppermute still runs every step —
             # collectives must stay uniform across the ring — but the
-            # logits/softmax FLOPs are branched away
+            # logits/softmax FLOPs are branched away. (Callers wrap with
+            # check_vma=False: the identity skip branch is replicated-typed
+            # while attend's outputs vary over the ring axis, which strict
+            # vma checking would reject despite being correct here.)
             m, l, acc = lax.cond(
                 src <= rank, attend, lambda m, l, acc: (m, l, acc), m, l, acc
             )

@@ -1,0 +1,65 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second canonical long-context scheme next to ring attention
+(parallel/ring_attention.py): instead of rotating KV blocks around the ring,
+ONE all-to-all over the "sp" axis re-shards [B, C_local, H, hd] into
+[B, C_full, H_local, hd] — every device then runs plain dense attention on
+the FULL sequence for its head slice, and a final all-to-all restores the
+sequence sharding. Four all-to-alls per attention (q, k, v, out — constant
+in mesh size, vs the ring's 2*sp ppermutes), at the cost of requiring
+heads % sp == 0; communication rides ICI either way. This fills the
+reference's explicit long-context gap (SURVEY.md §5: no ring/Ulysses/
+context parallelism at all).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from bloombee_tpu.ops.attention import causal_mask, masked_attention, repeat_kv
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, C, H, hd] local sequence chunk, all heads
+    k: jax.Array,  # [B, C, Hkv, hd]
+    v: jax.Array,  # [B, C, Hkv, hd]
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Must be called inside shard_map with `axis_name` mapped; returns the
+    local output chunk [B, C, H, hd]."""
+    n = lax.axis_size(axis_name)
+    b, c, h, hd = q.shape
+    hkv = k.shape[2]
+    if h % n:
+        raise ValueError(f"heads={h} must divide over sp={n}")
+    if hkv % n:
+        # replicate KV heads up to the mesh size so each device owns at
+        # least one; attention math is unchanged (repeat_kv semantics)
+        if n % hkv:
+            raise ValueError(
+                f"kv heads={hkv} must divide or be divisible by sp={n}"
+            )
+        rep = n // hkv
+        k = repeat_kv(k, rep)
+        v = repeat_kv(v, rep)
+
+    # head-shard + sequence-gather: [B, C, H, hd] -> [B, C*n, H/n, hd]
+    qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    s = qg.shape[1]
+    mask = (
+        causal_mask(s)[None]
+        if causal
+        else jax.numpy.ones((1, s, s), bool)
+    )
+    out = masked_attention(qg, kg, vg, mask, scale=scale)  # GQA inside
+
+    # restore sequence sharding: [B, C*n, H/n, hd] -> [B, C, H, hd]
+    return lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
