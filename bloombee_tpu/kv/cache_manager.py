@@ -92,6 +92,7 @@ class CacheManager:
         quant: str | None = None,  # None -> BBTPU_KV_QUANT env default
         hetero_spec=None,  # ModelSpec with per-layer geometry (gemma-4)
         start_block: int = 0,
+        oversubscribe: float = 1.0,  # admit up to this x capacity (parking)
     ):
         dtype = dtype or jnp.bfloat16
         if quant is None:
@@ -122,11 +123,22 @@ class CacheManager:
         self._seq_counter = itertools.count()
         self._handle_counter = itertools.count()
         self._parked: dict[int, tuple[np.ndarray, np.ndarray, int, int]] = {}
+        # over-subscription (the FlexGen serve-more-than-HBM-fits story):
+        # admission may reserve up to oversubscribe x capacity; physical
+        # page pressure is relieved by the reclaimer callback (the server
+        # parks idle sessions' KV to host) invoked from write/unpark paths
+        self.oversubscribe = max(float(oversubscribe), 1.0)
+        self.reclaimer = None  # callable(need_pages, exclude_seq_ids) -> int
 
     # reference: ServerInfo.cache_tokens_left (handler.py:3256-3273 rpc_info)
     @property
     def tokens_left(self) -> int:
-        return self.capacity_tokens - self._reserved_tokens
+        """Admittable tokens (scaled by oversubscribe — that IS the
+        admission limit, so routing must see it, not raw capacity)."""
+        return (
+            int(self.capacity_tokens * self.oversubscribe)
+            - self._reserved_tokens
+        )
 
     def _condition(self) -> asyncio.Condition:
         if self._cond is None:
@@ -148,15 +160,16 @@ class CacheManager:
         # ceil(max_length / page_size) whole pages
         per_seq = -(-max_length // self.page_size) * self.page_size
         need = batch_size * per_seq
-        if need > self.capacity_tokens:
+        admit_limit = int(self.capacity_tokens * self.oversubscribe)
+        if need > admit_limit:
             raise AllocationTimeout(
                 f"request for {need} tokens exceeds capacity "
-                f"{self.capacity_tokens}"
+                f"{admit_limit}"
             )
         cond = self._condition()
         deadline = None if timeout is None else time.monotonic() + timeout
         async with cond:
-            while self._reserved_tokens + need > self.capacity_tokens:
+            while self._reserved_tokens + need > admit_limit:
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
@@ -209,6 +222,9 @@ class CacheManager:
                 -(-(st.l_seq + num_tokens) // self.page_size)
                 - len(st.pages),
             )
+        if need > table.free_pages and self.reclaimer is not None:
+            # over-subscribed: evict idle sessions' KV to host and retry
+            self.reclaimer(need - table.free_pages, set(handle.seq_ids))
         if need > table.free_pages:
             from bloombee_tpu.kv.paged import OutOfPages
 
@@ -249,6 +265,9 @@ class CacheManager:
         `accepted_indices[i]` lists row i's surviving tree-relative indices
         in path order (depth 0, 1, ...).
         """
+        # an over-subscribed server may have parked this session between
+        # rounds; the accept operates on live table state
+        self.ensure_resident(handle)
         src_all, dst_all = [], []
         for sid, idx in zip(handle.seq_ids, accepted_indices):
             st = self.table.seq(sid)
@@ -276,6 +295,21 @@ class CacheManager:
             self.arena["k"], self.arena["v"],
             jnp.asarray(src_p), jnp.asarray(dst_p),
         )
+
+    def ensure_resident(self, handle: CacheHandle) -> None:
+        """Unpark any parked sequences of this handle before a step (the
+        demand-paging half of over-subscription), reclaiming pages from
+        idle sessions when tight. Raises OutOfPages when nothing can be
+        evicted — the client's retry path handles it."""
+        parked = [sid for sid in handle.seq_ids if sid in self._parked]
+        for sid in parked:
+            l_seq = self._parked[sid][3]
+            need = -(-l_seq // self.page_size)
+            if need > self.table.free_pages and self.reclaimer is not None:
+                self.reclaimer(
+                    need - self.table.free_pages, set(handle.seq_ids)
+                )
+            self.unpark_sequence(sid)
 
     # ------------------------------------------------------- host tiering
     def park_sequence(self, seq_id: int, tier: str = "host") -> None:

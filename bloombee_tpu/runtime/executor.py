@@ -148,6 +148,9 @@ class SpanExecutor:
         b, t, d = hidden.shape
         assert d == spec.hidden_size
 
+        # over-subscribed servers may have parked this session's KV to
+        # host while it was idle; bring it back before writing
+        self.manager.ensure_resident(handle)
         starts = self.manager.context_lens(handle)  # [B] before write
         slots = self.manager.write_slots(handle, t, commit=commit)  # [B*T]
         total_lens = self.manager.context_lens(handle)  # [B] after write

@@ -73,6 +73,7 @@ class _Session:
         self.layers = layers  # relative (l0, l1) within this server's span
         self.push_inbox: asyncio.Queue = asyncio.Queue()
         self.step_tasks: set[asyncio.Task] = set()  # in-flight mb chunks
+        self.last_step_at = 0.0  # idle measure for the parking reclaimer
         # per-session timing accumulators (server half of the reference's
         # [TIMING_TABLE] decomposition, handler.py:1276-1605)
         self.n_steps = 0
@@ -135,6 +136,8 @@ class BlockServer:
         adapter_dirs: list[str] | None = None,
         tp: int = 1,
         kv_quant: str | None = None,  # "int4" -> quantized KV arena
+        oversubscribe: float = 1.0,  # admit > capacity; park idle sessions
+        idle_park_s: float = 5.0,  # a session this idle may be parked
     ):
         self.model_dir = model_dir
         if params is None:
@@ -168,7 +171,14 @@ class BlockServer:
             quant=kv_quant,
             hetero_spec=spec if spec.heterogeneous else None,
             start_block=start,
+            oversubscribe=oversubscribe,
         )
+        self.idle_park_s = idle_park_s
+        if oversubscribe > 1.0:
+            # serve more sessions than HBM fits: page pressure evicts idle
+            # sessions' KV to host (the FlexGen offload story at the
+            # session granularity); their next step unparks on demand
+            self.manager.reclaimer = self._reclaim_idle
         mesh = None
         if tp > 1:
             # intra-server tensor parallelism over the local chips (ICI):
@@ -370,6 +380,7 @@ class BlockServer:
 
             session = _Session(session_id, handle, batch, layers)
             session.opened_at = _time.monotonic()
+            session.last_step_at = session.opened_at
             self._sessions[session_id] = session
             self._drain_pending_pushes(session)
             try:
@@ -641,6 +652,7 @@ class BlockServer:
         handler.py:1276-1605)."""
         import time
 
+        session.last_step_at = time.monotonic()
         t0 = time.perf_counter()
         if hidden.shape[1] > 1 and tree_mask is None:
             out = self.executor.prefill(
@@ -659,6 +671,40 @@ class BlockServer:
                 session.id, hidden.shape[1], dt_ms,
             )
         return out, dt_ms
+
+    def _reclaim_idle(self, need_pages: int, exclude_seq_ids: set) -> int:
+        """Park idle sessions' KV (LRU by last step) until `need_pages` are
+        freed. Runs on the compute thread — the only thread that mutates
+        the paged table — so no step can race the eviction."""
+        import time as _time
+
+        now = _time.monotonic()
+        victims = sorted(
+            (
+                s for s in list(self._sessions.values())
+                if now - s.last_step_at >= self.idle_park_s
+                and not (set(s.handle.seq_ids) & exclude_seq_ids)
+            ),
+            key=lambda s: s.last_step_at,
+        )
+        freed = 0
+        for sess in victims:
+            if freed >= need_pages:
+                break
+            for sid in sess.handle.seq_ids:
+                if (
+                    self.manager.table.has_seq(sid)
+                    and sid not in self.manager._parked
+                    and self.manager.table.seq(sid).l_seq > 0
+                ):
+                    before = self.manager.table.free_pages
+                    self.manager.park_sequence(sid)
+                    freed += self.manager.table.free_pages - before
+            logger.info(
+                "parked idle session %s (freed %d pages so far)",
+                sess.id, freed,
+            )
+        return freed
 
     def _dump_activations(
         self, dump_dir: str, session: _Session, meta: dict, hidden, out

@@ -148,3 +148,88 @@ def test_park_to_disk_roundtrip(tmp_path, monkeypatch):
     import asyncio
 
     asyncio.run(run())
+
+
+def test_oversubscribed_sessions_park_and_resume(tmp_path):
+    """Over-subscription (FlexGen serve-more-than-HBM-fits): two sessions
+    whose reservations exceed physical pages are both admitted; page
+    pressure parks the idle one's KV to host, and its next step unparks on
+    demand — both generations stay token-exact vs HF."""
+    import asyncio
+
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
+        max_position_embeddings=64, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "model")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        # 5 pages x 4 tokens = 20 physical tokens; each session reserves 20
+        # -> both admitted only via oversubscribe, and their live KV
+        # (3 + 4 pages) cannot be co-resident; idle_park_s=0 parks eagerly
+        s = BlockServer(
+            model_uid="m", start=0, end=2, model_dir=d,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=5, page_size=4,
+            oversubscribe=2.0, idle_park_s=0.0,
+        )
+        await s.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, RegistryClient("127.0.0.1", reg.port), model_uid="m"
+        )
+        ids_a = np.arange(6)[None, :] % config.vocab_size
+        ids_b = (np.arange(6)[None, :] * 3 + 1) % config.vocab_size
+
+        sess_a = model.inference_session(20, 1)
+        sess_b = model.inference_session(20, 1)
+        await sess_a.__aenter__()
+        await sess_b.__aenter__()  # admitted only thanks to oversubscribe
+        out_a = await model.generate(ids_a, max_new_tokens=4, session=sess_a)
+        # B's steps pressure the pages -> A gets parked
+        out_b = await model.generate(ids_b, max_new_tokens=8, session=sess_b)
+        srv_sess_a = s._sessions[sess_a._spans[0].session_id]
+        assert any(
+            sid in s.manager._parked for sid in srv_sess_a.handle.seq_ids
+        ), "idle session A was never parked"
+        # A resumes: unparks on demand and continues exactly
+        more_a = await model.generate(
+            out_a[:, -1:], max_new_tokens=4, session=sess_a
+        )
+        await sess_a.__aexit__(None, None, None)
+        await sess_b.__aexit__(None, None, None)
+
+        full_a = np.concatenate([out_a, more_a[:, 1:]], axis=1)
+        with torch.no_grad():
+            pa = torch.tensor(ids_a)
+            ref_a = hf.generate(pa, attention_mask=torch.ones_like(pa),
+                                max_new_tokens=8, do_sample=False).numpy()
+            pb = torch.tensor(ids_b)
+            ref_b = hf.generate(pb, attention_mask=torch.ones_like(pb),
+                                max_new_tokens=8, do_sample=False).numpy()
+        # HF may stop early at its eos token; the common prefix must match
+        n_a = min(full_a.shape[1], ref_a.shape[1])
+        np.testing.assert_array_equal(full_a[:, :n_a], ref_a[:, :n_a])
+        assert n_a > ids_a.shape[1] + 2
+        n_b = min(out_b.shape[1], ref_b.shape[1])
+        np.testing.assert_array_equal(out_b[:, :n_b], ref_b[:, :n_b])
+        assert n_b > ids_b.shape[1] + 2
+
+        await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
