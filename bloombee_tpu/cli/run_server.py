@@ -40,6 +40,11 @@ def main(argv=None):
     parser.add_argument("--kv-quant", default=None,
                         choices=["none", "int4"],
                         help="KV cache quantization (int4 = ~3.2x capacity)")
+    parser.add_argument("--oversubscribe", type=float, default=1.0,
+                        help="admit up to this x KV capacity; idle "
+                        "sessions' KV parks to host under pressure")
+    parser.add_argument("--idle-park-s", type=float, default=5.0,
+                        help="a session idle this long may be parked")
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel degree over local chips "
                         "(reference --tensor_parallel_devices)")
@@ -95,6 +100,8 @@ def main(argv=None):
             adapter_dirs=args.adapter_dirs,
             tp=args.tp,
             kv_quant=args.kv_quant,
+            oversubscribe=args.oversubscribe,
+            idle_park_s=args.idle_park_s,
         )
         await server.start()
         if args.warmup_batches:

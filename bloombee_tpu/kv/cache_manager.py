@@ -24,6 +24,7 @@ import contextlib
 import dataclasses
 import functools
 import itertools
+import threading
 import time
 from typing import Iterator
 
@@ -54,6 +55,18 @@ env.declare(
 
 class AllocationTimeout(RuntimeError):
     pass
+
+
+def _locked(fn):
+    """Serialize table/arena mutations across the compute thread and the
+    event loop (see CacheManager._lock)."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -129,16 +142,24 @@ class CacheManager:
         # parks idle sessions' KV to host) invoked from write/unpark paths
         self.oversubscribe = max(float(oversubscribe), 1.0)
         self.reclaimer = None  # callable(need_pages, exclude_seq_ids) -> int
+        # table mutations happen on BOTH the compute thread (steps,
+        # reclaim-parking) and the event loop (session teardown): a
+        # reentrant lock keeps them atomic (reentrant because the reclaimer
+        # runs inside write_slots/ensure_resident which already hold it)
+        self._lock = threading.RLock()
+
+    @property
+    def admit_limit(self) -> int:
+        """Max reservable tokens (the load-bearing over-subscription
+        invariant, derived in exactly one place)."""
+        return int(self.capacity_tokens * self.oversubscribe)
 
     # reference: ServerInfo.cache_tokens_left (handler.py:3256-3273 rpc_info)
     @property
     def tokens_left(self) -> int:
         """Admittable tokens (scaled by oversubscribe — that IS the
         admission limit, so routing must see it, not raw capacity)."""
-        return (
-            int(self.capacity_tokens * self.oversubscribe)
-            - self._reserved_tokens
-        )
+        return self.admit_limit - self._reserved_tokens
 
     def _condition(self) -> asyncio.Condition:
         if self._cond is None:
@@ -160,7 +181,7 @@ class CacheManager:
         # ceil(max_length / page_size) whole pages
         per_seq = -(-max_length // self.page_size) * self.page_size
         need = batch_size * per_seq
-        admit_limit = int(self.capacity_tokens * self.oversubscribe)
+        admit_limit = self.admit_limit
         if need > admit_limit:
             raise AllocationTimeout(
                 f"request for {need} tokens exceeds capacity "
@@ -194,15 +215,17 @@ class CacheManager:
         try:
             yield handle
         finally:
-            for sid in handle.seq_ids:
-                if self.table.has_seq(sid):
-                    self.table.drop_seq(sid)
-                self._parked.pop(sid, None)
+            with self._lock:
+                for sid in handle.seq_ids:
+                    if self.table.has_seq(sid):
+                        self.table.drop_seq(sid)
+                    self._parked.pop(sid, None)
             async with cond:
                 self._reserved_tokens -= need
                 cond.notify_all()
 
     # ----------------------------------------------------------- device plans
+    @_locked
     def write_slots(
         self, handle: CacheHandle, num_tokens: int, commit: bool = True
     ) -> np.ndarray:
@@ -247,14 +270,17 @@ class CacheManager:
     ) -> np.ndarray:
         return self.table.context_lens(handle.seq_ids, committed_only)
 
+    @_locked
     def commit(self, handle: CacheHandle, lengths: list[int] | None = None):
         for i, sid in enumerate(handle.seq_ids):
             self.table.commit(sid, None if lengths is None else lengths[i])
 
+    @_locked
     def rollback(self, handle: CacheHandle):
         for sid in handle.seq_ids:
             self.table.rollback(sid)
 
+    @_locked
     def accept_speculative(
         self, handle: CacheHandle, accepted_indices: list
     ) -> None:
@@ -296,6 +322,7 @@ class CacheManager:
             jnp.asarray(src_p), jnp.asarray(dst_p),
         )
 
+    @_locked
     def ensure_resident(self, handle: CacheHandle) -> None:
         """Unpark any parked sequences of this handle before a step (the
         demand-paging half of over-subscription), reclaiming pages from
@@ -312,6 +339,7 @@ class CacheManager:
             self.unpark_sequence(sid)
 
     # ------------------------------------------------------- host tiering
+    @_locked
     def park_sequence(self, seq_id: int, tier: str = "host") -> None:
         """Move one sequence's KV off the device and free its pages.
 
@@ -388,6 +416,7 @@ class CacheManager:
         os.unlink(path)  # POSIX: mapping keeps the data until released
         return mm
 
+    @_locked
     def unpark_sequence(self, seq_id: int) -> None:
         k_host, v_host, l_acc, l_seq = self._parked[seq_id]
         state = self.table.seq(seq_id)

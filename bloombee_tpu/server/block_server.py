@@ -534,6 +534,16 @@ class BlockServer:
         # (the round trip dominates per-step latency on tunnel/DCN hosts —
         # the reference overlaps the same way with per-handler processes and
         # CUDA streams, task_pool.py:127-192).
+        # ragged replay: the step writes a padded rectangle speculatively
+        # and each row commits to its true length (freeing the padding's
+        # pages) INSIDE the same compute-thread slot as the dispatch, so an
+        # over-subscribed reclaimer can never park the session in between.
+        # `handle` may be a row slice — align lengths to its rows.
+        commit_lens = meta.get("commit_lens")
+        if commit_lens is not None:
+            commit_lens = [int(x) for x in commit_lens]
+            if rows is not None:
+                commit_lens = commit_lens[rows[0]:rows[1]]
         out_dev, t_dispatch_ms = await self.compute.submit(
             PRIORITY_INFERENCE,
             self._compute_step,
@@ -543,18 +553,8 @@ class BlockServer:
             commit,
             tree_mask,
             depths,
+            commit_lens,
         )
-        commit_lens = meta.get("commit_lens")
-        if commit_lens is not None:
-            # ragged replay: the step wrote a padded rectangle speculatively;
-            # commit each row to its true length (frees the padding's pages).
-            # Safe right after dispatch: slots were assigned in-queue, and
-            # freed pages can only be overwritten by later-dispatched steps.
-            # `handle` may be a row slice — align lengths to its rows.
-            lens = [int(x) for x in commit_lens]
-            if rows is not None:
-                lens = lens[rows[0]:rows[1]]
-            self.manager.commit(handle, lengths=lens)
         import time as _time
 
         t0 = _time.perf_counter()
@@ -643,7 +643,7 @@ class BlockServer:
 
     def _compute_step(
         self, session: _Session, handle, hidden, commit, tree_mask,
-        depths=None,
+        depths=None, commit_lens=None,
     ):
         """Runs on the compute thread: plan packing + async device dispatch
         only (the d2h fetch happens off-queue in _run_step). The dispatch
@@ -664,6 +664,8 @@ class BlockServer:
                 handle, hidden, commit=commit, tree_mask=tree_mask,
                 layers=session.layers, depths=depths, fetch=False,
             )
+        if commit_lens is not None:
+            self.manager.commit(handle, lengths=commit_lens)
         dt_ms = (time.perf_counter() - t0) * 1000.0
         if env.log_channel_enabled("timing"):
             logger.info(
@@ -692,14 +694,17 @@ class BlockServer:
             if freed >= need_pages:
                 break
             for sid in sess.handle.seq_ids:
-                if (
-                    self.manager.table.has_seq(sid)
-                    and sid not in self.manager._parked
-                    and self.manager.table.seq(sid).l_seq > 0
-                ):
-                    before = self.manager.table.free_pages
-                    self.manager.park_sequence(sid)
-                    freed += self.manager.table.free_pages - before
+                try:
+                    if (
+                        self.manager.table.has_seq(sid)
+                        and sid not in self.manager._parked
+                        and self.manager.table.seq(sid).l_seq > 0
+                    ):
+                        before = self.manager.table.free_pages
+                        self.manager.park_sequence(sid)
+                        freed += self.manager.table.free_pages - before
+                except KeyError:
+                    continue  # session tore down between snapshot and park
             logger.info(
                 "parked idle session %s (freed %d pages so far)",
                 sess.id, freed,

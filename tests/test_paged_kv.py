@@ -101,3 +101,85 @@ def test_prefix_slots_clamped():
     t.assign_write_slots(0, 3, commit=False)
     assert t.prefix_slots(0).tolist() == s_committed.tolist()
     assert len(t.prefix_slots(0, committed_only=False)) == 8
+
+
+def test_paged_table_fuzz_against_model():
+    """Randomized op sequences (write/commit/rollback/accept/drop) against a
+    simple list-based model: page accounting, lengths, and prefix slot
+    CONTENT mapping must always agree, and no page may be double-owned."""
+    import numpy as np
+
+    from bloombee_tpu.kv.paged import OutOfPages, PagedKVTable
+
+    rng = np.random.default_rng(0)
+    for trial in range(20):
+        num_pages = int(rng.integers(4, 12))
+        page_size = int(rng.integers(2, 6))
+        table = PagedKVTable(num_pages, page_size)
+        # model: sid -> (committed tokens list, speculative tokens list),
+        # tokens are (value) with slot tracked via table's own mapping
+        model: dict[int, tuple[list, list]] = {}
+        slot_of: dict[tuple, int] = {}  # (sid, position) -> slot
+        next_sid = 0
+        for _ in range(200):
+            op = rng.choice(
+                ["add", "write", "commit", "rollback", "accept", "drop"]
+            )
+            if op == "add" or not model:
+                table.add_seq(next_sid)
+                model[next_sid] = ([], [])
+                next_sid += 1
+                continue
+            sid = int(rng.choice(list(model)))
+            acc, spec = model[sid]
+            if op == "write":
+                n = int(rng.integers(1, 2 * page_size))
+                commit = bool(rng.integers(0, 2)) and not spec
+                try:
+                    slots = table.assign_write_slots(sid, n, commit=commit)
+                except (OutOfPages, ValueError):
+                    continue
+                start = len(acc) + len(spec)
+                for j, s in enumerate(slots):
+                    slot_of[(sid, start + j)] = int(s)
+                (acc if commit else spec).extend(range(start, start + n))
+            elif op == "commit":
+                table.commit(sid)
+                acc.extend(spec)
+                spec.clear()
+            elif op == "rollback":
+                table.rollback(sid)
+                for p in spec:
+                    slot_of.pop((sid, p), None)
+                spec.clear()
+            elif op == "accept":
+                if not spec:
+                    continue
+                k = int(rng.integers(0, len(spec) + 1))
+                # accept the first k spec tokens in place (no reorder here)
+                table.accept(sid, k)
+                for p in spec[k:]:
+                    slot_of.pop((sid, p), None)
+                acc.extend(spec[:k])
+                spec.clear()
+            elif op == "drop":
+                table.drop_seq(sid)
+                for p in list(acc) + list(spec):
+                    slot_of.pop((sid, p), None)
+                del model[sid]
+                continue
+            # invariants after every op
+            st = table.seq(sid)
+            assert st.l_acc == len(acc), (trial, op)
+            assert st.l_seq == len(acc) + len(spec), (trial, op)
+            # committed prefix slots stable: positions map to the SAME
+            # slots they were written to
+            pref = table.prefix_slots(sid, committed_only=True)
+            assert len(pref) == len(acc)
+            for j, s in enumerate(pref):
+                assert slot_of[(sid, j)] == int(s), (trial, op, j)
+        # global invariant: live pages + free pages == num_pages and no
+        # page double-owned
+        owned = [p for s in model for p in table.seq(s).pages]
+        assert len(owned) == len(set(owned))
+        assert len(owned) + table.free_pages == num_pages
