@@ -111,7 +111,9 @@ class CacheManager:
         if quant is None:
             quant = env.get("BBTPU_KV_QUANT")
         self.quant = None if quant in (None, "none") else quant
-        self.table = PagedKVTable(num_pages, page_size)
+        from bloombee_tpu.kv.paged_native import make_table
+
+        self.table = make_table(num_pages, page_size)
         if hetero_spec is not None and hetero_spec.heterogeneous:
             if self.quant:
                 raise ValueError(
@@ -243,7 +245,7 @@ class CacheManager:
             need += max(
                 0,
                 -(-(st.l_seq + num_tokens) // self.page_size)
-                - len(st.pages),
+                - st.num_pages,
             )
         if need > table.free_pages and self.reclaimer is not None:
             # over-subscribed: evict idle sessions' KV to host and retry
@@ -388,9 +390,7 @@ class CacheManager:
             )
         self._parked[seq_id] = (k_host, v_host, state.l_acc, state.l_seq)
         # free device pages but keep the seq registered with zero length
-        state.l_acc = 0
-        state.l_seq = 0
-        self.table.rollback(seq_id)
+        self.table.reset_seq(seq_id)
 
     _disk_counter = itertools.count()
 
@@ -425,7 +425,7 @@ class CacheManager:
         # attempt, so only drop it once slots are secured
         slots_np = self.table.assign_write_slots(seq_id, l_seq, commit=False)
         del self._parked[seq_id]
-        state.l_acc = l_acc
+        self.table.restore_committed(seq_id, l_acc)
         slots = jnp.asarray(slots_np)
         from bloombee_tpu.kv.quant import QuantSlab, dequantize
 

@@ -33,6 +33,10 @@ class SeqState:
     l_acc: int = 0  # committed token count
     l_seq: int = 0  # total written (committed + speculative)
 
+    @property
+    def num_pages(self) -> int:
+        return len(self.pages)
+
 
 class PagedKVTable:
     """Page allocator + per-sequence length bookkeeping (host side)."""
@@ -97,8 +101,17 @@ class PagedKVTable:
         mirroring the reference write(commit=...) flag (paged_kv.py:137-204).
         Returns int32 flat slot ids (page * page_size + offset).
         """
+        if num_tokens < 0:
+            raise ValueError(f"num_tokens must be >= 0, got {num_tokens}")
         state = self._seqs[seq_id]
         start = state.l_seq
+        if commit and state.l_acc != start:
+            # validate BEFORE reserving: an invalid write must not mutate
+            # the table (pages/lengths) on its way to the exception
+            raise ValueError(
+                "committed write must follow the committed prefix "
+                f"(l_acc={state.l_acc}, write starts at {start})"
+            )
         self.reserve(seq_id, start + num_tokens)
         positions = np.arange(start, start + num_tokens)
         pages = np.asarray(state.pages, dtype=np.int64)[
@@ -107,11 +120,6 @@ class PagedKVTable:
         slots = pages * self.page_size + positions % self.page_size
         state.l_seq = start + num_tokens
         if commit:
-            if state.l_acc != start:
-                raise ValueError(
-                    "committed write must follow the committed prefix "
-                    f"(l_acc={state.l_acc}, write starts at {start})"
-                )
             state.l_acc = state.l_seq
         return slots.astype(np.int32)
 
@@ -165,6 +173,24 @@ class PagedKVTable:
         state = self._seqs[seq_id]
         state.l_seq = state.l_acc
         self._trim(state)
+
+    def reset_seq(self, seq_id: int) -> None:
+        """Drop ALL tokens (committed included) and free the pages, keeping
+        the sequence registered — the parking primitive."""
+        state = self._seqs[seq_id]
+        state.l_acc = 0
+        state.l_seq = 0
+        self._trim(state)
+
+    def restore_committed(self, seq_id: int, l_acc: int) -> None:
+        """Set the committed watermark without touching l_seq (unparking
+        re-materializes tokens speculatively, then restores l_acc)."""
+        state = self._seqs[seq_id]
+        if not 0 <= l_acc <= state.l_seq:
+            raise ValueError(
+                f"l_acc {l_acc} outside [0, {state.l_seq}]"
+            )
+        state.l_acc = l_acc
 
     def _trim(self, state: SeqState) -> None:
         keep = self._pages_for(max(state.l_seq, state.l_acc))

@@ -1,0 +1,231 @@
+"""Native (C++) paged table behind the PagedKVTable API.
+
+The table is the hot host-side control plane of every serving step; the C++
+implementation (native/paged_table.cc) replicates kv/paged.py exactly —
+including LIFO free-list order, so slot assignment is bit-identical (pinned
+by a randomized equivalence test). `make_table` picks the implementation:
+BBTPU_NATIVE_TABLE=1 (default) uses C++ when the toolchain builds it, with
+a silent fall back to the pure-Python table otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from bloombee_tpu.kv.paged import DEFAULT_PAGE_SIZE, OutOfPages, PagedKVTable
+from bloombee_tpu.utils import env
+
+env.declare(
+    "BBTPU_NATIVE_TABLE", bool, True,
+    "use the C++ paged table when the toolchain can build it",
+)
+
+
+def _check(rc: int, what: str) -> int:
+    if rc == -1:
+        raise KeyError(f"{what}: unknown sequence")
+    if rc == -2:
+        raise OutOfPages(what)
+    if rc < 0:
+        raise ValueError(f"{what}: rc={rc}")
+    return rc
+
+
+class _NativeSeqView:
+    """Duck-typed stand-in for paged.SeqState (read-only fields)."""
+
+    __slots__ = ("_t", "_sid")
+
+    def __init__(self, table: "NativePagedKVTable", sid: int):
+        self._t = table
+        self._sid = sid
+
+    @property
+    def l_acc(self) -> int:
+        return _check(
+            self._t._lib.pt_l_acc(self._t._h, self._sid), "l_acc"
+        )
+
+    @property
+    def l_seq(self) -> int:
+        return _check(
+            self._t._lib.pt_l_seq(self._t._h, self._sid), "l_seq"
+        )
+
+    @property
+    def num_pages(self) -> int:
+        return _check(
+            self._t._lib.pt_num_seq_pages(self._t._h, self._sid),
+            "num_pages",
+        )
+
+    @property
+    def pages(self) -> list[int]:
+        n = _check(
+            self._t._lib.pt_num_seq_pages(self._t._h, self._sid), "pages"
+        )
+        out = np.empty(max(n, 1), dtype=np.int32)
+        self._t._lib.pt_page_row(
+            self._t._h, self._sid,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n,
+        )
+        return [int(x) for x in out[:n]]
+
+
+class NativePagedKVTable:
+    """C++-backed table with kv/paged.PagedKVTable's exact API."""
+
+    def __init__(self, num_pages: int, page_size: int = DEFAULT_PAGE_SIZE):
+        from bloombee_tpu.native import paged_table_lib
+
+        lib = paged_table_lib()
+        if lib is None:
+            raise RuntimeError("native paged table unavailable")
+        if num_pages <= 0 or page_size <= 0:
+            raise ValueError("num_pages and page_size must be positive")
+        self._lib = lib
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._h = lib.pt_create(num_pages, page_size)
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        try:
+            self._lib.pt_destroy(self._h)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def free_pages(self) -> int:
+        return _check(self._lib.pt_free_pages(self._h), "free_pages")
+
+    @property
+    def free_tokens(self) -> int:
+        return self.free_pages * self.page_size
+
+    def has_seq(self, seq_id: int) -> bool:
+        return bool(_check(self._lib.pt_has_seq(self._h, seq_id), "has_seq"))
+
+    def seq(self, seq_id: int) -> _NativeSeqView:
+        if not self.has_seq(seq_id):
+            raise KeyError(seq_id)
+        return _NativeSeqView(self, seq_id)
+
+    def add_seq(self, seq_id: int) -> None:
+        rc = self._lib.pt_add_seq(self._h, seq_id)
+        if rc == -3:
+            raise ValueError(f"sequence {seq_id} already exists")
+        _check(rc, "add_seq")
+
+    def drop_seq(self, seq_id: int) -> None:
+        _check(self._lib.pt_drop_seq(self._h, seq_id), "drop_seq")
+
+    # --------------------------------------------------------------- writing
+    def assign_write_slots(
+        self, seq_id: int, num_tokens: int, commit: bool = True
+    ) -> np.ndarray:
+        out = np.empty(max(num_tokens, 1), dtype=np.int32)
+        rc = self._lib.pt_assign_write_slots(
+            self._h, seq_id, num_tokens, 1 if commit else 0,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if rc == -2:
+            raise OutOfPages(f"write of {num_tokens} tokens")
+        if rc == -3:
+            raise ValueError(
+                "committed write must follow the committed prefix"
+            )
+        _check(rc, "assign_write_slots")
+        return out[:num_tokens].copy()
+
+    # ------------------------------------------------------ commit / rollback
+    def commit(self, seq_id: int, length: int | None = None) -> None:
+        rc = self._lib.pt_commit(
+            self._h, seq_id, -1 if length is None else length
+        )
+        if rc == -3:
+            raise ValueError(f"commit length {length} out of range")
+        _check(rc, "commit")
+
+    def accept(self, seq_id: int, num_accepted: int) -> None:
+        rc = self._lib.pt_accept(self._h, seq_id, num_accepted)
+        if rc == -3:
+            raise ValueError(
+                f"accept {num_accepted} outside speculative window"
+            )
+        _check(rc, "accept")
+
+    def rollback(self, seq_id: int) -> None:
+        _check(self._lib.pt_rollback(self._h, seq_id), "rollback")
+
+    def reset_seq(self, seq_id: int) -> None:
+        _check(self._lib.pt_reset_seq(self._h, seq_id), "reset_seq")
+
+    def restore_committed(self, seq_id: int, l_acc: int) -> None:
+        rc = self._lib.pt_restore_committed(self._h, seq_id, l_acc)
+        if rc == -3:
+            raise ValueError(f"l_acc {l_acc} out of range")
+        _check(rc, "restore_committed")
+
+    def range_slots(self, seq_id: int, start: int, end: int) -> np.ndarray:
+        out = np.empty(max(end - start, 1), dtype=np.int32)
+        rc = self._lib.pt_range_slots(
+            self._h, seq_id, start, end,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if rc == -3:
+            raise ValueError("range beyond allocated pages")
+        _check(rc, "range_slots")
+        return out[: end - start].copy()
+
+    # ---------------------------------------------------------- device plans
+    def page_table(self, seq_ids: list[int], max_pages: int) -> np.ndarray:
+        out = np.zeros((len(seq_ids), max_pages), dtype=np.int32)
+        for i, sid in enumerate(seq_ids):
+            rc = self._lib.pt_page_row(
+                self._h, sid,
+                out[i].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                max_pages,
+            )
+            if rc == -3:
+                raise ValueError(
+                    f"sequence {sid} has more pages than bucket {max_pages}"
+                )
+            _check(rc, "page_table")
+        return out
+
+    def context_lens(
+        self, seq_ids: list[int], committed_only: bool = False
+    ) -> np.ndarray:
+        fn = self._lib.pt_l_acc if committed_only else self._lib.pt_l_seq
+        return np.asarray(
+            [_check(fn(self._h, s), "context_lens") for s in seq_ids],
+            dtype=np.int32,
+        )
+
+    def prefix_slots(
+        self, seq_id: int, committed_only: bool = True
+    ) -> np.ndarray:
+        n = _check(
+            (self._lib.pt_l_acc if committed_only else self._lib.pt_l_seq)(
+                self._h, seq_id
+            ),
+            "prefix_slots",
+        )
+        return self.range_slots(seq_id, 0, n)
+
+
+def make_table(num_pages: int, page_size: int = DEFAULT_PAGE_SIZE):
+    """The serving table: native when available and enabled, else Python."""
+    if env.get("BBTPU_NATIVE_TABLE"):
+        try:
+            return NativePagedKVTable(num_pages, page_size)
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "native table unavailable (%s); using python table", e
+            )
+    return PagedKVTable(num_pages, page_size)

@@ -49,6 +49,53 @@ def _build(src: pathlib.Path) -> pathlib.Path | None:
         return None
 
 
+_paged_lib = None
+_paged_tried = False
+
+
+def paged_table_lib():
+    """ctypes handle to the native paged table, or None."""
+    global _paged_lib, _paged_tried
+    if _paged_tried:
+        return _paged_lib
+    _paged_tried = True
+    so = _build(_SRC_DIR / "paged_table.cc")
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(so))
+        i64, i32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)
+        sigs = {
+            "pt_create": ([i64, i64], i64),
+            "pt_destroy": ([i64], None),
+            "pt_free_pages": ([i64], i64),
+            "pt_add_seq": ([i64, i64], i64),
+            "pt_has_seq": ([i64, i64], i64),
+            "pt_drop_seq": ([i64, i64], i64),
+            "pt_l_acc": ([i64, i64], i64),
+            "pt_l_seq": ([i64, i64], i64),
+            "pt_num_seq_pages": ([i64, i64], i64),
+            "pt_assign_write_slots": (
+                [i64, i64, i64, ctypes.c_int32, i32p], i64,
+            ),
+            "pt_commit": ([i64, i64, i64], i64),
+            "pt_accept": ([i64, i64, i64], i64),
+            "pt_rollback": ([i64, i64], i64),
+            "pt_reset_seq": ([i64, i64], i64),
+            "pt_restore_committed": ([i64, i64, i64], i64),
+            "pt_page_row": ([i64, i64, i32p, i64], i64),
+            "pt_range_slots": ([i64, i64, i64, i64, i32p], i64),
+        }
+        for name, (args, res) in sigs.items():
+            fn = getattr(lib, name)
+            fn.argtypes = args
+            fn.restype = res
+        _paged_lib = lib
+    except Exception as e:  # pragma: no cover
+        logger.info("native load failed (%s); using python table", e)
+    return _paged_lib
+
+
 def byte_split_lib():
     """ctypes handle to the byte-split codec, or None."""
     global _byte_split_lib, _tried

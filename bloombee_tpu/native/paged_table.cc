@@ -1,0 +1,241 @@
+// Native paged KV table: the hot host-side control plane of every serving
+// step (slot assignment, commit/rollback/accept, page bookkeeping).
+// Semantics mirror bloombee_tpu/kv/paged.py EXACTLY — including the LIFO
+// free-list order — so slot assignment is bit-identical to the Python
+// table (the randomized equivalence test relies on that).
+//
+// C ABI, driven via ctypes. Error codes:
+//   >= 0 success (payload-dependent meaning)
+//   -1 unknown sequence        -2 out of pages
+//   -3 invalid argument        -4 unknown table handle
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Seq {
+  std::vector<int32_t> pages;
+  int64_t l_acc = 0;
+  int64_t l_seq = 0;
+};
+
+struct Table {
+  int64_t num_pages;
+  int64_t page_size;
+  std::vector<int32_t> free_list;  // LIFO: pop from the back
+  std::unordered_map<int64_t, Seq> seqs;
+};
+
+// handles are the Table pointers themselves: no shared registry, so
+// concurrent create/destroy from different threads cannot race a map
+Table* get(int64_t h) { return reinterpret_cast<Table*>(h); }
+
+int64_t pages_for(const Table& t, int64_t tokens) {
+  return (tokens + t.page_size - 1) / t.page_size;
+}
+
+void trim(Table& t, Seq& s) {
+  int64_t keep = pages_for(t, s.l_seq > s.l_acc ? s.l_seq : s.l_acc);
+  while ((int64_t)s.pages.size() > keep) {
+    t.free_list.push_back(s.pages.back());
+    s.pages.pop_back();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pt_create(int64_t num_pages, int64_t page_size) {
+  if (num_pages <= 0 || page_size <= 0) return -3;
+  Table* t = new Table;
+  t->num_pages = num_pages;
+  t->page_size = page_size;
+  // python fills range(num_pages-1, -1, -1) and pops from the END, so the
+  // first page handed out is page 0
+  t->free_list.reserve(num_pages);
+  for (int64_t p = num_pages - 1; p >= 0; --p)
+    t->free_list.push_back((int32_t)p);
+  return reinterpret_cast<int64_t>(t);
+}
+
+void pt_destroy(int64_t h) {
+  delete reinterpret_cast<Table*>(h);
+}
+
+int64_t pt_free_pages(int64_t h) {
+  Table* t = get(h);
+  return t ? (int64_t)t->free_list.size() : -4;
+}
+
+int64_t pt_add_seq(int64_t h, int64_t sid) {
+  Table* t = get(h);
+  if (!t) return -4;
+  if (t->seqs.count(sid)) return -3;
+  t->seqs[sid] = Seq{};
+  return 0;
+}
+
+int64_t pt_has_seq(int64_t h, int64_t sid) {
+  Table* t = get(h);
+  if (!t) return -4;
+  return t->seqs.count(sid) ? 1 : 0;
+}
+
+int64_t pt_drop_seq(int64_t h, int64_t sid) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  if (it == t->seqs.end()) return -1;
+  for (int32_t p : it->second.pages) t->free_list.push_back(p);
+  t->seqs.erase(it);
+  return 0;
+}
+
+int64_t pt_l_acc(int64_t h, int64_t sid) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  return it == t->seqs.end() ? -1 : it->second.l_acc;
+}
+
+int64_t pt_l_seq(int64_t h, int64_t sid) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  return it == t->seqs.end() ? -1 : it->second.l_seq;
+}
+
+int64_t pt_num_seq_pages(int64_t h, int64_t sid) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  return it == t->seqs.end() ? -1 : (int64_t)it->second.pages.size();
+}
+
+// Assign flat slots for the next num_tokens tokens; writes them to out.
+// Returns num_tokens, or an error code.
+int64_t pt_assign_write_slots(int64_t h, int64_t sid, int64_t num_tokens,
+                              int32_t commit, int32_t* out) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  if (it == t->seqs.end()) return -1;
+  Seq& s = it->second;
+  if (num_tokens < 0) return -3;
+  int64_t start = s.l_seq;
+  // validation precedes capacity (and any mutation) — same order as the
+  // Python table, so both raise the same error for the same op
+  if (commit && s.l_acc != start) return -3;
+  int64_t need = pages_for(*t, start + num_tokens) - (int64_t)s.pages.size();
+  if (need > (int64_t)t->free_list.size()) return -2;
+  for (int64_t i = 0; i < need; ++i) {
+    s.pages.push_back(t->free_list.back());
+    t->free_list.pop_back();
+  }
+  for (int64_t i = 0; i < num_tokens; ++i) {
+    int64_t pos = start + i;
+    out[i] = s.pages[pos / t->page_size] * (int32_t)t->page_size +
+             (int32_t)(pos % t->page_size);
+  }
+  s.l_seq = start + num_tokens;
+  if (commit) s.l_acc = s.l_seq;
+  return num_tokens;
+}
+
+int64_t pt_commit(int64_t h, int64_t sid, int64_t length /* -1 = l_seq */) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  if (it == t->seqs.end()) return -1;
+  Seq& s = it->second;
+  if (length < 0) length = s.l_seq;
+  if (length < s.l_acc || length > s.l_seq) return -3;
+  s.l_acc = length;
+  s.l_seq = length;
+  trim(*t, s);
+  return 0;
+}
+
+int64_t pt_accept(int64_t h, int64_t sid, int64_t num_accepted) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  if (it == t->seqs.end()) return -1;
+  Seq& s = it->second;
+  if (num_accepted < 0 || num_accepted > s.l_seq - s.l_acc) return -3;
+  s.l_acc += num_accepted;
+  s.l_seq = s.l_acc;
+  trim(*t, s);
+  return 0;
+}
+
+int64_t pt_rollback(int64_t h, int64_t sid) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  if (it == t->seqs.end()) return -1;
+  Seq& s = it->second;
+  s.l_seq = s.l_acc;
+  trim(*t, s);
+  return 0;
+}
+
+// Writes the page list (padded positions untouched); returns page count or
+// error.
+int64_t pt_page_row(int64_t h, int64_t sid, int32_t* out, int64_t max_pages) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  if (it == t->seqs.end()) return -1;
+  Seq& s = it->second;
+  if ((int64_t)s.pages.size() > max_pages) return -3;
+  for (std::size_t i = 0; i < s.pages.size(); ++i) out[i] = s.pages[i];
+  return (int64_t)s.pages.size();
+}
+
+// Flat slots for positions [start, end); returns count or error.
+int64_t pt_range_slots(int64_t h, int64_t sid, int64_t start, int64_t end,
+                       int32_t* out) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  if (it == t->seqs.end()) return -1;
+  Seq& s = it->second;
+  if (start < 0 || end < start ||
+      end > (int64_t)s.pages.size() * t->page_size)
+    return -3;
+  for (int64_t pos = start; pos < end; ++pos) {
+    out[pos - start] = s.pages[pos / t->page_size] * (int32_t)t->page_size +
+                       (int32_t)(pos % t->page_size);
+  }
+  return end - start;
+}
+
+int64_t pt_reset_seq(int64_t h, int64_t sid) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  if (it == t->seqs.end()) return -1;
+  Seq& s = it->second;
+  s.l_acc = 0;
+  s.l_seq = 0;
+  trim(*t, s);
+  return 0;
+}
+
+int64_t pt_restore_committed(int64_t h, int64_t sid, int64_t l_acc) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  if (it == t->seqs.end()) return -1;
+  Seq& s = it->second;
+  if (l_acc < 0 || l_acc > s.l_seq) return -3;
+  s.l_acc = l_acc;
+  return 0;
+}
+
+}  // extern "C"

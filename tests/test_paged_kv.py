@@ -183,3 +183,86 @@ def test_paged_table_fuzz_against_model():
         owned = [p for s in model for p in table.seq(s).pages]
         assert len(owned) == len(set(owned))
         assert len(owned) + table.free_pages == num_pages
+
+
+def test_native_table_bit_identical_to_python():
+    """The C++ table must be BIT-IDENTICAL to the Python table across random
+    op sequences (same LIFO free-list order => same slots)."""
+    import numpy as np
+    import pytest
+
+    from bloombee_tpu.kv.paged import OutOfPages, PagedKVTable
+    from bloombee_tpu.kv.paged_native import NativePagedKVTable
+
+    try:
+        native = NativePagedKVTable(8, 4)
+    except RuntimeError:
+        pytest.skip("no C++ toolchain")
+    rng = np.random.default_rng(7)
+    for trial in range(10):
+        py = PagedKVTable(10, 3)
+        cc = NativePagedKVTable(10, 3)
+        sids: list[int] = []
+        next_sid = 0
+        for _ in range(300):
+            op = rng.choice(
+                ["add", "write", "commit", "commit_len", "rollback",
+                 "accept", "drop"]
+            )
+            if op == "add" or not sids:
+                py.add_seq(next_sid)
+                cc.add_seq(next_sid)
+                sids.append(next_sid)
+                next_sid += 1
+                continue
+            sid = int(rng.choice(sids))
+            if op == "write":
+                n = int(rng.integers(1, 7))
+                commit = bool(rng.integers(0, 2))
+                res = []
+                for t in (py, cc):
+                    try:
+                        res.append(("ok", t.assign_write_slots(
+                            sid, n, commit=commit)))
+                    except OutOfPages:
+                        res.append(("oop", None))
+                    except ValueError:
+                        res.append(("val", None))
+                assert res[0][0] == res[1][0], (trial, op)
+                if res[0][0] == "ok":
+                    np.testing.assert_array_equal(res[0][1], res[1][1])
+            elif op == "commit":
+                py.commit(sid)
+                cc.commit(sid)
+            elif op == "commit_len":
+                st = py.seq(sid)
+                if st.l_seq > st.l_acc:
+                    ln = int(rng.integers(st.l_acc, st.l_seq + 1))
+                    py.commit(sid, ln)
+                    cc.commit(sid, ln)
+            elif op == "rollback":
+                py.rollback(sid)
+                cc.rollback(sid)
+            elif op == "accept":
+                st = py.seq(sid)
+                spec = st.l_seq - st.l_acc
+                if spec:
+                    k = int(rng.integers(0, spec + 1))
+                    py.accept(sid, k)
+                    cc.accept(sid, k)
+            elif op == "drop":
+                py.drop_seq(sid)
+                cc.drop_seq(sid)
+                sids.remove(sid)
+                continue
+            # state must match exactly after every op
+            assert py.free_pages == cc.free_pages, (trial, op)
+            for s in sids:
+                ps, cs = py.seq(s), cc.seq(s)
+                assert (ps.l_acc, ps.l_seq, ps.pages) == (
+                    cs.l_acc, cs.l_seq, cs.pages
+                ), (trial, op, s)
+                np.testing.assert_array_equal(
+                    py.prefix_slots(s, committed_only=False),
+                    cc.prefix_slots(s, committed_only=False),
+                )
