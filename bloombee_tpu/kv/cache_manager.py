@@ -57,6 +57,70 @@ class AllocationTimeout(RuntimeError):
     pass
 
 
+class ParkedKVLost(RuntimeError):
+    """The background d2h copy of parked KV failed (e.g. disk full) after
+    the device pages were already reused. The sequence's server-side KV is
+    gone; the client recovers by replaying its token history onto a fresh
+    allocation (the same path that handles a dead server)."""
+
+
+@dataclasses.dataclass
+class _Parked:
+    """One parked sequence's KV: either still in flight to host (`future`
+    resolves to the (k_host, v_host) tuple) or already resolved (`host`)."""
+
+    l_acc: int
+    l_seq: int
+    host: tuple | None = None
+    future: object | None = None  # concurrent.futures.Future
+
+    def resolve(self) -> tuple:
+        if self.host is None:
+            try:
+                self.host = self.future.result()
+            except Exception as e:
+                raise ParkedKVLost(
+                    f"background park copy failed ({e!r}); KV for this "
+                    "sequence is unrecoverable — replay the session"
+                ) from e
+            self.future = None
+        return self.host
+
+
+class _DaemonPool:
+    """Two-worker submit() pool built on daemon threads.
+
+    concurrent.futures.ThreadPoolExecutor joins its (non-daemon) workers at
+    interpreter exit — with a PJRT-wedged d2h copy in flight that join
+    blocks forever and the process can never exit. Daemon threads let the
+    interpreter die with the wedge still pending."""
+
+    def __init__(self, max_workers: int = 2, name: str = "kv-park"):
+        import concurrent.futures
+        import queue
+
+        self._futures = concurrent.futures
+        self._q: queue.Queue = queue.Queue()
+        for i in range(max_workers):
+            threading.Thread(
+                target=self._worker, name=f"{name}-{i}", daemon=True
+            ).start()
+
+    def _worker(self):
+        while True:
+            fut, fn = self._q.get()
+            if fut.set_running_or_notify_cancel():
+                try:
+                    fut.set_result(fn())
+                except BaseException as e:  # noqa: BLE001 — relay to waiter
+                    fut.set_exception(e)
+
+    def submit(self, fn):
+        fut = self._futures.Future()
+        self._q.put((fut, fn))
+        return fut
+
+
 def _locked(fn):
     """Serialize table/arena mutations across the compute thread and the
     event loop (see CacheManager._lock)."""
@@ -137,7 +201,11 @@ class CacheManager:
         self._cond: asyncio.Condition | None = None
         self._seq_counter = itertools.count()
         self._handle_counter = itertools.count()
-        self._parked: dict[int, tuple[np.ndarray, np.ndarray, int, int]] = {}
+        self._parked: dict[int, _Parked] = {}
+        # d2h copies of parked KV run here so parking never stalls the
+        # compute thread (the copy engine half of the reference's async
+        # offload, mcm.py:972-1335); 2 workers keep host-link order sane
+        self._park_pool = None  # created lazily on first park
         # over-subscription (the FlexGen serve-more-than-HBM-fits story):
         # admission may reserve up to oversubscribe x capacity; physical
         # page pressure is relieved by the reclaimer callback (the server
@@ -332,7 +400,7 @@ class CacheManager:
         evicted — the client's retry path handles it."""
         parked = [sid for sid in handle.seq_ids if sid in self._parked]
         for sid in parked:
-            l_seq = self._parked[sid][3]
+            l_seq = self._parked[sid].l_seq
             need = -(-l_seq // self.page_size)
             if need > self.table.free_pages and self.reclaimer is not None:
                 self.reclaimer(
@@ -350,9 +418,17 @@ class CacheManager:
         BBTPU_DISK_DIR — the third tier of the reference's FlexGen substrate
         (pytorch_backend.py TorchDisk, np.memmap-backed tensors).
         Lengths are preserved; `unpark_sequence` restores (possibly to
-        different pages). This is the paged equivalent of the reference's
-        micro-batch KV offload to CPU staging
-        (memory_cache_manager.py:972-1335).
+        different pages).
+
+        ASYNC: only the device-side gather (and optional int4 quantize) runs
+        here; pages are freed immediately and the d2h copy overlaps ongoing
+        serving on a background thread (the copy-engine overlap of the
+        reference's async offload, memory_cache_manager.py:972-1335).
+        Freeing before the copy lands is safe: the gather is dispatched
+        before any later step that could write the freed slots, and the
+        device executes dispatches in order. Until the copy drains, the
+        gathered slice transiently holds its bytes in HBM (int4 planes when
+        quantized parking is on).
         """
         if tier not in ("host", "disk"):
             # before the expensive d2h copy, not after
@@ -368,27 +444,37 @@ class CacheManager:
             # reference's compressed offload)
             from bloombee_tpu.kv import quant as q
 
-            k_host = jax.tree.map(
-                np.asarray, q.quantize(self.arena["k"][:, slots])
-            )
-            v_host = jax.tree.map(
-                np.asarray, q.quantize(self.arena["v"][:, slots])
-            )
+            k_dev = q.quantize(self.arena["k"][:, slots])
+            v_dev = q.quantize(self.arena["v"][:, slots])
         else:
 
             def take(a):
-                return np.asarray(a[:, slots])
+                return a[:, slots]
 
-            k_host = jax.tree.map(take, self.arena["k"])  # [L, n, kv, hd]
-            v_host = jax.tree.map(take, self.arena["v"])
-        if tier == "disk":
-            k_host = jax.tree.map(
-                lambda a, tag=("k", seq_id): self._to_disk(a, *tag), k_host
-            )
-            v_host = jax.tree.map(
-                lambda a, tag=("v", seq_id): self._to_disk(a, *tag), v_host
-            )
-        self._parked[seq_id] = (k_host, v_host, state.l_acc, state.l_seq)
+            k_dev = jax.tree.map(take, self.arena["k"])  # [L, n, kv, hd]
+            v_dev = jax.tree.map(take, self.arena["v"])
+
+        def fetch(k_dev=k_dev, v_dev=v_dev, tier=tier, seq_id=seq_id):
+            k_host = jax.tree.map(np.asarray, k_dev)
+            v_host = jax.tree.map(np.asarray, v_dev)
+            if tier == "disk":
+                k_host = jax.tree.map(
+                    lambda a, tag=("k", seq_id): self._to_disk(a, *tag),
+                    k_host,
+                )
+                v_host = jax.tree.map(
+                    lambda a, tag=("v", seq_id): self._to_disk(a, *tag),
+                    v_host,
+                )
+            return k_host, v_host
+
+        if self._park_pool is None:
+            self._park_pool = _DaemonPool()
+        self._parked[seq_id] = _Parked(
+            l_acc=state.l_acc,
+            l_seq=state.l_seq,
+            future=self._park_pool.submit(fetch),
+        )
         # free device pages but keep the seq registered with zero length
         self.table.reset_seq(seq_id)
 
@@ -418,7 +504,17 @@ class CacheManager:
 
     @_locked
     def unpark_sequence(self, seq_id: int) -> None:
-        k_host, v_host, l_acc, l_seq = self._parked[seq_id]
+        entry = self._parked[seq_id]
+        # blocks until the background d2h copy has landed (usually long
+        # done — the sequence sat parked precisely because it was idle)
+        try:
+            k_host, v_host = entry.resolve()
+        except ParkedKVLost:
+            # the copy is gone for good: drop the entry so the client's
+            # replay lands on a clean zero-length sequence, not a wedge
+            del self._parked[seq_id]
+            raise
+        l_acc, l_seq = entry.l_acc, entry.l_seq
         state = self.table.seq(seq_id)
         assert state.l_seq == 0, "unpark target must be empty"
         # may raise OutOfPages: the parked host copy must survive a failed

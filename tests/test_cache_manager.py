@@ -5,10 +5,12 @@ blocking allocation, timeout) onto the asyncio single-process design.
 """
 
 import asyncio
+import functools
 
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from bloombee_tpu.kv.cache_manager import AllocationTimeout, CacheManager
@@ -109,6 +111,76 @@ def test_park_unpark_roundtrip():
     asyncio.run(run())
 
 
+def test_async_park_survives_page_reuse():
+    """Parking is async (pages free before the d2h copy lands): a second
+    sequence immediately rewriting the freed slots must not corrupt the
+    parked copy — the device executes the park's gather before the rewrite
+    because it was dispatched first."""
+
+    async def run():
+        m = make_manager()
+        rng = np.random.default_rng(7)
+        async with m.allocate(1, 16) as h1, m.allocate(1, 16) as h2:
+            sid = h1.seq_ids[0]
+            k_new = rng.normal(size=(6, 1, 4)).astype(np.float32)
+            slots = jnp.asarray(m.write_slots(h1, 6))
+            for layer in range(m.num_layers):
+                m.arena["k"] = (
+                    m.arena["k"].at[layer, slots].set(jnp.asarray(k_new))
+                )
+                m.arena["v"] = (
+                    m.arena["v"].at[layer, slots].set(jnp.asarray(k_new))
+                )
+            m.park_sequence(sid)
+            # immediately claim + clobber the freed slots from a second seq
+            # through a DONATING jit like the production step (step.py
+            # donates the arena): on backends that honor donation this
+            # rewrites the very buffer the in-flight park gather reads, so
+            # dispatch order is what protects the parked copy (CPU ignores
+            # donation, so there the clobber is only structural)
+            slots2 = jnp.asarray(m.write_slots(h2, 6))
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def clobber(a, s):
+                return a.at[:, s].set(999.0)
+
+            m.arena["k"] = clobber(m.arena["k"], slots2)
+            m.arena["v"] = clobber(m.arena["v"], slots2)
+            m.unpark_sequence(sid)
+            got = np.asarray(
+                m.arena["k"][0][jnp.asarray(m.table.prefix_slots(sid))]
+            )
+            np.testing.assert_array_equal(got, k_new)
+
+    asyncio.run(run())
+
+
+def test_failed_park_copy_raises_parked_kv_lost(monkeypatch):
+    """If the background d2h copy fails after pages were freed, the next
+    touch of that sequence raises ParkedKVLost (clients replay the session)
+    and the parked entry is dropped rather than wedged."""
+    from bloombee_tpu.kv.cache_manager import ParkedKVLost
+
+    async def run():
+        m = make_manager()
+        async with m.allocate(1, 16) as h:
+            sid = h.seq_ids[0]
+            m.write_slots(h, 6)
+            monkeypatch.setattr(
+                CacheManager,
+                "_to_disk",
+                lambda self, a, kind, seq_id: (_ for _ in ()).throw(
+                    OSError("disk full")
+                ),
+            )
+            m.park_sequence(sid, tier="disk")
+            with pytest.raises(ParkedKVLost):
+                m.unpark_sequence(sid)
+            assert sid not in m._parked
+
+    asyncio.run(run())
+
+
 def test_park_to_disk_roundtrip(tmp_path, monkeypatch):
     """Disk tier (reference TorchDisk): parked KV lives in a memmap, device
     pages free, unpark restores exactly."""
@@ -139,7 +211,7 @@ def test_park_to_disk_roundtrip(tmp_path, monkeypatch):
             free_before = m.table.free_pages
             m.park_sequence(sid, tier="disk")
             assert m.table.free_pages > free_before  # pages actually freed
-            parked_k = m._parked[sid][0]
+            parked_k = m._parked[sid].resolve()[0]
             assert isinstance(parked_k, np.memmap)
             m.unpark_sequence(sid)
             after = np.asarray(m.arena["k"][0, m.table.prefix_slots(sid)])

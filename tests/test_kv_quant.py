@@ -167,7 +167,7 @@ def test_park_unpark_quantized_host(monkeypatch):
             sid = handle.seq_ids[0]
             before = np.asarray(manager.arena["k"][0, slots])
             manager.park_sequence(sid)
-            parked_k = manager._parked[sid][0]
+            parked_k = manager._parked[sid].resolve()[0]
             assert isinstance(parked_k, QuantSlab)  # int4 on host
             manager.unpark_sequence(sid)
             after_slots = manager.table.prefix_slots(sid)
