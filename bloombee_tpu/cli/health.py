@@ -17,7 +17,8 @@ def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("model_uid", nargs="?", default=None)
     parser.add_argument("--num-blocks", type=int)
-    parser.add_argument("--registry", default="127.0.0.1:7700")
+    parser.add_argument("--registry", default="127.0.0.1:7700",
+                        help="registry address or comma-separated replicas")
     parser.add_argument("--probe", action="store_true",
                         help="also call rpc_info on every server")
     parser.add_argument("--switches", action="store_true",
@@ -34,12 +35,11 @@ def main(argv=None):
         parser.error("model_uid and --num-blocks are required")
 
     async def run():
-        from bloombee_tpu.swarm.registry import RegistryClient
+        from bloombee_tpu.swarm.registry import make_registry
         from bloombee_tpu.swarm.spans import compute_spans
         from bloombee_tpu.wire.rpc import connect
 
-        host, port = args.registry.rsplit(":", 1)
-        reg = RegistryClient(host, int(port))
+        reg = make_registry(args.registry)
         infos = await reg.get_module_infos(
             args.model_uid, range(args.num_blocks)
         )

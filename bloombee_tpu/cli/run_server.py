@@ -21,7 +21,10 @@ def main(argv=None):
     parser.add_argument("model_dir", help="local HF model directory")
     parser.add_argument("--model-uid", default=None,
                         help="swarm uid (default: model dir name)")
-    parser.add_argument("--registry", default="127.0.0.1:7700")
+    parser.add_argument("--registry", default="127.0.0.1:7700",
+                        help="registry address, or a comma-separated "
+                             "replica list host:port,host:port (announces "
+                             "go to every replica)")
     parser.add_argument("--blocks", default=None,
                         help="'start:end' or omit for automatic selection")
     parser.add_argument("--num-blocks", type=int, default=None,
@@ -63,11 +66,13 @@ def main(argv=None):
         choose_num_blocks,
     )
     from bloombee_tpu.server.block_server import BlockServer
-    from bloombee_tpu.swarm.registry import RegistryClient
+    from bloombee_tpu.swarm.registry import make_registry
     from bloombee_tpu.swarm.spans import compute_spans
 
-    host, port = args.registry.rsplit(":", 1)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    # parse the registry spec BEFORE model resolution: a typo'd --registry
+    # must fail fast, not after a multi-GB hub download
+    registry = make_registry(args.registry)
     from bloombee_tpu.models.hub import resolve_model_dir
 
     args.model_dir = resolve_model_dir(args.model_dir)
@@ -75,7 +80,6 @@ def main(argv=None):
     model_uid = args.model_uid or args.model_dir.rstrip("/").split("/")[-1]
 
     async def run():
-        registry = RegistryClient(host, int(port))
         if args.blocks:
             start, end = (int(x) for x in args.blocks.split(":"))
         else:

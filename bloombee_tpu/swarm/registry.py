@@ -24,21 +24,32 @@ from bloombee_tpu.wire.rpc import Connection, RpcServer, connect
 
 
 class _Store:
+    # deletes live this long as tombstones so a replica that missed the
+    # delete can't resurrect the record in a merged read (must outlive the
+    # stale record's own expiration, default 30s announces)
+    TOMBSTONE_TTL = 60.0
+
     def __init__(self):
-        # key -> subkey -> (value dict, expiration unix time)
-        self._data: dict[str, dict[str, tuple[dict, float]]] = {}
+        # key -> subkey -> (value dict | None, expiration, stored_at)
+        # value None = tombstone (deleted; newer than any older live record)
+        self._data: dict[
+            str, dict[str, tuple[dict | None, float, float]]
+        ] = {}
 
     def store(self, key: str, subkey: str, value: dict, expiration: float):
-        self._data.setdefault(key, {})[subkey] = (value, expiration)
+        self._data.setdefault(key, {})[subkey] = (
+            value, expiration, time.time(),
+        )
 
     # --------------------------------------------------------- persistence
     def snapshot(self) -> list:
-        """Live records as a JSON-serializable list."""
+        """Live records (and tombstones) as a JSON-serializable list."""
         now = time.time()
         return [
-            {"key": k, "subkey": sk, "value": v, "expiration": exp}
+            {"key": k, "subkey": sk, "value": v, "expiration": exp,
+             "stored_at": t}
             for k, sub in self._data.items()
-            for sk, (v, exp) in sub.items()
+            for sk, (v, exp, t) in sub.items()
             if exp > now
         ]
 
@@ -46,28 +57,33 @@ class _Store:
         now = time.time()
         for r in records:
             if r["expiration"] > now:
-                self.store(r["key"], r["subkey"], r["value"], r["expiration"])
+                self._data.setdefault(r["key"], {})[r["subkey"]] = (
+                    r["value"], r["expiration"],
+                    r.get("stored_at", now),
+                )
 
-    def get(self, key: str) -> dict[str, dict]:
+    def get(self, key: str) -> dict[str, tuple[dict | None, float]]:
+        """subkey -> (value | None-for-tombstone, stored_at), expired pruned."""
         now = time.time()
         out = {}
         sub = self._data.get(key)
         if not sub:
             return out
         dead = []
-        for sk, (v, exp) in sub.items():
+        for sk, (v, exp, t) in sub.items():
             if exp < now:
                 dead.append(sk)
             else:
-                out[sk] = v
+                out[sk] = (v, t)
         for sk in dead:
             del sub[sk]
         return out
 
     def delete(self, key: str, subkey: str):
-        sub = self._data.get(key)
-        if sub:
-            sub.pop(subkey, None)
+        now = time.time()
+        self._data.setdefault(key, {})[subkey] = (
+            None, now + self.TOMBSTONE_TTL, now,
+        )
 
 
 class RegistryServer:
@@ -154,7 +170,17 @@ class RegistryServer:
         return {"ok": True}, []
 
     async def _rpc_get(self, meta: dict, tensors):
-        return {"results": {k: self._store.get(k) for k in meta["keys"]}}, []
+        # each record ships as {"v": value-or-None, "t": stored_at} so a
+        # replicated reader can do latest-write-wins across replicas
+        return {
+            "results": {
+                k: {
+                    sk: {"v": v, "t": t}
+                    for sk, (v, t) in self._store.get(k).items()
+                }
+                for k in meta["keys"]
+            }
+        }, []
 
     async def _rpc_delete(self, meta: dict, tensors):
         for rec in meta["records"]:
@@ -212,21 +238,180 @@ class RegistryClient:
         ]
         await conn.call("registry_delete", {"records": records})
 
+    async def get_records(
+        self, model_uid: str, blocks: range
+    ) -> list[dict[str, tuple[dict | None, float]]]:
+        """Per-block raw record maps: server_id -> (wire value | None for a
+        tombstone, stored_at). The replicated reader merges these by
+        latest-write-wins."""
+        conn = await self._connection()
+        keys = [f"{model_uid}.{i}" for i in blocks]
+        meta, _ = await conn.call("registry_get", {"keys": keys})
+        return [
+            {
+                sid: (rec["v"], rec["t"])
+                for sid, rec in meta["results"].get(key, {}).items()
+            }
+            for key in keys
+        ]
+
     async def get_module_infos(
         self, model_uid: str, blocks: range
     ) -> list[ModuleInfo]:
         """reference: get_remote_module_infos (utils/dht.py:74-117)."""
-        conn = await self._connection()
-        keys = [f"{model_uid}.{i}" for i in blocks]
-        meta, _ = await conn.call("registry_get", {"keys": keys})
-        out = []
-        for i, key in zip(blocks, keys):
-            servers = {
-                sid: ServerInfo.from_wire(v)
-                for sid, v in meta["results"].get(key, {}).items()
-            }
-            out.append(ModuleInfo(uid=key, servers=servers))
-        return out
+        raw = await self.get_records(model_uid, blocks)
+        return _records_to_infos(model_uid, blocks, raw)
+
+
+def _records_to_infos(
+    model_uid: str, blocks, raw: list[dict]
+) -> list[ModuleInfo]:
+    """Raw (value|tombstone, stored_at) maps -> ModuleInfo list."""
+    out = []
+    for i, sub in zip(blocks, raw):
+        servers = {
+            sid: ServerInfo.from_wire(v)
+            for sid, (v, _t) in sub.items()
+            if v is not None  # drop tombstones
+        }
+        out.append(ModuleInfo(uid=f"{model_uid}.{i}", servers=servers))
+    return out
+
+
+class ReplicatedRegistry:
+    """N-replica registry client: announce everywhere, read anywhere.
+
+    The reference's hivemind DHT replicates records across peers; a single
+    TCP registry is a point of failure. This client restores the
+    availability story without a DHT: servers declare to EVERY replica each
+    announce period, reads race all replicas and merge whatever answered by
+    first-success + a short grace window (a wedged replica costs `read_grace`,
+    not `timeout`), and any operation succeeds as long as ONE replica is
+    reachable. Merging is latest-write-wins per (block, server) using each
+    record's stored_at, and deletes are tombstones — a replica that missed a
+    revoke cannot resurrect the dead server in the merged view. A replica
+    that restarts repopulates from its disk snapshot plus the next announce
+    wave, so no cross-registry gossip protocol is needed.
+    """
+
+    def __init__(
+        self,
+        replicas: list[RegistryClient],
+        timeout: float = 5.0,
+        read_grace: float = 0.25,
+    ):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        self.replicas = list(replicas)
+        self.timeout = timeout
+        self.read_grace = read_grace
+
+    async def _fanout(self, op_name: str, coros: list, read: bool = False):
+        """Run one op against every replica; return per-replica results
+        (exceptions included). Raises only if ALL replicas fail.
+
+        Writes wait for every replica (bounded by `timeout` each — they must
+        land broadly). Reads return at first success + `read_grace`: healthy
+        replicas answer within the grace window; a wedged one is abandoned.
+        """
+        tasks = [
+            asyncio.ensure_future(asyncio.wait_for(c, self.timeout))
+            for c in coros
+        ]
+        if not read:
+            results = await asyncio.gather(*tasks, return_exceptions=True)
+        else:
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + self.timeout
+            success_at = None
+            pending = set(tasks)
+            while pending:
+                now = loop.time()
+                budget = deadline - now
+                if success_at is not None:
+                    budget = min(budget, success_at + self.read_grace - now)
+                if budget <= 0:
+                    break
+                done, pending = await asyncio.wait(
+                    pending, timeout=budget,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if success_at is None and any(
+                    not t.cancelled() and t.exception() is None for t in done
+                ):
+                    success_at = loop.time()
+            for t in pending:
+                t.cancel()
+            results = [
+                (TimeoutError("abandoned slow replica") if t in pending
+                 else (t.exception() or t.result()))
+                for t in tasks
+            ]
+        if all(isinstance(r, BaseException) for r in results):
+            raise RuntimeError(
+                f"registry {op_name} failed on all "
+                f"{len(self.replicas)} replicas: {results[0]!r}"
+            )
+        return results
+
+    async def declare_blocks(self, model_uid, server_id, blocks, info,
+                             expiration: float = 30.0) -> None:
+        await self._fanout(
+            "declare",
+            [
+                r.declare_blocks(model_uid, server_id, blocks, info,
+                                 expiration)
+                for r in self.replicas
+            ],
+        )
+
+    async def revoke_blocks(self, model_uid, server_id, blocks) -> None:
+        await self._fanout(
+            "revoke",
+            [r.revoke_blocks(model_uid, server_id, blocks)
+             for r in self.replicas],
+        )
+
+    async def get_module_infos(self, model_uid, blocks) -> list[ModuleInfo]:
+        results = await self._fanout(
+            "get",
+            [r.get_records(model_uid, blocks) for r in self.replicas],
+            read=True,
+        )
+        blocks = list(blocks)
+        # latest-write-wins per (block, server): tombstones carry stored_at
+        # like live records, so the newest fact (announce vs revoke) rules
+        merged: list[dict] = [{} for _ in blocks]
+        for res in results:
+            if isinstance(res, BaseException):
+                continue
+            for m, sub in zip(merged, res):
+                for sid, (v, t) in sub.items():
+                    if sid not in m or t > m[sid][1]:
+                        m[sid] = (v, t)
+        return _records_to_infos(model_uid, blocks, merged)
+
+    async def close(self):
+        await asyncio.gather(
+            *(r.close() for r in self.replicas), return_exceptions=True
+        )
+
+
+def make_registry(spec: str, timeout: float = 5.0):
+    """Build a registry client from 'host:port' or
+    'host:port,host:port,...' (replicated)."""
+    clients = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, port = part.rsplit(":", 1)
+        clients.append(RegistryClient(host, int(port)))
+    if not clients:
+        raise ValueError(f"no registry addresses in {spec!r}")
+    if len(clients) == 1:
+        return clients[0]
+    return ReplicatedRegistry(clients, timeout=timeout)
 
 
 class InProcessRegistry:
@@ -248,15 +433,8 @@ class InProcessRegistry:
             self._store.delete(f"{model_uid}.{i}", server_id)
 
     async def get_module_infos(self, model_uid, blocks):
-        out = []
-        for i in blocks:
-            key = f"{model_uid}.{i}"
-            servers = {
-                sid: ServerInfo.from_wire(v)
-                for sid, v in self._store.get(key).items()
-            }
-            out.append(ModuleInfo(uid=key, servers=servers))
-        return out
+        raw = [self._store.get(f"{model_uid}.{i}") for i in blocks]
+        return _records_to_infos(model_uid, blocks, raw)
 
     async def close(self):
         pass

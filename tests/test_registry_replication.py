@@ -1,0 +1,186 @@
+"""Replicated registry: announce everywhere, read anywhere, survive a
+replica failure (the availability half of the reference's hivemind DHT
+replication, utils/dht.py:28-117, without a gossip protocol)."""
+
+import asyncio
+
+import pytest
+
+from bloombee_tpu.swarm.data import ServerInfo
+from bloombee_tpu.swarm.registry import (
+    RegistryClient,
+    RegistryServer,
+    ReplicatedRegistry,
+    make_registry,
+)
+
+
+def make_info(port=1234):
+    return ServerInfo(host="127.0.0.1", port=port, throughput=1.0)
+
+
+def test_make_registry_parsing():
+    assert isinstance(make_registry("127.0.0.1:7700"), RegistryClient)
+    rep = make_registry("127.0.0.1:7700, 127.0.0.1:7701")
+    assert isinstance(rep, ReplicatedRegistry)
+    assert len(rep.replicas) == 2
+    with pytest.raises(ValueError):
+        make_registry("  ,  ")
+
+
+def test_declare_lands_on_every_replica():
+    async def run():
+        regs = [RegistryServer(host="127.0.0.1") for _ in range(2)]
+        for r in regs:
+            await r.start()
+        rep = make_registry(
+            ",".join(f"127.0.0.1:{r.port}" for r in regs)
+        )
+        await rep.declare_blocks("m", "srv-a", range(0, 4), make_info())
+        # each replica independently knows the full record set
+        for r in regs:
+            solo = RegistryClient("127.0.0.1", r.port)
+            infos = await solo.get_module_infos("m", range(0, 4))
+            assert all("srv-a" in m.servers for m in infos)
+            await solo.close()
+        await rep.close()
+        for r in regs:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_survives_replica_failure():
+    """One replica dies: declare/get still work through the other, and the
+    calls stay time-bounded instead of hanging on the dead peer."""
+
+    async def run():
+        regs = [RegistryServer(host="127.0.0.1") for _ in range(2)]
+        for r in regs:
+            await r.start()
+        rep = ReplicatedRegistry(
+            [RegistryClient("127.0.0.1", r.port) for r in regs],
+            timeout=3.0,
+        )
+        await rep.declare_blocks("m", "srv-a", range(0, 2), make_info())
+        await regs[0].stop()  # kill the first replica
+
+        t0 = asyncio.get_event_loop().time()
+        await rep.declare_blocks("m", "srv-b", range(2, 4), make_info(4321))
+        infos = await rep.get_module_infos("m", range(0, 4))
+        elapsed = asyncio.get_event_loop().time() - t0
+        assert elapsed < 10.0
+        assert all("srv-a" in m.servers for m in infos[:2])
+        assert all("srv-b" in m.servers for m in infos[2:])
+        await rep.close()
+        await regs[1].stop()
+
+    asyncio.run(run())
+
+
+def test_get_merges_skewed_replicas():
+    """Records present on only one replica (announce skew / replica restart)
+    still appear in the merged view."""
+
+    async def run():
+        regs = [RegistryServer(host="127.0.0.1") for _ in range(2)]
+        for r in regs:
+            await r.start()
+        solo = [RegistryClient("127.0.0.1", r.port) for r in regs]
+        await solo[0].declare_blocks("m", "srv-a", range(0, 2), make_info())
+        await solo[1].declare_blocks(
+            "m", "srv-b", range(0, 2), make_info(4321)
+        )
+        rep = ReplicatedRegistry(solo)
+        infos = await rep.get_module_infos("m", range(0, 2))
+        for m in infos:
+            assert set(m.servers) == {"srv-a", "srv-b"}
+        await rep.close()
+        for r in regs:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_revoke_tombstone_beats_missed_replica():
+    """A replica that missed the revoke (it was down) cannot resurrect the
+    dead server in the merged view: the surviving replica's tombstone is
+    newer than the stale live record (latest-write-wins)."""
+
+    async def run():
+        regs = [RegistryServer(host="127.0.0.1") for _ in range(2)]
+        for r in regs:
+            await r.start()
+        solo = [RegistryClient("127.0.0.1", r.port) for r in regs]
+        rep = ReplicatedRegistry(list(solo))
+        await rep.declare_blocks("m", "srv-a", range(0, 2), make_info())
+        await asyncio.sleep(0.02)  # the revoke must be strictly newer
+        # revoke lands ONLY on replica 0 (replica 1 "was down")
+        await solo[0].revoke_blocks("m", "srv-a", range(0, 2))
+        infos = await rep.get_module_infos("m", range(0, 2))
+        for m in infos:
+            assert "srv-a" not in m.servers, "revoked server resurrected"
+        # a RE-announce after the revoke wins again (newer than tombstone)
+        await asyncio.sleep(0.02)
+        await rep.declare_blocks("m", "srv-a", range(0, 2), make_info())
+        infos = await rep.get_module_infos("m", range(0, 2))
+        assert all("srv-a" in m.servers for m in infos)
+        await rep.close()
+        for r in regs:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_read_returns_fast_despite_wedged_replica():
+    """A replica that accepts connections but never answers must cost reads
+    ~read_grace, not the full timeout."""
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        # a "wedged" replica: accepts TCP, never replies
+        async def black_hole(reader, writer):
+            await asyncio.sleep(3600)
+
+        wedged = await asyncio.start_server(black_hole, "127.0.0.1", 0)
+        wedged_port = wedged.sockets[0].getsockname()[1]
+
+        rep = ReplicatedRegistry(
+            [
+                RegistryClient("127.0.0.1", reg.port),
+                RegistryClient("127.0.0.1", wedged_port),
+            ],
+            timeout=10.0,
+            read_grace=0.25,
+        )
+        solo = RegistryClient("127.0.0.1", reg.port)
+        await solo.declare_blocks("m", "srv-a", range(0, 2), make_info())
+        t0 = asyncio.get_event_loop().time()
+        infos = await rep.get_module_infos("m", range(0, 2))
+        elapsed = asyncio.get_event_loop().time() - t0
+        assert all("srv-a" in m.servers for m in infos)
+        assert elapsed < 2.0, f"read stalled {elapsed:.2f}s on wedged replica"
+        await rep.close()
+        await solo.close()
+        wedged.close()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_all_replicas_down_raises():
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        port = reg.port
+        await reg.stop()
+        rep = ReplicatedRegistry(
+            [RegistryClient("127.0.0.1", port)], timeout=2.0
+        )
+        with pytest.raises(RuntimeError, match="all 1 replicas"):
+            await rep.get_module_infos("m", range(0, 2))
+        await rep.close()
+
+    asyncio.run(run())
