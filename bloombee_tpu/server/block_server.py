@@ -256,7 +256,11 @@ class BlockServer:
         if self.registry is not None:
             try:
                 await self.registry.revoke_blocks(
-                    self.model_uid, self.server_id, range(self.start_block, self.end_block)
+                    self.model_uid, self.server_id,
+                    range(self.start_block, self.end_block),
+                    # the tombstone must outlive any replica's stale copy of
+                    # our announce (expiration = announce_period * 2.5)
+                    expiration=max(60.0, self.announce_period * 2.5 + 10.0),
                 )
             except Exception:
                 pass

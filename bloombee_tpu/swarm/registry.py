@@ -36,9 +36,16 @@ class _Store:
             str, dict[str, tuple[dict | None, float, float]]
         ] = {}
 
-    def store(self, key: str, subkey: str, value: dict, expiration: float):
+    def store(
+        self, key: str, subkey: str, value: dict, expiration: float,
+        stored_at: float | None = None,
+    ):
+        # stored_at is stamped by the WRITER (server/client), not this
+        # replica's clock: one actor's clock then orders its own
+        # announce/revoke sequence identically on every replica, so the
+        # replicated merge is immune to cross-replica clock skew
         self._data.setdefault(key, {})[subkey] = (
-            value, expiration, time.time(),
+            value, expiration, time.time() if stored_at is None else stored_at,
         )
 
     # --------------------------------------------------------- persistence
@@ -79,10 +86,15 @@ class _Store:
             del sub[sk]
         return out
 
-    def delete(self, key: str, subkey: str):
+    def delete(
+        self, key: str, subkey: str, ttl: float | None = None,
+        stored_at: float | None = None,
+    ):
         now = time.time()
         self._data.setdefault(key, {})[subkey] = (
-            None, now + self.TOMBSTONE_TTL, now,
+            None,
+            now + (self.TOMBSTONE_TTL if ttl is None else ttl),
+            now if stored_at is None else stored_at,
         )
 
 
@@ -166,6 +178,7 @@ class RegistryServer:
             self._store.store(
                 rec["key"], rec["subkey"], rec["value"],
                 now + rec["expiration"],
+                stored_at=rec.get("stored_at"),
             )
         return {"ok": True}, []
 
@@ -184,7 +197,10 @@ class RegistryServer:
 
     async def _rpc_delete(self, meta: dict, tensors):
         for rec in meta["records"]:
-            self._store.delete(rec["key"], rec["subkey"])
+            self._store.delete(
+                rec["key"], rec["subkey"], ttl=rec.get("ttl"),
+                stored_at=rec.get("stored_at"),
+            )
         return {"ok": True}, []
 
 
@@ -218,23 +234,36 @@ class RegistryClient:
     ) -> None:
         """reference: declare_active_modules (utils/dht.py:28-73)."""
         conn = await self._connection()
+        now = time.time()
         records = [
             {
                 "key": f"{model_uid}.{i}",
                 "subkey": server_id,
                 "value": info.to_wire(),
                 "expiration": expiration,
+                "stored_at": now,  # writer's clock orders announce vs revoke
             }
             for i in blocks
         ]
         await conn.call("registry_store", {"records": records})
 
     async def revoke_blocks(
-        self, model_uid: str, server_id: str, blocks: range
+        self, model_uid: str, server_id: str, blocks: range,
+        expiration: float = 60.0,
     ) -> None:
+        """`expiration` must be >= the announce expiration so the tombstone
+        outlives any stale live record on a replica that missed the
+        delete."""
         conn = await self._connection()
+        now = time.time()
         records = [
-            {"key": f"{model_uid}.{i}", "subkey": server_id} for i in blocks
+            {
+                "key": f"{model_uid}.{i}",
+                "subkey": server_id,
+                "ttl": expiration,
+                "stored_at": now,
+            }
+            for i in blocks
         ]
         await conn.call("registry_delete", {"records": records})
 
@@ -365,10 +394,12 @@ class ReplicatedRegistry:
             ],
         )
 
-    async def revoke_blocks(self, model_uid, server_id, blocks) -> None:
+    async def revoke_blocks(self, model_uid, server_id, blocks,
+                            expiration: float = 60.0) -> None:
         await self._fanout(
             "revoke",
-            [r.revoke_blocks(model_uid, server_id, blocks)
+            [r.revoke_blocks(model_uid, server_id, blocks,
+                             expiration=expiration)
              for r in self.replicas],
         )
 
@@ -428,9 +459,12 @@ class InProcessRegistry:
                 f"{model_uid}.{i}", server_id, info.to_wire(), now + expiration
             )
 
-    async def revoke_blocks(self, model_uid, server_id, blocks):
+    async def revoke_blocks(self, model_uid, server_id, blocks,
+                            expiration: float = 60.0):
         for i in blocks:
-            self._store.delete(f"{model_uid}.{i}", server_id)
+            self._store.delete(
+                f"{model_uid}.{i}", server_id, ttl=expiration
+            )
 
     async def get_module_infos(self, model_uid, blocks):
         raw = [self._store.get(f"{model_uid}.{i}") for i in blocks]

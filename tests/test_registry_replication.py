@@ -170,6 +170,38 @@ def test_read_returns_fast_despite_wedged_replica():
     asyncio.run(run())
 
 
+def test_records_carry_writer_stamps_not_replica_clocks():
+    """stored_at comes from the WRITER, so every replica holds the same
+    stamp for the same write — replica clock skew cannot flip the
+    announce-vs-revoke ordering in a merged read (one writer's clock
+    orders its own sequence)."""
+
+    async def run():
+        regs = [RegistryServer(host="127.0.0.1") for _ in range(2)]
+        for r in regs:
+            await r.start()
+        solo = [RegistryClient("127.0.0.1", r.port) for r in regs]
+        # same declare call, one writer stamp, both replicas
+        now_rec = {"key": "m.0", "subkey": "srv-a",
+                   "value": make_info().to_wire(),
+                   "expiration": 30.0, "stored_at": 1234.5}
+        for s in solo:
+            conn = await s._connection()
+            await conn.call("registry_store", {"records": [now_rec]})
+        t0 = regs[0]._store._data["m.0"]["srv-a"][2]
+        t1 = regs[1]._store._data["m.0"]["srv-a"][2]
+        assert t0 == t1 == 1234.5  # replica receive clocks never used
+        await rep_cleanup(regs, solo)
+
+    async def rep_cleanup(regs, solo):
+        for s in solo:
+            await s.close()
+        for r in regs:
+            await r.stop()
+
+    asyncio.run(run())
+
+
 def test_all_replicas_down_raises():
     async def run():
         reg = RegistryServer(host="127.0.0.1")
