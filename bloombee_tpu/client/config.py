@@ -27,3 +27,7 @@ class ClientConfig:
     # server filters (reference allowed_servers / blocked_servers)
     allowed_servers: list[str] | None = None
     blocked_servers: list[str] | None = None
+    # vocab-chunked LM head for low-RAM client hosts (reference
+    # LMHead.chunked_forward, client/lm_head.py:50-76)
+    use_chunked_head: bool = False
+    chunked_head_step: int = 16384

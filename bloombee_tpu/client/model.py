@@ -61,6 +61,47 @@ def _norm_head(
     return logits
 
 
+@functools.partial(
+    jax.jit, static_argnames=("eps", "soft_cap", "norm_type", "step")
+)
+def _norm_head_chunked(
+    params, hidden, eps: float, soft_cap: float = 0.0,
+    norm_type: str = "rms", step: int = 16384,
+):
+    """Vocab-chunked head: the matmul runs `step` vocab columns at a time
+    (lax.map keeps one chunk's intermediates live), bounding transient
+    memory on weak client hosts — the role of the reference's
+    LMHead.chunked_forward (client/lm_head.py:50-76, 16384-column steps
+    for low-RAM / non-AVX512 CPUs)."""
+    if norm_type == "ln":
+        h = layer_norm(hidden, params["norm"], params.get("norm_bias"), eps)
+    else:
+        h = rms_norm(hidden, params["norm"], eps)
+    w = params["lm_head"]  # [D, V]
+    v = w.shape[1]
+    step = min(step, v)
+    n = -(-v // step)
+    # slice the ORIGINAL weight per iteration (no padded/transposed copy —
+    # peak transient memory is one [D, step] slice + one [B, T, step]
+    # product on top of the full logits output). dynamic_slice clamps the
+    # ragged last start to v - step, so read and write overlap identically
+    # and the overlap rows are simply rewritten with equal values.
+    out = jnp.zeros((*h.shape[:-1], v), jnp.float32)
+
+    def body(i, out):
+        start = jnp.minimum(i * step, v - step)
+        wi = jax.lax.dynamic_slice_in_dim(w, start, step, axis=1)
+        li = (h @ wi).astype(jnp.float32)
+        return jax.lax.dynamic_update_slice_in_dim(
+            out, li, start, axis=out.ndim - 1
+        )
+
+    logits = jax.lax.fori_loop(0, n, body, out)
+    if soft_cap:
+        logits = jnp.tanh(logits / soft_cap) * soft_cap
+    return logits
+
+
 class DistributedModelForCausalLM:
     """Client-side model: local embed/norm/head + remote block chain."""
 
@@ -135,6 +176,17 @@ class DistributedModelForCausalLM:
         return np.asarray(h, dtype=np.float32)
 
     def logits(self, hidden: np.ndarray) -> np.ndarray:
+        if self.config.use_chunked_head:
+            return np.asarray(
+                _norm_head_chunked(
+                    self.params,
+                    jnp.asarray(hidden),
+                    eps=self.spec.rms_norm_eps,
+                    soft_cap=self.spec.logits_soft_cap,
+                    norm_type=self.spec.norm_type,
+                    step=self.config.chunked_head_step,
+                )
+            )
         return np.asarray(
             _norm_head(
                 self.params,

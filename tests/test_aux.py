@@ -67,3 +67,28 @@ def test_cli_help_smoke():
         )
         assert out.returncode == 0, out.stderr
         assert "usage" in out.stdout.lower()
+
+
+def test_chunked_head_matches_full():
+    """Vocab-chunked LM head (low-RAM client path) is numerically identical
+    to the one-shot head, including ragged last chunks and soft-capping."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bloombee_tpu.client.model import _norm_head, _norm_head_chunked
+
+    rng = np.random.default_rng(0)
+    d, v = 32, 1000  # v deliberately not a multiple of step
+    params = {
+        "norm": jnp.asarray(rng.normal(size=(d,)).astype(np.float32)),
+        "lm_head": jnp.asarray(rng.normal(size=(d, v)).astype(np.float32)),
+    }
+    hidden = jnp.asarray(rng.normal(size=(2, 3, d)).astype(np.float32))
+    for soft_cap in (0.0, 30.0):
+        full = _norm_head(params, hidden, eps=1e-5, soft_cap=soft_cap)
+        chunked = _norm_head_chunked(
+            params, hidden, eps=1e-5, soft_cap=soft_cap, step=256
+        )
+        np.testing.assert_allclose(
+            np.asarray(chunked), np.asarray(full), rtol=1e-6, atol=1e-6
+        )
