@@ -1,0 +1,163 @@
+"""Paged decode attention (Pallas TPU kernel).
+
+The dense decode path gathers every page of context into a contiguous
+[B, S, Hkv, hd] buffer each step (kv/arena.py gather_pages) and then runs
+masked attention over it — two full passes over the context's HBM bytes per
+step. This kernel instead streams K/V pages straight out of the paged arena
+(one pass): the page table rides in as a scalar-prefetch operand and steers
+each grid step's K/V BlockSpec index map to the right physical page, with
+online-softmax stats carried in VMEM scratch across the page dimension.
+Covers the decode-attention role of the reference's fused kernels
+(/root/reference/src/bloombee/flexgen_utils/pytorch_backend.py:733
+`mha_gen_llama`), built vLLM-paged-attention-style for the TPU memory
+hierarchy.
+
+Scope: single-token decode (T=1), uniform standard causal semantics —
+per-sequence lengths may differ (masked per page), but tree masks, sliding
+windows, ALiBi, logit soft-caps, and quantized arenas take the dense path
+(the executor checks eligibility host-side, like the flash prefill kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e30
+
+
+def _kernel(
+    pt_ref,  # [B, NP] i32 scalar prefetch: logical page j of seq b
+    lens_ref,  # [B] i32 scalar prefetch: context length per sequence
+    q_ref,  # [G, hd] — the query heads of this kv head's group
+    k_ref,  # [page_size, hd] — current physical K page, this kv head
+    v_ref,  # [page_size, hd]
+    o_ref,  # [G, hd]
+    m_scr,  # [G, 1] f32
+    l_scr,  # [G, 1] f32
+    acc_scr,  # [G, hd] f32
+    *,
+    scale: float,
+    page_size: int,
+    n_pages: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = lens_ref[b]
+    # logical token positions covered by page j; garbage pages (page-table
+    # padding) land entirely past `length` and mask to nothing
+    pos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1
+    )
+    page_live = j * page_size < length
+
+    @pl.when(page_live)
+    def _update():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G, page_size]
+        mask = pos < length
+        logits = jnp.where(mask, logits, NEG)
+        m = m_scr[...]
+        m_new = jnp.maximum(m, logits.max(axis=1, keepdims=True))
+        p = jnp.exp(logits - m_new) * mask.astype(jnp.float32)
+        corr = jnp.exp(m - m_new)
+        l_scr[...] = l_scr[...] * corr + p.sum(axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        # fully-masked rows (zero-length padding sequences) divide by eps
+        # and emit zeros, which the executor drops with the pad rows
+        o_ref[...] = (
+            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "scale", "interpret"),
+)
+def paged_decode_attention(
+    q: jax.Array,  # [B, H, hd] — one decode token per sequence
+    k_slab: jax.Array,  # [S_tot, Hkv, hd] — the paged arena, one layer
+    v_slab: jax.Array,
+    page_table: jax.Array,  # [B, NP] i32 physical page ids (padding = 0)
+    lens: jax.Array,  # [B] i32 context lengths (incl. this token)
+    page_size: int,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:  # [B, H, hd]
+    b, h, hd = q.shape
+    s_tot, hkv = k_slab.shape[0], k_slab.shape[1]
+    if h % hkv:
+        raise ValueError(f"H={h} must be a multiple of Hkv={hkv}")
+    if s_tot % page_size:
+        raise ValueError(f"arena slots {s_tot} % page_size {page_size}")
+    g = h // hkv
+    n_pages = page_table.shape[1]
+    if scale is None:
+        scale = hd**-0.5
+
+    # query head i uses kv head i // g: group-major view [B, Hkv, G, hd]
+    qg = q.reshape(b, hkv, g, hd)
+    # arena as pages: [n_phys, page_size, Hkv, hd] (free reshape)
+    kp = k_slab.reshape(-1, page_size, hkv, hd)
+    vp = v_slab.reshape(-1, page_size, hkv, hd)
+
+    grid = (b, hkv, n_pages)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (None, None, g, hd),
+                lambda bi, hi, j, pt, ln: (bi, hi, 0, 0),
+            ),
+            pl.BlockSpec(
+                (None, page_size, None, hd),
+                lambda bi, hi, j, pt, ln: (pt[bi, j], 0, hi, 0),
+            ),
+            pl.BlockSpec(
+                (None, page_size, None, hd),
+                lambda bi, hi, j, pt, ln: (pt[bi, j], 0, hi, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, None, g, hd), lambda bi, hi, j, pt, ln: (bi, hi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, page_size=page_size, n_pages=n_pages
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, hd), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lens.astype(jnp.int32), qg, kp, vp)
+    return out.reshape(b, h, hd)

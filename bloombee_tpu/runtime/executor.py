@@ -31,6 +31,17 @@ env.declare(
     "use the Pallas flash kernel for eligible long prefill steps (T>=128, "
     "causal, uniform context lengths, no tree/window/alibi/softcap)",
 )
+env.declare(
+    "BBTPU_PAGED_ATTENTION", bool, True,
+    "use the Pallas paged-attention kernel for eligible single-token decode "
+    "steps (T=1, dense arena, no tree/window/alibi/softcap); TPU backend "
+    "only unless BBTPU_PAGED_INTERPRET forces the interpreter (tests)",
+)
+env.declare(
+    "BBTPU_PAGED_INTERPRET", bool, False,
+    "run the paged decode kernel in interpreter mode on non-TPU backends "
+    "(CPU parity tests; far too slow for production)",
+)
 
 
 def next_pow2(n: int, floor: int = 1) -> int:
@@ -198,6 +209,25 @@ class SpanExecutor:
             tm_pad = np.zeros((bb, tb, tb), dtype=bool)
             tm_pad[:b, :t, :t] = tree_mask
 
+        # paged-kernel eligibility: plain single-token decode on a dense
+        # arena (per-seq lens may differ — masked per page in-kernel)
+        use_paged = bool(
+            not getattr(self, "_paged_broken", False)
+            and self.mesh is None  # Pallas kernels don't GSPMD-partition
+            and not self.spec.heterogeneous
+            and self.manager.quant is None
+            and tree_mask is None
+            and tb == 1
+            and not self.spec.alibi
+            and not self.spec.attn_logit_softcap
+            and all(w == 0 for w in self.windows)
+            and env.get("BBTPU_PAGED_ATTENTION")
+            and (
+                jax.default_backend() == "tpu"
+                or env.get("BBTPU_PAGED_INTERPRET")
+            )
+        )
+
         # flash eligibility: the Pallas kernel's causal-offset mask encodes
         # exactly "uniform start, uniform length, no extra masking"
         s_ctx = pb * self.page_size
@@ -253,21 +283,47 @@ class SpanExecutor:
                 tm_dev = (
                     jnp.asarray(tm_pad) if tm_pad is not None else None
                 )
-            out, new_k, new_v = span_step_packed(
-                self.params,
-                arena["k"],
-                arena["v"],
-                payload_dev,
-                tm_dev,
-                spec=spec,
-                b=bb,
-                t=tb,
-                page_size=self.page_size,
-                max_pages=pb,
-                use_tree_mask=tree_mask is not None,
-                windows=self.windows,
-                use_flash=use_flash,
-            )
+            def _run(use_paged_now: bool):
+                return span_step_packed(
+                    self.params,
+                    arena["k"],
+                    arena["v"],
+                    payload_dev,
+                    tm_dev,
+                    spec=spec,
+                    b=bb,
+                    t=tb,
+                    page_size=self.page_size,
+                    max_pages=pb,
+                    use_tree_mask=tree_mask is not None,
+                    windows=self.windows,
+                    use_flash=use_flash,
+                    use_paged=use_paged_now,
+                )
+
+            try:
+                out, new_k, new_v = _run(use_paged)
+            except Exception:
+                # Only the paged-kernel path self-heals, and only when the
+                # donated arena buffers are still alive (a compile failure
+                # surfaces at call time BEFORE donation consumes them; if a
+                # runtime failure already ate the arena, retrying would
+                # compute on deleted buffers — re-raise the real error).
+                if not use_paged or any(
+                    getattr(a, "is_deleted", lambda: False)()
+                    for a in (arena["k"], arena["v"])
+                ):
+                    raise
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "paged decode kernel failed; retrying on the dense "
+                    "gather path"
+                )
+                out, new_k, new_v = _run(False)
+                # the dense path works while paged does not -> the kernel
+                # itself is broken on this backend; stop trying it
+                self._paged_broken = True
         self.manager.arena = {"k": new_k, "v": new_v}
         out = out[:b, :t]
         if not fetch:

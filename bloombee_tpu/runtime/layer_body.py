@@ -145,6 +145,7 @@ def layer_body(
     tree_mask: jax.Array | None,
     window,  # traced scalar
     use_flash: bool = False,  # static: executor's shape heuristic said yes
+    use_paged: bool = False,  # static: T=1 decode via the paged kernel
 ):
     b, t, d = hidden.shape
     h_heads, kv_heads, hd = (
@@ -171,6 +172,24 @@ def layer_body(
         k_slab, v_slab, slots,
         k.reshape(b * t, kv_heads, hd), v.reshape(b * t, kv_heads, hd),
     )
+    if use_paged:
+        # single-token decode: the Pallas kernel streams K/V pages straight
+        # from the arena (page table as scalar prefetch) — no gathered
+        # [B, S, Hkv, hd] context buffer in HBM at all. Eligibility (T==1,
+        # no tree/window/alibi/softcap, dense arena) was checked host-side.
+        from bloombee_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention,
+        )
+
+        attn = paged_decode_attention(
+            q[:, 0], k_slab, v_slab, page_table, total_lens,
+            page_size=page_size, scale=attn_scale(spec),
+            # Mosaic only exists on TPU; any other backend that reaches
+            # here (executor: BBTPU_PAGED_INTERPRET) runs the interpreter
+            interpret=jax.default_backend() != "tpu",
+        )[:, None]  # [B, 1, H, hd]
+        attn_out = _proj(attn.reshape(b, t, h_heads * hd), params, "o_proj")
+        return _finish_layer(spec, params, hidden, x, attn_out, k_slab, v_slab)
     k_ctx = gather_pages(k_slab, page_table, page_size).astype(hidden.dtype)
     v_ctx = gather_pages(v_slab, page_table, page_size).astype(hidden.dtype)
 
@@ -185,14 +204,18 @@ def layer_body(
         attn = flash_attention(
             q, k_ctx, v_ctx, causal=True, scale=attn_scale(spec),
             offset=q_positions[0, 0],
-            interpret=jax.default_backend() == "cpu",
+            interpret=jax.default_backend() != "tpu",
         )
     else:
         attn = attend_paged(
             spec, q, k_ctx, v_ctx, q_positions, total_lens, tree_mask, window
         )
     attn_out = _proj(attn.reshape(b, t, h_heads * hd), params, "o_proj")
+    return _finish_layer(spec, params, hidden, x, attn_out, k_slab, v_slab)
 
+
+def _finish_layer(spec, params, hidden, x, attn_out, k_slab, v_slab):
+    """Residual + MLP tail shared by the dense/flash/paged attention paths."""
     if spec.parallel_attn:
         # falcon: parallel residual. 7b shares one input norm for attention
         # AND the MLP; 40b/180b new-arch uses two (ln_attn already fed the

@@ -110,6 +110,7 @@ def span_step_packed_impl(
     use_tree_mask: bool = False,
     windows: tuple | None = None,
     use_flash: bool = False,
+    use_paged: bool = False,
 ):
     """span_step over a pack_step_payload buffer (one h2d per step)."""
     hidden, plan = unpack_step_payload(payload, b, t, spec.hidden_size)
@@ -117,6 +118,7 @@ def span_step_packed_impl(
         stacked_params, arena_k, arena_v, hidden, plan, tree_mask,
         spec=spec, page_size=page_size, max_pages=max_pages,
         use_tree_mask=use_tree_mask, windows=windows, use_flash=use_flash,
+        use_paged=use_paged,
     )
 
 
@@ -124,7 +126,7 @@ span_step_packed = functools.partial(
     jax.jit,
     static_argnames=(
         "spec", "b", "t", "page_size", "max_pages", "use_tree_mask",
-        "windows", "use_flash",
+        "windows", "use_flash", "use_paged",
     ),
     donate_argnames=("arena_k", "arena_v"),
 )(span_step_packed_impl)
@@ -145,6 +147,7 @@ def span_step_impl(
     use_tree_mask: bool = False,
     windows: tuple | None = None,
     use_flash: bool = False,
+    use_paged: bool = False,
 ):
     """Run all local blocks over one step; returns (hidden, arena_k, arena_v).
 
@@ -199,7 +202,7 @@ def span_step_impl(
             return layer_body(
                 spec, page_size, h, params_l, k_l, v_l, cos_l, sin_l, slots,
                 page_table, q_positions, total_lens, tm, window_l,
-                use_flash=use_flash,
+                use_flash=use_flash, use_paged=use_paged,
             )
 
         def skip(h, k_l, v_l):
@@ -216,7 +219,7 @@ span_step = functools.partial(
     jax.jit,
     static_argnames=(
         "spec", "page_size", "max_pages", "use_tree_mask", "windows",
-        "use_flash",
+        "use_flash", "use_paged",
     ),
     donate_argnames=("arena_k", "arena_v"),
 )(span_step_impl)
