@@ -12,9 +12,11 @@ Covers the decode-attention role of the reference's fused kernels
 `mha_gen_llama`), built vLLM-paged-attention-style for the TPU memory
 hierarchy.
 
-Scope: single-token decode (T=1), uniform standard causal semantics —
-per-sequence lengths may differ (masked per page), but tree masks, sliding
-windows, ALiBi, logit soft-caps, and quantized arenas take the dense path
+Scope: single-token decode (T=1) with standard causal semantics —
+per-sequence lengths may differ (masked per page), and sliding windows are
+supported (the per-layer window arrives as a traced scalar; pages wholly
+below the window are skipped, DMA included, via an index-map clamp). Tree
+masks, ALiBi, logit soft-caps, and quantized arenas take the dense path
 (the executor checks eligibility host-side, like the flash prefill kernel).
 """
 
@@ -34,6 +36,7 @@ NEG = -1e30
 def _kernel(
     pt_ref,  # [B, NP] i32 scalar prefetch: logical page j of seq b
     lens_ref,  # [B] i32 scalar prefetch: context length per sequence
+    win_ref,  # [1] i32 scalar prefetch: sliding window (0 = full attention)
     q_ref,  # [G, hd] — the query heads of this kv head's group
     k_ref,  # [page_size, hd] — current physical K page, this kv head
     v_ref,  # [page_size, hd]
@@ -56,12 +59,19 @@ def _kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     length = lens_ref[b]
+    win = win_ref[0]
+    # sliding window: the decode query sits at position length-1 and sees
+    # keys in [length - win, length) (matching attend_paged's
+    # `key_pos > q_pos - window`); win == 0 means full attention. Pages
+    # wholly below the window are skipped outright — for long contexts
+    # that is most of them, which is the point of a sliding window.
+    low = jnp.where(win > 0, jnp.maximum(length - win, 0), 0)
     # logical token positions covered by page j; garbage pages (page-table
     # padding) land entirely past `length` and mask to nothing
     pos = j * page_size + jax.lax.broadcasted_iota(
         jnp.int32, (1, page_size), 1
     )
-    page_live = j * page_size < length
+    page_live = (j * page_size < length) & ((j + 1) * page_size > low)
 
     @pl.when(page_live)
     def _update():
@@ -72,7 +82,7 @@ def _kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [G, page_size]
-        mask = pos < length
+        mask = (pos < length) & (pos >= low)
         logits = jnp.where(mask, logits, NEG)
         m = m_scr[...]
         m_new = jnp.maximum(m, logits.max(axis=1, keepdims=True))
@@ -107,6 +117,7 @@ def paged_decode_attention(
     page_size: int,
     scale: float | None = None,
     interpret: bool = False,
+    window=0,  # traced i32 scalar; 0 = full attention (per-layer in scan)
 ) -> jax.Array:  # [B, H, hd]
     b, h, hd = q.shape
     s_tot, hkv = k_slab.shape[0], k_slab.shape[1]
@@ -125,26 +136,32 @@ def paged_decode_attention(
     kp = k_slab.reshape(-1, page_size, hkv, hd)
     vp = v_slab.reshape(-1, page_size, hkv, hd)
 
+    def kv_index(bi, hi, j, pt, ln, wn):
+        # out-of-window grid steps must not cost HBM bandwidth: clamp the
+        # logical page to the first in-window page, so dead steps re-name
+        # the same block and Pallas elides the duplicate DMA entirely
+        # (their compute is skipped by pl.when(page_live) in the kernel)
+        first = jnp.where(
+            wn[0] > 0,
+            jnp.maximum(ln[bi] - wn[0], 0) // page_size,
+            0,
+        )
+        return (pt[bi, jnp.maximum(j, first)], 0, hi, 0)
+
     grid = (b, hkv, n_pages)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=grid,
         in_specs=[
             pl.BlockSpec(
                 (None, None, g, hd),
-                lambda bi, hi, j, pt, ln: (bi, hi, 0, 0),
+                lambda bi, hi, j, pt, ln, wn: (bi, hi, 0, 0),
             ),
-            pl.BlockSpec(
-                (None, page_size, None, hd),
-                lambda bi, hi, j, pt, ln: (pt[bi, j], 0, hi, 0),
-            ),
-            pl.BlockSpec(
-                (None, page_size, None, hd),
-                lambda bi, hi, j, pt, ln: (pt[bi, j], 0, hi, 0),
-            ),
+            pl.BlockSpec((None, page_size, None, hd), kv_index),
+            pl.BlockSpec((None, page_size, None, hd), kv_index),
         ],
         out_specs=pl.BlockSpec(
-            (None, None, g, hd), lambda bi, hi, j, pt, ln: (bi, hi, 0, 0)
+            (None, None, g, hd), lambda bi, hi, j, pt, ln, wn: (bi, hi, 0, 0)
         ),
         scratch_shapes=[
             pltpu.VMEM((g, 1), jnp.float32),
@@ -152,6 +169,7 @@ def paged_decode_attention(
             pltpu.VMEM((g, hd), jnp.float32),
         ],
     )
+    win_arr = jnp.asarray(window, jnp.int32).reshape(1)
     out = pl.pallas_call(
         functools.partial(
             _kernel, scale=scale, page_size=page_size, n_pages=n_pages
@@ -159,5 +177,8 @@ def paged_decode_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, g, hd), q.dtype),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), lens.astype(jnp.int32), qg, kp, vp)
+    )(
+        page_table.astype(jnp.int32), lens.astype(jnp.int32), win_arr,
+        qg, kp, vp,
+    )
     return out.reshape(b, h, hd)

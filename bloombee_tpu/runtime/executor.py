@@ -42,6 +42,11 @@ env.declare(
     "run the paged decode kernel in interpreter mode on non-TPU backends "
     "(CPU parity tests; far too slow for production)",
 )
+env.declare(
+    "BBTPU_FLASH_INTERPRET", bool, False,
+    "run the flash prefill kernel in interpreter mode on non-TPU backends "
+    "(CPU parity tests; far too slow for production)",
+)
 
 
 def next_pow2(n: int, floor: int = 1) -> int:
@@ -210,7 +215,9 @@ class SpanExecutor:
             tm_pad[:b, :t, :t] = tree_mask
 
         # paged-kernel eligibility: plain single-token decode on a dense
-        # arena (per-seq lens may differ — masked per page in-kernel)
+        # arena (per-seq lens may differ — masked in-kernel, and sliding
+        # windows ride the scan as a traced scalar, skipping out-of-window
+        # pages outright)
         use_paged = bool(
             not getattr(self, "_paged_broken", False)
             and self.mesh is None  # Pallas kernels don't GSPMD-partition
@@ -220,7 +227,6 @@ class SpanExecutor:
             and tb == 1
             and not self.spec.alibi
             and not self.spec.attn_logit_softcap
-            and all(w == 0 for w in self.windows)
             and env.get("BBTPU_PAGED_ATTENTION")
             and (
                 jax.default_backend() == "tpu"
@@ -246,6 +252,10 @@ class SpanExecutor:
             and np.all(total_lens == total_lens[0])
             and int(total_lens[0]) == int(starts[0]) + t
             and env.get("BBTPU_FLASH_ATTENTION")
+            and (
+                jax.default_backend() == "tpu"
+                or env.get("BBTPU_FLASH_INTERPRET")
+            )
         )
 
         arena = self.manager.arena

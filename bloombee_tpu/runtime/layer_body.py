@@ -176,7 +176,8 @@ def layer_body(
         # single-token decode: the Pallas kernel streams K/V pages straight
         # from the arena (page table as scalar prefetch) — no gathered
         # [B, S, Hkv, hd] context buffer in HBM at all. Eligibility (T==1,
-        # no tree/window/alibi/softcap, dense arena) was checked host-side.
+        # no tree/alibi/softcap, dense arena) was checked host-side;
+        # sliding windows are handled in-kernel (per-layer traced scalar).
         from bloombee_tpu.ops.pallas.paged_attention import (
             paged_decode_attention,
         )
@@ -187,6 +188,7 @@ def layer_body(
             # Mosaic only exists on TPU; any other backend that reaches
             # here (executor: BBTPU_PAGED_INTERPRET) runs the interpreter
             interpret=jax.default_backend() != "tpu",
+            window=window,  # per-layer traced scalar (0 = full)
         )[:, None]  # [B, 1, H, hd]
         attn_out = _proj(attn.reshape(b, t, h_heads * hd), params, "o_proj")
         return _finish_layer(spec, params, hidden, x, attn_out, k_slab, v_slab)

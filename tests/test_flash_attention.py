@@ -101,6 +101,7 @@ def test_span_prefill_flash_matches_dense():
 
     async def run_one(flag):
         os.environ["BBTPU_FLASH_ATTENTION"] = flag
+        os.environ["BBTPU_FLASH_INTERPRET"] = "1"  # non-TPU backend gate
         try:
             manager = CacheManager(
                 num_layers=2, num_pages=64, page_size=16,
@@ -112,6 +113,7 @@ def test_span_prefill_flash_matches_dense():
                 return ex.prefill(handle, hidden)
         finally:
             del os.environ["BBTPU_FLASH_ATTENTION"]
+            del os.environ["BBTPU_FLASH_INTERPRET"]
 
     out_flash = asyncio.run(run_one("1"))
     out_dense = asyncio.run(run_one("0"))

@@ -8,8 +8,9 @@ import jax.numpy as jnp
 from bloombee_tpu.ops.pallas.paged_attention import paged_decode_attention
 
 
-def dense_reference(q, k_slab, v_slab, page_table, lens, page_size):
-    """Gather pages then masked softmax — the exact dense-path semantics."""
+def dense_reference(q, k_slab, v_slab, page_table, lens, page_size, window=0):
+    """Gather pages then masked softmax — the exact dense-path semantics
+    (incl. attend_paged's sliding window: key visible iff pos > q_pos - w)."""
     b, h, hd = q.shape
     hkv = k_slab.shape[1]
     g = h // hkv
@@ -24,6 +25,8 @@ def dense_reference(q, k_slab, v_slab, page_table, lens, page_size):
         v = v_slab[np.asarray(slots)]
         s = k.shape[0]
         mask = np.arange(s) < lens[i]
+        if window > 0:
+            mask &= np.arange(s) > (lens[i] - 1) - window
         row = []
         for head in range(h):
             kv = head // g
@@ -62,6 +65,36 @@ def test_paged_decode_matches_dense(hkv, h):
         )
     )
     want = dense_reference(q, k_slab, v_slab, page_table, lens, page_size)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [5, 16, 40])
+def test_paged_decode_sliding_window(window):
+    """Sliding window masks to [len-w, len) and must match attend_paged's
+    semantics; pages wholly below the window are skipped in-kernel."""
+    rng = np.random.default_rng(3)
+    b, h, hkv, hd, page_size = 2, 4, 2, 64, 16
+    n_phys = 10
+    q = rng.standard_normal((b, h, hd)).astype(np.float32)
+    k_slab = rng.standard_normal(
+        (n_phys * page_size, hkv, hd)
+    ).astype(np.float32)
+    v_slab = rng.standard_normal(
+        (n_phys * page_size, hkv, hd)
+    ).astype(np.float32)
+    page_table = np.array([[7, 2, 9, 0], [1, 4, 3, 6]], np.int32)
+    lens = np.array([55, 33], np.int32)
+
+    got = np.asarray(
+        paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k_slab), jnp.asarray(v_slab),
+            jnp.asarray(page_table), jnp.asarray(lens),
+            page_size=page_size, interpret=True, window=window,
+        )
+    )
+    want = dense_reference(
+        q, k_slab, v_slab, page_table, lens, page_size, window=window
+    )
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
@@ -144,6 +177,68 @@ def test_span_decode_paged_kernel_matches_dense():
             )
             ex = SpanExecutor(params, spec, manager,
                               compute_dtype=jnp.float32)
+            async with manager.allocate(2, 64) as handle:
+                outs = [ex.prefill(handle, prefill)]
+                for s in steps:
+                    outs.append(ex.decode(handle, s))
+                return outs
+        finally:
+            del os.environ["BBTPU_PAGED_ATTENTION"]
+            del os.environ["BBTPU_PAGED_INTERPRET"]
+
+    outs_paged = asyncio.run(run_one(True))
+    outs_dense = asyncio.run(run_one(False))
+    for got, want in zip(outs_paged, outs_dense):
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_span_decode_paged_kernel_sliding_windows():
+    """Mistral/gemma-style alternating sliding-window layers run through
+    the paged kernel (the per-layer window rides the scan) and match the
+    dense path exactly."""
+    import asyncio
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from bloombee_tpu.kv.cache_manager import CacheManager
+    from bloombee_tpu.models.llama.block import init_block_params
+    from bloombee_tpu.models.spec import ModelSpec
+    from bloombee_tpu.runtime.executor import SpanExecutor
+    from bloombee_tpu.utils.tree import stack_params
+
+    spec = ModelSpec(
+        family="llama", hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=64,
+        num_hidden_layers=2, vocab_size=64,
+        layer_types=("sliding", "full"), sliding_window=7,
+    )
+    params = stack_params(
+        [init_block_params(jax.random.PRNGKey(i), spec, dtype=jnp.float32)
+         for i in range(2)]
+    )
+    prefill = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(8), (2, 19, 64), jnp.float32)
+    ) * 0.1
+    steps = [
+        np.asarray(
+            jax.random.normal(jax.random.PRNGKey(80 + i), (2, 1, 64))
+        ) * 0.1
+        for i in range(2)
+    ]
+
+    async def run_one(paged: bool):
+        os.environ["BBTPU_PAGED_ATTENTION"] = "1" if paged else "0"
+        os.environ["BBTPU_PAGED_INTERPRET"] = "1"
+        try:
+            manager = CacheManager(
+                num_layers=2, num_pages=16, page_size=16,
+                n_kv_heads=2, head_dim=64, dtype=jnp.float32,
+            )
+            ex = SpanExecutor(params, spec, manager,
+                              compute_dtype=jnp.float32)
+            assert ex.windows == (7, 0)
             async with manager.allocate(2, 64) as handle:
                 outs = [ex.prefill(handle, prefill)]
                 for s in steps:
