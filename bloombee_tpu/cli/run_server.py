@@ -40,6 +40,11 @@ def main(argv=None):
     parser.add_argument("--adapter-dirs", nargs="*", default=None,
                         help="LoRA adapter directories to merge into blocks")
     parser.add_argument("--announce-period", type=float, default=5.0)
+    parser.add_argument("--weight-quant", default=None,
+                        choices=["none", "int8", "int4"],
+                        help="weight-only quantization for the served span "
+                             "(int8 halves / int4 quarters weight HBM "
+                             "bytes per decode step; compute stays bf16)")
     parser.add_argument("--kv-quant", default=None,
                         choices=["none", "int4"],
                         help="KV cache quantization (int4 = ~3.2x capacity)")
@@ -104,6 +109,7 @@ def main(argv=None):
             adapter_dirs=args.adapter_dirs,
             tp=args.tp,
             kv_quant=args.kv_quant,
+            weight_quant=args.weight_quant,
             oversubscribe=args.oversubscribe,
             idle_park_s=args.idle_park_s,
         )

@@ -1,0 +1,127 @@
+"""Weight-only quantization for serving spans (int8 / int4).
+
+The weight half of the reference's compression lever
+(/root/reference/src/bloombee/flexgen_utils/compression.py:22-210 compresses
+weights as well as KV). Decode is weight-bandwidth-bound — the span step
+reads every projection matrix once per token — so storing projections as
+int8 (or group-wise int4) halves (quarters) the HBM bytes per step and
+raises the decode-throughput roofline accordingly. Compute stays bf16: the
+dequantize (convert + scale multiply) is an elementwise producer that XLA
+fuses into the matmul's operand read on TPU, so the dequantized matrix is
+never materialized in HBM.
+
+Layouts:
+- int8: per-output-column symmetric scale. codes [..., in, out] int8,
+  scale [..., 1, out].
+- int4: group-wise (GROUP=32 x out) asymmetric — same group size as the
+  int4 KV slab; round-to-nearest at larger groups is too noisy — two
+  values packed per byte along the input dim. codes [..., in/2, out]
+  uint8, scale/zero [..., in/GROUP, out] f16 (0.625 B/weight vs 2 B bf16,
+  3.2x).
+
+`QuantWeight` is a pytree: quantized leaves stack, scan, and donate through
+the span step exactly like dense arrays.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+GROUP = 32
+
+# 2D projection keys eligible for quantization (per-layer params dict);
+# norms/biases/router stay dense — tiny, and precision-critical
+QUANT_KEYS = (
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+    "experts_gate", "experts_up", "experts_down",
+)
+
+
+class QuantWeight(NamedTuple):
+    codes: jax.Array
+    scale: jax.Array
+    zero: jax.Array | None = None  # int4 only
+
+    @property
+    def bits(self) -> int:
+        return 8 if self.codes.dtype == jnp.int8 else 4
+
+
+def quantize_weight(w: jax.Array, bits: int = 8) -> QuantWeight:
+    """Quantize [..., in, out] along the input (contraction) dim."""
+    w = w.astype(jnp.float32)
+    if bits == 8:
+        amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)  # [..., 1, out]
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        codes = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        return QuantWeight(codes=codes, scale=scale.astype(jnp.float32))
+    if bits == 4:
+        *lead, din, dout = w.shape
+        gs = min(GROUP, din)
+        if din % gs or din % 2:
+            raise ValueError(f"in dim {din} not int4-groupable")
+        g = din // gs
+        wg = w.reshape(*lead, g, gs, dout)
+        mn = wg.min(axis=-2, keepdims=True)  # [..., g, 1, out]
+        mx = wg.max(axis=-2, keepdims=True)
+        scale = (mx - mn) / 15.0
+        safe = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(jnp.round((wg - mn) / safe), 0, 15).astype(jnp.uint8)
+        q = q.reshape(*lead, din, dout)
+        codes = q[..., 0::2, :] | (q[..., 1::2, :] << 4)
+        return QuantWeight(
+            codes=codes,
+            scale=scale.squeeze(-2).astype(jnp.float16),
+            zero=mn.squeeze(-2).astype(jnp.float16),
+        )
+    raise ValueError(f"unsupported weight bits {bits}")
+
+
+def dequantize_weight(qw: QuantWeight, dtype=jnp.bfloat16) -> jax.Array:
+    if qw.bits == 8:
+        return (qw.codes.astype(jnp.float32) * qw.scale).astype(dtype)
+    codes = qw.codes
+    lo = (codes & 0xF).astype(jnp.float32)
+    hi = (codes >> 4).astype(jnp.float32)
+    *lead, half, dout = codes.shape
+    q = jnp.stack([lo, hi], axis=-2).reshape(*lead, half * 2, dout)
+    din = half * 2
+    gs = min(GROUP, din)
+    g = din // gs
+    qg = q.reshape(*lead, g, gs, dout)
+    out = (
+        qg * qw.scale[..., :, None, :].astype(jnp.float32)
+        + qw.zero[..., :, None, :].astype(jnp.float32)
+    )
+    return out.reshape(*lead, din, dout).astype(dtype)
+
+
+def maybe_dequantize(w, dtype=jnp.bfloat16):
+    """Dense passthrough or fused-dequant entry used by the layer body."""
+    if isinstance(w, QuantWeight):
+        return dequantize_weight(w, dtype)
+    return w
+
+
+def quantize_span_params(stacked: dict, bits: int) -> dict:
+    """Quantize the eligible 2D projections of a stacked span params dict
+    (leaves carry a leading L dim). Returns a new dict; ineligible leaves
+    (norms, biases, router) pass through dense."""
+    out = {}
+    for key, leaf in stacked.items():
+        if key in QUANT_KEYS and getattr(leaf, "ndim", 0) >= 3:
+            out[key] = quantize_weight(leaf, bits)
+        else:
+            out[key] = leaf
+    return out
+
+
+def params_nbytes(stacked: dict) -> int:
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(stacked)
+    )

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from bloombee_tpu.kv.arena import arena_write, gather_pages
 from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.models.wquant import maybe_dequantize
 from bloombee_tpu.ops import apply_rotary, rms_norm, silu_mlp
 from bloombee_tpu.ops.alibi import alibi_slopes
 from bloombee_tpu.ops.attention import NEG_INF, repeat_kv
@@ -41,7 +42,9 @@ def _norm(x, params, key, spec):
 
 
 def _proj(x, params, key):
-    y = x @ params[key]
+    # quantized projections dequantize here; XLA fuses the convert+scale
+    # into the matmul's operand read (no dense copy lands in HBM)
+    y = x @ maybe_dequantize(params[key], x.dtype)
     b = params.get(f"{key.removesuffix('_proj')}_bias")
     if b is not None:
         y = y + b
@@ -53,21 +56,26 @@ def _mlp(x, params, spec):
         return moe_mlp(
             x,
             params["router"],
-            params["experts_gate"],
-            params["experts_up"],
-            params["experts_down"],
+            maybe_dequantize(params["experts_gate"], x.dtype),
+            maybe_dequantize(params["experts_up"], x.dtype),
+            maybe_dequantize(params["experts_down"], x.dtype),
             spec.num_experts_per_tok,
             pre_softmax=spec.moe_pre_softmax,
             norm_topk=spec.moe_norm_topk,
         )
     if spec.mlp_type == "silu":
         return silu_mlp(
-            x, params["gate_proj"], params["up_proj"], params["down_proj"]
+            x,
+            maybe_dequantize(params["gate_proj"], x.dtype),
+            maybe_dequantize(params["up_proj"], x.dtype),
+            maybe_dequantize(params["down_proj"], x.dtype),
         )
     if spec.mlp_type == "gelu_tanh_gated":
         g = _proj(x, params, "gate_proj")
         u = _proj(x, params, "up_proj")
-        return (jax.nn.gelu(g, approximate=True) * u) @ params["down_proj"]
+        return (jax.nn.gelu(g, approximate=True) * u) @ maybe_dequantize(
+            params["down_proj"], x.dtype
+        )
     # plain 4h GELU: "gelu" = exact/erf (falcon), "gelu_tanh" = tanh (bloom)
     h = jax.nn.gelu(
         _proj(x, params, "up_proj"), approximate=spec.mlp_type != "gelu"

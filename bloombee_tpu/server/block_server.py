@@ -62,6 +62,13 @@ env.declare(
     "pruner-head checkpoint path: loaded at init if present, saved every "
     "50 train steps",
 )
+env.declare(
+    "BBTPU_WEIGHT_QUANT", str, "none",
+    "weight-only quantization for served spans: none | int8 (per-column "
+    "symmetric, ~2x decode-bandwidth headroom) | int4 (group-wise "
+    "asymmetric, ~4x); compute stays bf16 (reference compression.py "
+    "weight compression)",
+)
 
 
 class _Session:
@@ -136,6 +143,7 @@ class BlockServer:
         adapter_dirs: list[str] | None = None,
         tp: int = 1,
         kv_quant: str | None = None,  # "int4" -> quantized KV arena
+        weight_quant: str | None = None,  # "int8"/"int4" -> quantized weights
         oversubscribe: float = 1.0,  # admit > capacity; park idle sessions
         idle_park_s: float = 5.0,  # a session this idle may be parked
     ):
@@ -148,6 +156,34 @@ class BlockServer:
                 adapter_dirs=adapter_dirs,
             )
         assert spec is not None
+        if weight_quant is None:
+            weight_quant = env.get("BBTPU_WEIGHT_QUANT")
+        if weight_quant and weight_quant != "none":
+            # weight-only quantization (reference compression.py's weight
+            # half): decode reads every projection once per token, so int8
+            # (int4) storage halves (quarters) HBM bytes per step
+            if tp > 1:
+                raise ValueError(
+                    "weight quantization + TP serving not supported together"
+                )
+            if spec.heterogeneous:
+                # hetero spans carry per-layer param dicts (a tuple), and
+                # their unrolled step has no quant handling yet
+                raise ValueError(
+                    "weight quantization + heterogeneous head_dim spans "
+                    "not supported together"
+                )
+            from bloombee_tpu.models import wquant
+
+            before = wquant.params_nbytes(params)
+            params = wquant.quantize_span_params(
+                params, {"int8": 8, "int4": 4}[weight_quant]
+            )
+            logger.info(
+                "quantized span weights to %s: %.1f -> %.1f MiB",
+                weight_quant, before / 2**20,
+                wquant.params_nbytes(params) / 2**20,
+            )
         self.model_uid = model_uid
         self.start_block = start
         self.end_block = end
