@@ -16,6 +16,26 @@ import asyncio
 import logging
 
 
+def parse_adapters(items):
+    """NAME=DIR pairs (or bare DIRs, named by basename) -> {name: dir}."""
+    if not items:
+        return None
+    import os
+
+    out = {}
+    for item in items:
+        name, sep, path = item.partition("=")
+        if not sep or os.sep in name or (os.altsep and os.altsep in name):
+            # bare DIR (possibly containing '='): name = basename
+            name, path = os.path.basename(os.path.normpath(item)), item
+        if not name or not path:
+            raise SystemExit(f"bad --adapters entry {item!r}: need NAME=DIR")
+        if name in out:
+            raise SystemExit(f"duplicate adapter name {name!r} in --adapters")
+        out[name] = path
+    return out
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("model_dir", help="local HF model directory")
@@ -39,6 +59,11 @@ def main(argv=None):
                         choices=["bfloat16", "float32"])
     parser.add_argument("--adapter-dirs", nargs="*", default=None,
                         help="LoRA adapter directories to merge into blocks")
+    parser.add_argument("--adapters", nargs="*", default=None,
+                        metavar="NAME=DIR",
+                        help="per-request switchable LoRA adapters "
+                             "(clients pick one via active_adapter; "
+                             "bare DIR uses its basename as the name)")
     parser.add_argument("--announce-period", type=float, default=5.0)
     parser.add_argument("--weight-quant", default=None,
                         choices=["none", "int8", "int4"],
@@ -107,6 +132,7 @@ def main(argv=None):
             compute_dtype=dtype, max_chunk_tokens=args.max_chunk_tokens,
             announce_period=args.announce_period,
             adapter_dirs=args.adapter_dirs,
+            adapters=parse_adapters(args.adapters),
             tp=args.tp,
             kv_quant=args.kv_quant,
             weight_quant=args.weight_quant,

@@ -31,3 +31,7 @@ class ClientConfig:
     # LMHead.chunked_forward, client/lm_head.py:50-76)
     use_chunked_head: bool = False
     chunked_head_step: int = 16384
+    # per-request LoRA adapter: route only to servers announcing it and ask
+    # them to apply it (reference config.py active_adapter + peft.py
+    # using_adapter); None serves the base model
+    active_adapter: str | None = None

@@ -128,6 +128,7 @@ class DistributedModelForCausalLM:
                 if config.allowed_servers else None
             )
             manager.blocked_servers = set(config.blocked_servers or ())
+            manager.active_adapter = config.active_adapter
         self.config = config or ClientConfig(use_push=use_push)
         self.use_push = self.config.use_push
 
@@ -161,6 +162,7 @@ class DistributedModelForCausalLM:
             ban_timeout=config.ban_timeout,
             allowed_servers=config.allowed_servers,
             blocked_servers=config.blocked_servers,
+            active_adapter=config.active_adapter,
         )
         return cls(spec, params, manager, config=config)
 
@@ -209,6 +211,7 @@ class DistributedModelForCausalLM:
                 microbatch if microbatch is not None else cfg.microbatch
             ),
             embed_fn=self.embed,
+            adapter=cfg.active_adapter,
         )
 
     # --------------------------------------------------------------- generate

@@ -44,6 +44,7 @@ class RemoteSequenceManager:
         rng: random.Random | None = None,
         allowed_servers: list[str] | None = None,
         blocked_servers: list[str] | None = None,
+        active_adapter: str | None = None,
     ):
         self.registry = registry
         self.model_uid = model_uid
@@ -54,6 +55,7 @@ class RemoteSequenceManager:
             set(allowed_servers) if allowed_servers else None
         )
         self.blocked_servers = set(blocked_servers or ())
+        self.active_adapter = active_adapter
         self.spans: dict[str, RemoteSpanInfo] = {}
         self._banned_until: dict[str, float] = {}
         self._last_update = 0.0
@@ -102,6 +104,10 @@ class RemoteSequenceManager:
             and (
                 self.allowed_servers is None
                 or s.peer_id in self.allowed_servers
+            )
+            and (
+                self.active_adapter is None
+                or self.active_adapter in (s.server_info.adapters or ())
             )
         ]
 

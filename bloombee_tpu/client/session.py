@@ -66,8 +66,10 @@ class InferenceSession:
         step_timeout: float = 120.0,
         microbatch: int | None = None,
         embed_fn=None,  # ids [B, T] -> hidden; enables token-id replay
+        adapter: str | None = None,  # per-request LoRA adapter name
     ):
         self.manager = manager
+        self.adapter = adapter
         self.max_length = max_length
         self.batch_size = batch_size
         self.use_push = use_push
@@ -129,6 +131,7 @@ class InferenceSession:
                 "max_length": self.max_length,
                 "start": span.start,
                 "end": span.end,
+                **({"adapter": self.adapter} if self.adapter else {}),
             },
         )
         return _SpanSession(span, conn, stream, session_id)

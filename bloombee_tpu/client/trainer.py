@@ -28,14 +28,18 @@ logger = logging.getLogger(__name__)
 class RemoteSpanChain:
     """Forward/backward over the span chain via rpc_forward/rpc_backward."""
 
-    def __init__(self, manager: RemoteSequenceManager, max_retries: int = 3):
+    def __init__(self, manager: RemoteSequenceManager, max_retries: int = 3,
+                 adapter: str | None = None):
         self.manager = manager
         self.max_retries = max_retries
+        self.adapter = adapter  # per-request LoRA (rides rpc meta)
 
     async def _call_span(self, span, method, tensors, deep_prompts=False):
         conn = await connect(span.server_info.host, span.server_info.port)
         try:
             meta = {"start": span.start, "end": span.end}
+            if self.adapter:
+                meta["adapter"] = self.adapter
             if deep_prompts:
                 meta["deep_prompts"] = True
             _, out = await conn.call(method, meta, tensors)
@@ -168,7 +172,10 @@ class PTuneTrainer:
         deep: bool = False,  # per-layer prompts (reference ptune deep mode)
     ):
         self.model = model
-        self.chain = RemoteSpanChain(model.manager)
+        self.chain = RemoteSpanChain(
+            model.manager,
+            adapter=getattr(model.config, "active_adapter", None),
+        )
         self.n_prompt = n_prompt
         self.lr = lr
         d = model.spec.hidden_size

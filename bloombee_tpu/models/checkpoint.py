@@ -176,6 +176,65 @@ class LoraAdapter:
             self._key_to_handle[key].get_tensor(key), dtype=np.float32
         )
 
+    def span_factors(self, start: int, end: int, dtype=None) -> dict:
+        """Stacked UNMERGED factors for blocks [start, end): per targeted
+        projection, {"a": [L, in, r], "b": [L, r, out]} with the alpha/r
+        scaling folded into b. This is the per-request adapter path
+        (reference utils/peft.py `using_adapter` + LoraLinear): one base
+        weight serves every adapter, the step adds (x a) b for the selected
+        one. Layers the adapter doesn't target get zero factors."""
+        import jax.numpy as jnp
+
+        per_target: dict[str, dict] = {}
+        for name, target in self._TARGETS.items():
+            a_list: list = []
+            b_list: list = []
+            shapes = None
+            for i in range(start, end):
+                ka = self._find(i, target, "lora_A")
+                kb = self._find(i, target, "lora_B")
+                if ka is not None and kb is not None:
+                    a = self._get(ka)  # PEFT A: [r, in]
+                    b = self._get(kb)  # PEFT B: [out, r]
+                    a_list.append(a.T)  # [in, r] for x @ a
+                    b_list.append(b.T * self.scaling)  # [r, out]
+                    shapes = (a.shape, b.shape)
+                else:
+                    a_list.append(None)
+                    b_list.append(None)
+            if shapes is None:
+                continue
+            (r, din), (dout, _) = shapes
+            a_zero = np.zeros((din, r), np.float32)
+            b_zero = np.zeros((r, dout), np.float32)
+            a_stack = np.stack([a if a is not None else a_zero for a in a_list])
+            b_stack = np.stack([b if b is not None else b_zero for b in b_list])
+            per_target[name] = {
+                "a": jnp.asarray(a_stack, dtype=dtype),
+                "b": jnp.asarray(b_stack, dtype=dtype),
+            }
+        if not per_target:
+            # distinguish "adapter targets other layers" (fine: this span
+            # serves base weights, e.g. layers_to_transform adapters split
+            # across servers) from "key layout mismatch" (a correctness
+            # trap: NO server would ever apply the adapter)
+            import re
+
+            any_layer = any(
+                re.search(
+                    rf"layers\.\d+\.(?:{'|'.join(map(re.escape, self._TARGETS.values()))})\.lora_[AB]\.weight$",
+                    k,
+                )
+                for k in self._key_to_handle
+            )
+            if not any_layer:
+                raise ValueError(
+                    f"adapter {self.dir} matched no tensors for ANY layer; "
+                    f"adapter keys like "
+                    f"{next(iter(self._key_to_handle), None)!r}"
+                )
+        return per_target
+
     def merge_into(self, params: dict, layer_idx: int) -> dict:
         import jax.numpy as jnp
 
@@ -204,6 +263,28 @@ class LoraAdapter:
             )
         self.merged_tensors += merged_here
         return params
+
+
+def load_adapter_factors(
+    adapter_dir: str, start: int, end: int, dtype=None
+) -> dict:
+    """Unmerged stacked LoRA factors for a span (see
+    LoraAdapter.span_factors) — the load half of per-request adapter
+    switching."""
+    return LoraAdapter(adapter_dir).span_factors(start, end, dtype=dtype)
+
+
+def resolve_adapter(adapters: dict, name: str | None):
+    """Shared adapter lookup: None -> base (no factors); unknown -> loud."""
+    if name is None:
+        return None
+    try:
+        return adapters[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown adapter {name!r}; serving "
+            f"{sorted(adapters) or 'base only'}"
+        ) from None
 
 
 def load_client_params(model_dir: str, dtype=None) -> dict:

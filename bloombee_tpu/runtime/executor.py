@@ -66,11 +66,17 @@ class SpanExecutor:
         compute_dtype=jnp.bfloat16,
         start_block: int = 0,
         mesh=None,  # jax.sharding.Mesh with a "tp" axis: TP-sharded serving
+        adapters: dict[str, dict] | None = None,  # name -> stacked factors
     ):
         self.mesh = mesh
         if spec.heterogeneous and mesh is not None:
             raise ValueError(
                 "TP serving + heterogeneous head_dim not supported together"
+            )
+        if spec.heterogeneous and adapters:
+            raise ValueError(
+                "per-request adapters + heterogeneous head_dim spans not "
+                "supported together"
             )
         if mesh is not None:
             from bloombee_tpu.parallel import serving as tp_serving
@@ -78,6 +84,14 @@ class SpanExecutor:
             tp_serving.check_tp_divides(spec, mesh.devices.size)
             stacked_params = tp_serving.place_span_params(stacked_params, mesh)
             manager.arena = tp_serving.place_arena(manager.arena, mesh)
+            if adapters:
+                # low-rank factors are small: replicate over the mesh and let
+                # GSPMD partition the delta einsums as it sees fit
+                adapters = {
+                    name: tp_serving.replicated(f, mesh)
+                    for name, f in adapters.items()
+                }
+        self.adapters = adapters or {}
         self.params = stacked_params
         self.spec = spec
         self.manager = manager
@@ -106,6 +120,7 @@ class SpanExecutor:
         commit: bool = True,
         layers: tuple[int, int] | None = None,
         fetch: bool = True,
+        adapter: str | None = None,
     ):
         """Run full-sequence prefill, chunked to bound attention logits memory
         (reference: backend.py:525-531 chunked inference).
@@ -121,7 +136,8 @@ class SpanExecutor:
             chunk = hidden[:, start : start + self.max_chunk_tokens]
             outs.append(
                 self._step(
-                    handle, chunk, commit=commit, layers=layers, fetch=fetch
+                    handle, chunk, commit=commit, layers=layers, fetch=fetch,
+                    adapter=adapter,
                 )
             )
         if len(outs) == 1:
@@ -138,10 +154,11 @@ class SpanExecutor:
         layers: tuple[int, int] | None = None,
         depths: np.ndarray | None = None,
         fetch: bool = True,
+        adapter: str | None = None,
     ):
         return self._step(
             handle, hidden, commit=commit, tree_mask=tree_mask, layers=layers,
-            depths=depths, fetch=fetch,
+            depths=depths, fetch=fetch, adapter=adapter,
         )
 
     def fetch(self, out) -> np.ndarray:
@@ -159,8 +176,12 @@ class SpanExecutor:
         layers: tuple[int, int] | None = None,
         depths: np.ndarray | None = None,
         fetch: bool = True,
+        adapter: str | None = None,
     ):
         spec = self.spec
+        from bloombee_tpu.models.checkpoint import resolve_adapter
+
+        lora = resolve_adapter(self.adapters, adapter)
         b, t, d = hidden.shape
         assert d == spec.hidden_size
 
@@ -300,6 +321,7 @@ class SpanExecutor:
                     arena["v"],
                     payload_dev,
                     tm_dev,
+                    lora,
                     spec=spec,
                     b=bb,
                     t=tb,

@@ -41,17 +41,32 @@ def _norm(x, params, key, spec):
     return rms_norm(x, params[key], spec.rms_norm_eps)
 
 
-def _proj(x, params, key):
+def _proj(x, params, key, lora=None):
     # quantized projections dequantize here; XLA fuses the convert+scale
     # into the matmul's operand read (no dense copy lands in HBM)
     y = x @ maybe_dequantize(params[key], x.dtype)
     b = params.get(f"{key.removesuffix('_proj')}_bias")
     if b is not None:
         y = y + b
+    if lora is not None and key in lora:
+        # per-request LoRA (reference utils/peft.py LoraLinear forward):
+        # y += (x A) B with the alpha/r scaling folded into B at load.
+        # Factors stay unmerged so one base weight serves every adapter.
+        f = lora[key]
+        y = y + (x @ f["a"].astype(x.dtype)) @ f["b"].astype(x.dtype)
     return y
 
 
-def _mlp(x, params, spec):
+def _mlp(x, params, spec, lora=None):
+    mlp_lora = lora is not None and any(
+        k in lora for k in ("gate_proj", "up_proj", "down_proj")
+    )
+    if mlp_lora and not spec.num_experts and spec.mlp_type == "silu":
+        # lora-aware gated-SiLU composition (the fused silu_mlp takes raw
+        # matrices, so the adapterized path spells it out)
+        g = _proj(x, params, "gate_proj", lora)
+        u = _proj(x, params, "up_proj", lora)
+        return _proj(jax.nn.silu(g) * u, params, "down_proj", lora)
     if spec.num_experts:
         return moe_mlp(
             x,
@@ -71,16 +86,15 @@ def _mlp(x, params, spec):
             maybe_dequantize(params["down_proj"], x.dtype),
         )
     if spec.mlp_type == "gelu_tanh_gated":
-        g = _proj(x, params, "gate_proj")
-        u = _proj(x, params, "up_proj")
-        return (jax.nn.gelu(g, approximate=True) * u) @ maybe_dequantize(
-            params["down_proj"], x.dtype
-        )
+        g = _proj(x, params, "gate_proj", lora)
+        u = _proj(x, params, "up_proj", lora)
+        return _proj(jax.nn.gelu(g, approximate=True) * u, params,
+                     "down_proj", lora)
     # plain 4h GELU: "gelu" = exact/erf (falcon), "gelu_tanh" = tanh (bloom)
     h = jax.nn.gelu(
-        _proj(x, params, "up_proj"), approximate=spec.mlp_type != "gelu"
+        _proj(x, params, "up_proj", lora), approximate=spec.mlp_type != "gelu"
     )
-    return _proj(h, params, "down_proj")
+    return _proj(h, params, "down_proj", lora)
 
 
 def attn_scale(spec: ModelSpec) -> float:
@@ -154,6 +168,7 @@ def layer_body(
     window,  # traced scalar
     use_flash: bool = False,  # static: executor's shape heuristic said yes
     use_paged: bool = False,  # static: T=1 decode via the paged kernel
+    lora: dict | None = None,  # this layer's per-request LoRA factors
 ):
     b, t, d = hidden.shape
     h_heads, kv_heads, hd = (
@@ -162,14 +177,14 @@ def layer_body(
         spec.head_dim,
     )
     x = _norm(hidden, params, "input_layernorm", spec)
-    q = _proj(x, params, "q_proj").reshape(b, t, h_heads, hd)
-    k = _proj(x, params, "k_proj").reshape(b, t, kv_heads, hd)
+    q = _proj(x, params, "q_proj", lora).reshape(b, t, h_heads, hd)
+    k = _proj(x, params, "k_proj", lora).reshape(b, t, kv_heads, hd)
     if spec.k_eq_v:
         # gemma-4 full-attention layers alias V to K (one shared
         # projection; reference gemma4/block.py attention_k_eq_v)
         v = k
     else:
-        v = _proj(x, params, "v_proj").reshape(b, t, kv_heads, hd)
+        v = _proj(x, params, "v_proj", lora).reshape(b, t, kv_heads, hd)
     if spec.qk_norm:
         q = rms_norm(q, params["q_norm"], spec.rms_norm_eps)
         k = rms_norm(k, params["k_norm"], spec.rms_norm_eps)
@@ -198,8 +213,12 @@ def layer_body(
             interpret=jax.default_backend() != "tpu",
             window=window,  # per-layer traced scalar (0 = full)
         )[:, None]  # [B, 1, H, hd]
-        attn_out = _proj(attn.reshape(b, t, h_heads * hd), params, "o_proj")
-        return _finish_layer(spec, params, hidden, x, attn_out, k_slab, v_slab)
+        attn_out = _proj(
+            attn.reshape(b, t, h_heads * hd), params, "o_proj", lora
+        )
+        return _finish_layer(
+            spec, params, hidden, x, attn_out, k_slab, v_slab, lora
+        )
     k_ctx = gather_pages(k_slab, page_table, page_size).astype(hidden.dtype)
     v_ctx = gather_pages(v_slab, page_table, page_size).astype(hidden.dtype)
 
@@ -220,11 +239,14 @@ def layer_body(
         attn = attend_paged(
             spec, q, k_ctx, v_ctx, q_positions, total_lens, tree_mask, window
         )
-    attn_out = _proj(attn.reshape(b, t, h_heads * hd), params, "o_proj")
-    return _finish_layer(spec, params, hidden, x, attn_out, k_slab, v_slab)
+    attn_out = _proj(attn.reshape(b, t, h_heads * hd), params, "o_proj", lora)
+    return _finish_layer(
+        spec, params, hidden, x, attn_out, k_slab, v_slab, lora
+    )
 
 
-def _finish_layer(spec, params, hidden, x, attn_out, k_slab, v_slab):
+def _finish_layer(spec, params, hidden, x, attn_out, k_slab, v_slab,
+                  lora=None):
     """Residual + MLP tail shared by the dense/flash/paged attention paths."""
     if spec.parallel_attn:
         # falcon: parallel residual. 7b shares one input norm for attention
@@ -234,7 +256,7 @@ def _finish_layer(spec, params, hidden, x, attn_out, k_slab, v_slab):
             x_mlp = _norm(hidden, params, "mlp_layernorm", spec)
         else:
             x_mlp = x
-        hidden = hidden + attn_out + _mlp(x_mlp, params, spec)
+        hidden = hidden + attn_out + _mlp(x_mlp, params, spec, lora)
         return hidden, k_slab, v_slab
 
     if spec.sandwich_norms:
@@ -242,12 +264,13 @@ def _finish_layer(spec, params, hidden, x, attn_out, k_slab, v_slab):
         hidden = hidden + attn_out
         x2 = _norm(hidden, params, "pre_feedforward_layernorm", spec)
         mlp_out = _norm(
-            _mlp(x2, params, spec), params, "post_feedforward_layernorm", spec
+            _mlp(x2, params, spec, lora), params,
+            "post_feedforward_layernorm", spec,
         )
         hidden = hidden + mlp_out
         return hidden, k_slab, v_slab
 
     hidden = hidden + attn_out
     x2 = _norm(hidden, params, "post_attention_layernorm", spec)
-    hidden = hidden + _mlp(x2, params, spec)
+    hidden = hidden + _mlp(x2, params, spec, lora)
     return hidden, k_slab, v_slab

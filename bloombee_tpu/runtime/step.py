@@ -101,6 +101,7 @@ def span_step_packed_impl(
     arena_v: jax.Array,
     payload: jax.Array,  # uint16 (bf16 compute) or uint32 (f32 compute)
     tree_mask: jax.Array | None = None,
+    lora: dict | None = None,  # per-request LoRA factors, leading dim L
     *,
     spec: ModelSpec,
     b: int,
@@ -116,6 +117,7 @@ def span_step_packed_impl(
     hidden, plan = unpack_step_payload(payload, b, t, spec.hidden_size)
     return span_step_impl(
         stacked_params, arena_k, arena_v, hidden, plan, tree_mask,
+        lora=lora,
         spec=spec, page_size=page_size, max_pages=max_pages,
         use_tree_mask=use_tree_mask, windows=windows, use_flash=use_flash,
         use_paged=use_paged,
@@ -140,6 +142,7 @@ def span_step_impl(
     plan: jax.Array,  # packed int32 (see unpack_plan)
     tree_mask: jax.Array | None = None,  # [B, T, T] bool
     prompts: jax.Array | None = None,  # [L, P, D] deep p-tuning prompts
+    lora: dict | None = None,  # {proj: {a: [L,in,r], b: [L,r,out]}}
     *,
     spec: ModelSpec,
     page_size: int,
@@ -184,13 +187,14 @@ def span_step_impl(
     xs = (stacked_params, arena_k, arena_v, layer_active, windows_arr)
     if prompts is not None:
         xs = xs + (prompts,)
+    if lora is not None:
+        xs = xs + (lora,)
 
     def body(h, xs):
-        if prompts is not None:
-            params_l, k_l, v_l, active, window_l, prompt_l = xs
-        else:
-            params_l, k_l, v_l, active, window_l = xs
-            prompt_l = None
+        params_l, k_l, v_l, active, window_l = xs[:5]
+        rest = list(xs[5:])
+        prompt_l = rest.pop(0) if prompts is not None else None
+        lora_l = rest.pop(0) if lora is not None else None
         use_local = window_l > 0
         cos_l = jnp.where(use_local, cos_loc, cos)
         sin_l = jnp.where(use_local, sin_loc, sin)
@@ -202,7 +206,7 @@ def span_step_impl(
             return layer_body(
                 spec, page_size, h, params_l, k_l, v_l, cos_l, sin_l, slots,
                 page_table, q_positions, total_lens, tm, window_l,
-                use_flash=use_flash, use_paged=use_paged,
+                use_flash=use_flash, use_paged=use_paged, lora=lora_l,
             )
 
         def skip(h, k_l, v_l):

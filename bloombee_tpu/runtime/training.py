@@ -43,7 +43,8 @@ def _train_plan(
     return pack_plan(slots, page_table, positions, total_lens, layer_active)
 
 
-def _dense_forward(stacked_params, hidden, plan, spec, windows, prompts=None):
+def _dense_forward(stacked_params, hidden, plan, spec, windows, prompts=None,
+                   lora=None):
     b, t, _ = hidden.shape
     num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
     arena_shape = (
@@ -52,7 +53,7 @@ def _dense_forward(stacked_params, hidden, plan, spec, windows, prompts=None):
     zeros = jnp.zeros(arena_shape, hidden.dtype)
     out, _, _ = span_step_impl(
         stacked_params, zeros, jnp.zeros_like(zeros), hidden, plan, None,
-        prompts,
+        prompts, lora,
         spec=spec, page_size=t, max_pages=1, windows=windows,
     )
     return out
@@ -60,22 +61,24 @@ def _dense_forward(stacked_params, hidden, plan, spec, windows, prompts=None):
 
 @functools.partial(jax.jit, static_argnames=("spec", "windows"))
 def span_train_forward(
-    stacked_params, hidden, plan, prompts=None, *,
+    stacked_params, hidden, plan, prompts=None, lora=None, *,
     spec: ModelSpec, windows=None,
 ):
-    return _dense_forward(stacked_params, hidden, plan, spec, windows, prompts)
+    return _dense_forward(
+        stacked_params, hidden, plan, spec, windows, prompts, lora
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("spec", "windows"))
 def span_train_backward(
-    stacked_params, hidden_in, grad_out, plan, prompts=None, *,
+    stacked_params, hidden_in, grad_out, plan, prompts=None, lora=None, *,
     spec: ModelSpec, windows=None,
 ):
     """Returns (forward_output, grad_wrt_input[, grad_wrt_prompts])."""
     if prompts is None:
         out, vjp = jax.vjp(
             lambda h: _dense_forward(
-                stacked_params, h, plan, spec, windows
+                stacked_params, h, plan, spec, windows, None, lora
             ),
             hidden_in,
         )
@@ -83,7 +86,7 @@ def span_train_backward(
         return out, g_in, None
     out, vjp = jax.vjp(
         lambda h, p: _dense_forward(
-            stacked_params, h, plan, spec, windows, p
+            stacked_params, h, plan, spec, windows, p, lora
         ),
         hidden_in, prompts,
     )
@@ -95,12 +98,18 @@ class TrainingExecutor:
     """Host wrapper used by the server's rpc_forward/rpc_backward."""
 
     def __init__(self, stacked_params, spec: ModelSpec, windows=None,
-                 compute_dtype=jnp.float32):
+                 compute_dtype=jnp.float32, adapters=None):
         self.params = stacked_params
         self.spec = spec
         self.windows = windows
         self.compute_dtype = compute_dtype
+        self.adapters = adapters or {}
         self.num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+
+    def _lora(self, adapter):
+        from bloombee_tpu.models.checkpoint import resolve_adapter
+
+        return resolve_adapter(self.adapters, adapter)
 
     def _expand_prompts(self, prompts, layers):
         """Received prompts cover the ACTIVE sub-span only; embed them at
@@ -118,12 +127,13 @@ class TrainingExecutor:
     def forward(
         self, hidden: np.ndarray, layers: tuple[int, int] | None = None,
         prompts: np.ndarray | None = None,
+        adapter: str | None = None,
     ) -> np.ndarray:
         b, t, _ = hidden.shape
         plan = jnp.asarray(_train_plan(b, t, self.num_layers, layers))
         out = span_train_forward(
             self.params, jnp.asarray(hidden, self.compute_dtype), plan,
-            self._expand_prompts(prompts, layers),
+            self._expand_prompts(prompts, layers), self._lora(adapter),
             spec=self.spec, windows=self.windows,
         )
         return np.asarray(out, dtype=np.float32)
@@ -134,6 +144,7 @@ class TrainingExecutor:
         grad_out: np.ndarray,
         layers: tuple[int, int] | None = None,
         prompts: np.ndarray | None = None,
+        adapter: str | None = None,
     ):
         """Returns g_in, or (g_in, g_prompts) when prompts are given
         (g_prompts covers only the active sub-span rows)."""
@@ -145,6 +156,7 @@ class TrainingExecutor:
             jnp.asarray(grad_out, self.compute_dtype),
             plan,
             self._expand_prompts(prompts, layers),
+            self._lora(adapter),
             spec=self.spec,
             windows=self.windows,
         )

@@ -73,11 +73,13 @@ env.declare(
 
 class _Session:
     def __init__(self, session_id: str, handle, batch_size: int,
-                 layers: tuple[int, int] | None = None):
+                 layers: tuple[int, int] | None = None,
+                 adapter: str | None = None):
         self.id = session_id
         self.handle = handle
         self.batch_size = batch_size
         self.layers = layers  # relative (l0, l1) within this server's span
+        self.adapter = adapter  # per-request LoRA adapter name (or base)
         self.push_inbox: asyncio.Queue = asyncio.Queue()
         self.step_tasks: set[asyncio.Task] = set()  # in-flight mb chunks
         self.last_step_at = 0.0  # idle measure for the parking reclaimer
@@ -140,7 +142,8 @@ class BlockServer:
         announce_period: float = 5.0,
         alloc_timeout: float = 60.0,
         throughput: float = 1.0,
-        adapter_dirs: list[str] | None = None,
+        adapter_dirs: list[str] | None = None,  # merged into base at load
+        adapters: dict[str, str] | None = None,  # name -> dir, per-request
         tp: int = 1,
         kv_quant: str | None = None,  # "int4" -> quantized KV arena
         weight_quant: str | None = None,  # "int8"/"int4" -> quantized weights
@@ -184,6 +187,18 @@ class BlockServer:
                 weight_quant, before / 2**20,
                 wquant.params_nbytes(params) / 2**20,
             )
+        # per-request switchable adapters (reference utils/peft.py
+        # `using_adapter` + server --adapters): factors stay UNMERGED so the
+        # same base weights serve base and every adapter; a session picks one
+        # via open metadata
+        self.adapter_factors: dict[str, dict] = {}
+        if adapters:
+            from bloombee_tpu.models.checkpoint import load_adapter_factors
+
+            for name, adir in adapters.items():
+                self.adapter_factors[name] = load_adapter_factors(
+                    adir, start, end, dtype=compute_dtype
+                )
         self.model_uid = model_uid
         self.start_block = start
         self.end_block = end
@@ -230,6 +245,7 @@ class BlockServer:
             compute_dtype=compute_dtype,
             start_block=start,
             mesh=mesh,
+            adapters=self.adapter_factors,
         )
         self.wire_dtype = name_for_dtype(self.executor.transfer_dtype)
         if spec.heterogeneous:
@@ -239,7 +255,7 @@ class BlockServer:
 
             self.training = TrainingExecutor(
                 params, spec, windows=self.executor.windows,
-                compute_dtype=compute_dtype,
+                compute_dtype=compute_dtype, adapters=self.adapter_factors,
             )
         self.compute = ComputeQueue()
         self.peers = _PeerPool()
@@ -346,6 +362,7 @@ class BlockServer:
             end_block=self.end_block,
             wire_dtype=self.wire_dtype,
             next_pings=self.next_pings.to_wire() or None,
+            adapters=sorted(self.adapter_factors) or None,
         )
 
     async def _announce(self, state: ServerState) -> None:
@@ -412,13 +429,17 @@ class BlockServer:
         session_id = meta["session_id"]
         batch = int(meta["batch_size"])
         max_length = int(meta["max_length"])
+        adapter = meta.get("adapter")
+        from bloombee_tpu.models.checkpoint import resolve_adapter
+
+        resolve_adapter(self.adapter_factors, adapter)  # loud on unknown
         layers = self._resolve_layers(meta)
         async with self.manager.allocate(
             batch, max_length, timeout=self.alloc_timeout
         ) as handle:
             import time as _time
 
-            session = _Session(session_id, handle, batch, layers)
+            session = _Session(session_id, handle, batch, layers, adapter)
             session.opened_at = _time.monotonic()
             session.last_step_at = session.opened_at
             self._sessions[session_id] = session
@@ -697,12 +718,13 @@ class BlockServer:
         if hidden.shape[1] > 1 and tree_mask is None:
             out = self.executor.prefill(
                 handle, hidden, commit=commit, layers=session.layers,
-                fetch=False,
+                fetch=False, adapter=session.adapter,
             )
         else:
             out = self.executor.decode(
                 handle, hidden, commit=commit, tree_mask=tree_mask,
                 layers=session.layers, depths=depths, fetch=False,
+                adapter=session.adapter,
             )
         if commit_lens is not None:
             self.manager.commit(handle, lengths=commit_lens)
@@ -949,7 +971,8 @@ class BlockServer:
         )
         layers = self._resolve_layers(meta)
         out = await self.compute.submit(
-            PRIORITY_TRAINING, self.training.forward, hidden, layers, prompts
+            PRIORITY_TRAINING, self.training.forward, hidden, layers, prompts,
+            meta.get("adapter"),
         )
         return {"ok": True}, [out]
 
@@ -968,7 +991,7 @@ class BlockServer:
         layers = self._resolve_layers(meta)
         result = await self.compute.submit(
             PRIORITY_TRAINING, self.training.backward, hidden_in, grad_out,
-            layers, prompts,
+            layers, prompts, meta.get("adapter"),
         )
         if prompts is not None:
             g_in, g_prompts = result

@@ -34,6 +34,10 @@ class ServerInfo:
     # computes in bf16; "f32" for exact-parity fp32 serving). Halves the
     # bytes of the latency-critical decode payload vs the round-1 fp32 wire.
     wire_dtype: str = "f32"
+    # per-request LoRA adapters this server can apply (reference ServerInfo
+    # adapters field, data_structures.py); routing filters on these when the
+    # client sets ClientConfig.active_adapter
+    adapters: list[str] | None = None
 
     def to_wire(self) -> dict:
         d = dataclasses.asdict(self)

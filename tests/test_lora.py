@@ -11,45 +11,14 @@ import jax.numpy as jnp
 
 
 def test_lora_merge_matches_torch(tmp_path):
-    from safetensors.torch import save_file
-    from transformers import LlamaConfig, LlamaForCausalLM
-
+    """Merge-at-load path (adapter_dirs): served logits must match an HF
+    model whose weights were merged in torch."""
     from bloombee_tpu.client.model import DistributedModelForCausalLM
     from bloombee_tpu.server.block_server import BlockServer
     from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
 
-    config = LlamaConfig(
-        hidden_size=64, intermediate_size=128, num_attention_heads=4,
-        num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
-        rms_norm_eps=1e-5, tie_word_embeddings=False,
-    )
-    torch.manual_seed(0)
-    hf = LlamaForCausalLM(config).eval().to(torch.float32)
-    base = str(tmp_path / "base")
-    hf.save_pretrained(base, safe_serialization=True)
-
-    # random LoRA on q_proj/v_proj of both layers (PEFT layout)
-    r, alpha = 4, 8.0
-    adapter = tmp_path / "adapter"
-    adapter.mkdir()
-    tensors = {}
-    torch.manual_seed(1)
-    for i in range(2):
-        for proj in ("q_proj", "v_proj"):
-            mod_w = getattr(hf.model.layers[i].self_attn, proj).weight
-            a = torch.randn(r, mod_w.shape[1]) * 0.1
-            b = torch.randn(mod_w.shape[0], r) * 0.1
-            key = f"base_model.model.model.layers.{i}.self_attn.{proj}"
-            tensors[f"{key}.lora_A.weight"] = a
-            tensors[f"{key}.lora_B.weight"] = b
-            # merge into the torch reference: W += alpha/r * B @ A
-            mod = getattr(hf.model.layers[i].self_attn, proj)
-            with torch.no_grad():
-                mod.weight += (alpha / r) * (b @ a)
-    save_file(tensors, str(adapter / "adapter_model.safetensors"))
-    (adapter / "adapter_config.json").write_text(
-        json.dumps({"r": r, "lora_alpha": alpha, "peft_type": "LORA"})
-    )
+    hf, base = _tiny_llama(tmp_path)
+    adir, merged = _write_adapter(tmp_path, hf, "adapter", ("q_proj", "v_proj"))
 
     async def run():
         reg = RegistryServer(host="127.0.0.1")
@@ -58,7 +27,7 @@ def test_lora_merge_matches_torch(tmp_path):
             model_uid="m", start=0, end=2, model_dir=base,
             registry=RegistryClient("127.0.0.1", reg.port),
             compute_dtype=jnp.float32, num_pages=32, page_size=4,
-            adapter_dirs=[str(adapter)],
+            adapter_dirs=[adir],
         )
         await server.start()
         model = DistributedModelForCausalLM.from_pretrained(
@@ -69,9 +38,160 @@ def test_lora_merge_matches_torch(tmp_path):
             out = await sess.step(model.embed(input_ids))
         logits = model.logits(out)
         with torch.no_grad():
-            ref = hf(torch.tensor(input_ids)).logits.numpy()
+            ref = merged(torch.tensor(input_ids)).logits.numpy()
         np.testing.assert_allclose(logits, ref, atol=2e-3, rtol=2e-3)
         await server.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def _tiny_llama(tmp_path, seed=0):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(seed)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    base = str(tmp_path / "base")
+    hf.save_pretrained(base, safe_serialization=True)
+    return hf, base
+
+
+def _write_adapter(tmp_path, hf, name, targets, r=4, alpha=8.0, seed=1):
+    """Random PEFT adapter over `targets`; returns (dir, merged hf copy)."""
+    import copy
+
+    from safetensors.torch import save_file
+
+    adapter = tmp_path / name
+    adapter.mkdir()
+    merged = copy.deepcopy(hf)
+    tensors = {}
+    torch.manual_seed(seed)
+    for i, layer in enumerate(merged.model.layers):
+        for proj in targets:
+            mod = (
+                getattr(layer.self_attn, proj)
+                if hasattr(layer.self_attn, proj)
+                else getattr(layer.mlp, proj)
+            )
+            prefix = "self_attn" if hasattr(layer.self_attn, proj) else "mlp"
+            a = torch.randn(r, mod.weight.shape[1]) * 0.1
+            b = torch.randn(mod.weight.shape[0], r) * 0.1
+            key = f"base_model.model.model.layers.{i}.{prefix}.{proj}"
+            tensors[f"{key}.lora_A.weight"] = a
+            tensors[f"{key}.lora_B.weight"] = b
+            with torch.no_grad():
+                mod.weight += (alpha / r) * (b @ a)
+    save_file(tensors, str(adapter / "adapter_model.safetensors"))
+    (adapter / "adapter_config.json").write_text(
+        json.dumps({"r": r, "lora_alpha": alpha, "peft_type": "LORA"})
+    )
+    return str(adapter), merged
+
+
+def test_per_request_adapter_switching(tmp_path):
+    """One server, UNMERGED base: a session that names the adapter gets the
+    tuned logits, a plain session gets the base logits (reference
+    utils/peft.py using_adapter + --adapters serving)."""
+    from bloombee_tpu.client.config import ClientConfig
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    hf, base = _tiny_llama(tmp_path)
+    adir, merged = _write_adapter(
+        tmp_path, hf, "tuned", ("q_proj", "v_proj", "gate_proj", "down_proj")
+    )
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        server = BlockServer(
+            model_uid="m", start=0, end=2, model_dir=base,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=32, page_size=4,
+            adapters={"tuned": adir},
+        )
+        await server.start()
+        input_ids = np.arange(8)[None, :]
+        results = {}
+        for label, cfg in (
+            ("tuned", ClientConfig(active_adapter="tuned")),
+            ("base", None),
+        ):
+            model = DistributedModelForCausalLM.from_pretrained(
+                base, RegistryClient("127.0.0.1", reg.port), model_uid="m",
+                config=cfg,
+            )
+            async with model.inference_session(16, 1) as sess:
+                out = await sess.step(model.embed(input_ids))
+            results[label] = model.logits(out)
+        await server.stop()
+        await reg.stop()
+        return results
+
+    results = asyncio.run(run())
+    input_ids = np.arange(8)[None, :]
+    with torch.no_grad():
+        ref_base = hf(torch.tensor(input_ids)).logits.numpy()
+        ref_tuned = merged(torch.tensor(input_ids)).logits.numpy()
+    np.testing.assert_allclose(
+        results["base"], ref_base, atol=2e-3, rtol=2e-3
+    )
+    np.testing.assert_allclose(
+        results["tuned"], ref_tuned, atol=2e-3, rtol=2e-3
+    )
+    # the adapter must actually change the logits for the switch to mean
+    # anything
+    assert np.abs(ref_tuned - ref_base).max() > 1e-2
+
+
+def test_adapter_routing_filter(tmp_path):
+    """active_adapter routes only to servers announcing that adapter
+    (reference sequence_manager's adapter-aware span filtering)."""
+    from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    hf, base = _tiny_llama(tmp_path)
+    adir, _ = _write_adapter(tmp_path, hf, "tuned", ("q_proj", "v_proj"))
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        plain = BlockServer(
+            model_uid="m", start=0, end=2, model_dir=base,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=16, page_size=4,
+        )
+        tuned = BlockServer(
+            model_uid="m", start=0, end=2, model_dir=base,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=16, page_size=4,
+            adapters={"tuned": adir},
+        )
+        await plain.start()
+        await tuned.start()
+        manager = RemoteSequenceManager(
+            RegistryClient("127.0.0.1", reg.port), "m", 2,
+            active_adapter="tuned",
+        )
+        await manager.update(force=True)
+        routes = {
+            manager.make_sequence()[0].peer_id for _ in range(8)
+        }
+        assert routes == {tuned.server_id}
+        # without the filter both servers are candidates
+        manager.active_adapter = None
+        all_peers = {s.peer_id for s in manager._active_spans()}
+        assert all_peers == {plain.server_id, tuned.server_id}
+        await plain.stop()
+        await tuned.stop()
         await reg.stop()
 
     asyncio.run(run())
