@@ -16,8 +16,10 @@ class ClientConfig:
     # transport topology (reference use_server_to_server +
     # push_only_downstream_decode)
     use_push: bool = True
-    # within-stage micro-batch count; None -> BBTPU_MICROBATCH env default
-    microbatch: int | None = None
+    # within-stage micro-batch count; "auto" sizes chunks to the pipeline
+    # depth (reference microbatch_config derives it from the deployment);
+    # None -> BBTPU_MICROBATCH env default
+    microbatch: int | str | None = None
     # per-step failure handling (reference retries/backoff + ban_timeout)
     max_retries: int = 3
     step_timeout: float = 120.0

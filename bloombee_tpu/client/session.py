@@ -84,9 +84,12 @@ class InferenceSession:
             microbatch if microbatch is not None
             else env.get("BBTPU_MICROBATCH")
         )
-        if self.microbatch < 1:
+        if not (
+            self.microbatch == "auto"
+            or (isinstance(self.microbatch, int) and self.microbatch >= 1)
+        ):
             raise ValueError(
-                f"microbatch must be >= 1, got {self.microbatch}"
+                f"microbatch must be >= 1 or 'auto', got {self.microbatch!r}"
             )
         self._spans: list[_SpanSession] = []
         # failure-replay history. Preferred: per-row committed token ids
@@ -291,6 +294,17 @@ class InferenceSession:
         # accept steps keep whole-batch semantics)
         b = hidden.shape[0]
         mb = self.microbatch
+        if mb == "auto":
+            # size chunks to the pipeline depth (reference
+            # microbatch_config.py:84-130 derives the count from the
+            # deployment, not a constant): overlap pays when there is more
+            # than one stage, and more chunks than stages adds per-chunk
+            # overhead without more overlap
+            mb = (
+                min(b, max(2, len(self._spans)))
+                if len(self._spans) > 1 and b > 1
+                else 1
+            )
         if (
             tree_mask is not None
             or accept is not None
@@ -404,11 +418,16 @@ class InferenceSession:
         ]
         total = float(np.mean([t["total_ms"] for t in rows]))
         compute = float(np.sum(per_span))
+        from bloombee_tpu.wire.tensor_codec import transport_stats
+
         return {
             "steps": len(rows),
             "mean_total_ms": total,
             "mean_compute_ms_per_span": per_span,
             "mean_wire_and_overhead_ms": total - compute,
+            # process-wide codec counters (the reference transport
+            # profiling channels' client half)
+            "transport": transport_stats(),
         }
 
     async def send_accept(

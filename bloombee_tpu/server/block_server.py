@@ -384,6 +384,10 @@ class BlockServer:
                 # successor would expire our registry record); the pings
                 # measured after ride the NEXT announce
                 await self._announce(ServerState.ONLINE)
+                if env.log_channel_enabled("transport"):
+                    from bloombee_tpu.wire.tensor_codec import transport_stats
+
+                    logger.info("[transport] %s", transport_stats())
                 await asyncio.wait_for(
                     self._measure_next_pings(), self.announce_period
                 )
@@ -415,9 +419,12 @@ class BlockServer:
     async def _rpc_info(self, meta: dict, tensors):
         import time as _time
 
+        from bloombee_tpu.wire.tensor_codec import transport_stats
+
         return {
             "server_id": self.server_id,
             "server_time": _time.time(),  # NTP-style clock sync anchor
+            "transport": transport_stats(),
             **self.server_info().to_wire(),
         }, []
 

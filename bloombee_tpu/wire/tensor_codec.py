@@ -32,6 +32,63 @@ except Exception:  # pragma: no cover - zstandard is in the base image
 
 from bloombee_tpu.utils import env as _env
 
+import threading
+import time as _time
+
+
+class _TransportStats:
+    """Per-process transport profiling (the role of the reference
+    lossless_transport profiling channels): per direction, tensor count,
+    raw vs wire bytes, codec time. Snapshot via transport_stats(); the
+    `transport` log channel (BBTPU_LOG_CHANNELS=transport) logs one line
+    per call site."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._d = {
+                "tx": {"n": 0, "raw_bytes": 0, "wire_bytes": 0, "s": 0.0,
+                       "compressed": 0},
+                "rx": {"n": 0, "raw_bytes": 0, "wire_bytes": 0, "s": 0.0,
+                       "compressed": 0},
+            }
+
+    def record(self, direction, raw_len, wire_len, seconds, compressed):
+        with self._lock:
+            d = self._d[direction]
+            d["n"] += 1
+            d["raw_bytes"] += raw_len
+            d["wire_bytes"] += wire_len
+            d["s"] += seconds
+            d["compressed"] += bool(compressed)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {}
+            for k, d in self._d.items():
+                out[k] = dict(d)
+                out[k]["ratio"] = (
+                    d["wire_bytes"] / d["raw_bytes"] if d["raw_bytes"] else 1.0
+                )
+            return out
+
+
+_STATS = _TransportStats()
+
+
+def transport_stats() -> dict:
+    """Snapshot of this process's wire-codec counters (tx/rx tensors, raw vs
+    wire bytes, compression ratio, codec seconds)."""
+    return _STATS.snapshot()
+
+
+def reset_transport_stats() -> None:
+    _STATS.reset()
+
+
 # defaults; overridable per process via the env switches declared below
 MIN_COMPRESS_BYTES = 48 * 1024
 MIN_GAIN_BYTES = 2 * 1024
@@ -113,6 +170,7 @@ def serialize_tensor(
     arr: np.ndarray, compression: bool = True
 ) -> tuple[TensorMeta, bytes]:
     """Serialize one array; returns (meta, payload bytes)."""
+    t0 = _time.perf_counter()
     arr = np.ascontiguousarray(arr)
     dtype = np.dtype(arr.dtype)
     if dtype not in _DTYPE_NAMES:
@@ -138,10 +196,15 @@ def serialize_tensor(
             codec = chosen
         else:
             byte_split = False
+    _STATS.record(
+        "tx", len(raw), len(payload), _time.perf_counter() - t0,
+        codec != "raw",
+    )
     return TensorMeta(_DTYPE_NAMES[dtype], arr.shape, codec, byte_split), payload
 
 
 def deserialize_tensor(meta: TensorMeta, payload: bytes) -> np.ndarray:
+    t0 = _time.perf_counter()
     dtype = np.dtype(_DTYPES[meta.dtype])
     if meta.codec == "raw":
         raw = payload
@@ -149,6 +212,10 @@ def deserialize_tensor(meta: TensorMeta, payload: bytes) -> np.ndarray:
         raw = _decompress(payload, meta.codec)
         if meta.byte_split:
             raw = _merge_planes(raw)
+    _STATS.record(
+        "rx", len(raw), len(payload), _time.perf_counter() - t0,
+        meta.codec != "raw",
+    )
     return np.frombuffer(bytearray(raw), dtype=dtype).reshape(meta.shape)
 
 

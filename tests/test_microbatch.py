@@ -136,3 +136,60 @@ def test_microbatch_overlap_beats_serial(tiny):
     # delay; pipelined ideal = 6 * 3 slots * 0.08 = 1.44s (+ overhead) —
     # a ~0.5s margin so scheduler noise can't flip the comparison
     assert pipe_t < serial_t * 0.92, (pipe_t, serial_t)
+
+
+def test_auto_microbatch_sizes_to_pipeline_depth(tiny):
+    """microbatch='auto' picks chunks = pipeline depth for multi-span
+    batched steps and stays whole-batch for single-row or single-span
+    sessions (reference microbatch_config derives the count from the
+    deployment)."""
+    model_dir, hf_model, config = tiny
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s1 = _server(model_dir, reg.port, 0, 2)
+        s2 = _server(model_dir, reg.port, 2, 3)
+        await s1.start()
+        await s2.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, RegistryClient("127.0.0.1", reg.port),
+            model_uid="tiny",
+        )
+        input_ids = np.arange(12).reshape(4, 3) % config.vocab_size
+        # spy on the first server's item handler to see the resolved chunking
+        seen_mb = []
+        orig = s1._handle_item
+
+        async def spy(session, stream, meta, tensors):
+            seen_mb.append(int(meta.get("mb_of", 1)))
+            return await orig(session, stream, meta, tensors)
+
+        s1._handle_item = spy
+        # drive the session directly so we can inspect the resolved chunking
+        async with model.inference_session(16, 4, microbatch="auto") as sess:
+            assert len(sess._spans) == 2
+            out = await sess.step(
+                model.embed(input_ids), ids=input_ids
+            )
+        # auto resolved to chunks == pipeline depth (2 spans -> mb_of == 2)
+        assert seen_mb and set(seen_mb) == {2}, seen_mb
+        logits = model.logits(out)
+        with torch.no_grad():
+            ref = hf_model(torch.tensor(input_ids)).logits.numpy()
+        np.testing.assert_allclose(logits, ref, atol=2e-3, rtol=2e-3)
+
+        # batch 1: auto degrades to whole-batch (no chunk overhead)
+        seen_mb.clear()
+        async with model.inference_session(16, 1, microbatch="auto") as sess:
+            one = await sess.step(model.embed(input_ids[:1]))
+        assert seen_mb and set(seen_mb) == {1}, seen_mb
+        np.testing.assert_allclose(
+            model.logits(one), ref[:1], atol=2e-3, rtol=2e-3
+        )
+        await s1.stop()
+        await s2.stop()
+        await reg.stop()
+
+    asyncio.run(run())

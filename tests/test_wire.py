@@ -186,3 +186,31 @@ def test_compute_spans_skips_offline():
     info = ServerInfo(state=ServerState.JOINING)
     infos = [ModuleInfo(uid="m.0", servers={"X": info})]
     assert compute_spans(infos) == {}
+
+
+def test_transport_stats_counters():
+    """Codec profiling counters (reference lossless_transport profiling
+    channels): tx/rx tensor counts, raw vs wire bytes, compression ratio."""
+    import numpy as np
+
+    from bloombee_tpu.wire.tensor_codec import (
+        deserialize_tensor,
+        reset_transport_stats,
+        serialize_tensor,
+        transport_stats,
+    )
+
+    reset_transport_stats()
+    big = np.zeros((256, 256), np.float32)  # compressible, above min size
+    small = np.ones((4,), np.float32)  # ships raw
+    for arr in (big, small):
+        meta, blob = serialize_tensor(arr)
+        out = deserialize_tensor(meta, blob)
+        np.testing.assert_array_equal(out, arr)
+    st = transport_stats()
+    assert st["tx"]["n"] == 2 and st["rx"]["n"] == 2
+    assert st["tx"]["compressed"] == 1  # only the big one
+    assert st["tx"]["raw_bytes"] == big.nbytes + small.nbytes
+    assert st["tx"]["wire_bytes"] < st["tx"]["raw_bytes"]
+    assert 0.0 < st["tx"]["ratio"] < 1.0
+    assert st["tx"]["s"] >= 0.0
