@@ -70,6 +70,10 @@ def _emit_json_locked():
             RESULTS.get("proxy_equiv_per_seq", 0.0), 2
         ),
         "ttft_ms": round(served.get("ttft_ms", 0.0), 1),
+        # the measured host<->device round-trip cost on this machine's
+        # tunnel-attached chip: the floor under per-seq served latency
+        # (production PCIe-attached v5e pays microseconds here)
+        "host_device_round_trip_ms": round(RESULTS.get("fence_ms", 0.0), 1),
     }
     if RESULTS.get("degraded"):
         out["degraded"] = RESULTS["degraded"]
@@ -225,6 +229,7 @@ def main():
         fence(h)
     fence_cost = (time.time() - t0) / 3
     log(f"fence cost: {fence_cost*1000:.1f} ms")
+    RESULTS["fence_ms"] = fence_cost * 1000.0
 
     # ---- fused decode: one jitted scan over per-step plans
     plans = []

@@ -201,7 +201,7 @@ class DistributedModelForCausalLM:
 
     def inference_session(
         self, max_length: int, batch_size: int = 1,
-        microbatch: int | None = None,
+        microbatch: int | str | None = None,
     ) -> InferenceSession:
         cfg = self.config
         return InferenceSession(

@@ -64,7 +64,7 @@ class InferenceSession:
         use_push: bool = True,
         max_retries: int = 3,
         step_timeout: float = 120.0,
-        microbatch: int | None = None,
+        microbatch: int | str | None = None,  # count or "auto"
         embed_fn=None,  # ids [B, T] -> hidden; enables token-id replay
         adapter: str | None = None,  # per-request LoRA adapter name
     ):

@@ -12,6 +12,15 @@ Covers the decode-attention role of the reference's fused kernels
 `mha_gen_llama`), built vLLM-paged-attention-style for the TPU memory
 hierarchy.
 
+Kernel layout note (Mosaic constraint): a block may not squeeze the
+second-to-last array dimension, so blocking one KV head at a time out of the
+[tokens, Hkv, hd] arena is not lowerable. Instead each grid step loads one
+whole page ACROSS heads as a [page_size*Hkv, hd] block (a free reshape of
+the arena) and computes every query head against every row in ONE MXU
+matmul; rows belonging to a different KV-head group are masked off in the
+logits. Decode attention is HBM-bandwidth-bound — the x Hkv extra FLOPs are
+noise, and the bytes read are exactly one pass over the context.
+
 Scope: single-token decode (T=1) with standard causal semantics —
 per-sequence lengths may differ (masked per page), and sliding windows are
 supported (the per-layer window arrives as a traced scalar; pages wholly
@@ -37,20 +46,24 @@ def _kernel(
     pt_ref,  # [B, NP] i32 scalar prefetch: logical page j of seq b
     lens_ref,  # [B] i32 scalar prefetch: context length per sequence
     win_ref,  # [1] i32 scalar prefetch: sliding window (0 = full attention)
-    q_ref,  # [G, hd] — the query heads of this kv head's group
-    k_ref,  # [page_size, hd] — current physical K page, this kv head
-    v_ref,  # [page_size, hd]
-    o_ref,  # [G, hd]
-    m_scr,  # [G, 1] f32
-    l_scr,  # [G, 1] f32
-    acc_scr,  # [G, hd] f32
+    q_ref,  # [H, hd] — every query head of this sequence
+    k_ref,  # [page_size * Hkv, hd] — current physical page, ALL kv heads
+    v_ref,  # [page_size * Hkv, hd]
+    o_ref,  # [H, hd]
+    m_scr,  # [H, 1] f32
+    l_scr,  # [H, 1] f32
+    acc_scr,  # [H, hd] f32
     *,
     scale: float,
     page_size: int,
     n_pages: int,
+    hkv: int,
+    g: int,  # query heads per kv head (H = hkv * g)
 ):
     b = pl.program_id(0)
-    j = pl.program_id(2)
+    j = pl.program_id(1)
+    h = hkv * g
+    rows = page_size * hkv
 
     @pl.when(j == 0)
     def _init():
@@ -66,11 +79,14 @@ def _kernel(
     # wholly below the window are skipped outright — for long contexts
     # that is most of them, which is the point of a sliding window.
     low = jnp.where(win > 0, jnp.maximum(length - win, 0), 0)
-    # logical token positions covered by page j; garbage pages (page-table
-    # padding) land entirely past `length` and mask to nothing
-    pos = j * page_size + jax.lax.broadcasted_iota(
-        jnp.int32, (1, page_size), 1
-    )
+    # block row r holds token (r // hkv) of the page for kv head (r % hkv)
+    # (row-major flatten of [page_size, Hkv]); query head i belongs to kv
+    # head i // g. Positions past `length` (page-table padding included)
+    # and off-group rows mask to NEG before the online-softmax max.
+    r = jax.lax.broadcasted_iota(jnp.int32, (h, rows), 1)
+    qh = jax.lax.broadcasted_iota(jnp.int32, (h, rows), 0)
+    pos = j * page_size + r // hkv
+    own = (r % hkv) == (qh // g)
     page_live = (j * page_size < length) & ((j + 1) * page_size > low)
 
     @pl.when(page_live)
@@ -81,14 +97,16 @@ def _kernel(
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [G, page_size]
-        mask = (pos < length) & (pos >= low)
+        )  # [H, page_size * Hkv]
+        mask = own & (pos < length) & (pos >= low)
         logits = jnp.where(mask, logits, NEG)
         m = m_scr[...]
         m_new = jnp.maximum(m, logits.max(axis=1, keepdims=True))
         p = jnp.exp(logits - m_new) * mask.astype(jnp.float32)
         corr = jnp.exp(m - m_new)
         l_scr[...] = l_scr[...] * corr + p.sum(axis=1, keepdims=True)
+        # off-group p entries are exactly zero, so contracting against ALL
+        # rows picks out each head's own V rows
         acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -130,13 +148,12 @@ def paged_decode_attention(
     if scale is None:
         scale = hd**-0.5
 
-    # query head i uses kv head i // g: group-major view [B, Hkv, G, hd]
-    qg = q.reshape(b, hkv, g, hd)
-    # arena as pages: [n_phys, page_size, Hkv, hd] (free reshape)
-    kp = k_slab.reshape(-1, page_size, hkv, hd)
-    vp = v_slab.reshape(-1, page_size, hkv, hd)
+    # arena as pages with heads folded into rows:
+    # [n_phys, page_size * Hkv, hd] (free reshape of the contiguous slab)
+    kp = k_slab.reshape(-1, page_size * hkv, hd)
+    vp = v_slab.reshape(-1, page_size * hkv, hd)
 
-    def kv_index(bi, hi, j, pt, ln, wn):
+    def kv_index(bi, j, pt, ln, wn):
         # out-of-window grid steps must not cost HBM bandwidth: clamp the
         # logical page to the first in-window page, so dead steps re-name
         # the same block and Pallas elides the duplicate DMA entirely
@@ -146,39 +163,37 @@ def paged_decode_attention(
             jnp.maximum(ln[bi] - wn[0], 0) // page_size,
             0,
         )
-        return (pt[bi, jnp.maximum(j, first)], 0, hi, 0)
+        return (pt[bi, jnp.maximum(j, first)], 0, 0)
 
-    grid = (b, hkv, n_pages)
+    grid = (b, n_pages)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=grid,
         in_specs=[
-            pl.BlockSpec(
-                (None, None, g, hd),
-                lambda bi, hi, j, pt, ln, wn: (bi, hi, 0, 0),
-            ),
-            pl.BlockSpec((None, page_size, None, hd), kv_index),
-            pl.BlockSpec((None, page_size, None, hd), kv_index),
+            pl.BlockSpec((None, h, hd), lambda bi, j, pt, ln, wn: (bi, 0, 0)),
+            pl.BlockSpec((None, page_size * hkv, hd), kv_index),
+            pl.BlockSpec((None, page_size * hkv, hd), kv_index),
         ],
         out_specs=pl.BlockSpec(
-            (None, None, g, hd), lambda bi, hi, j, pt, ln, wn: (bi, hi, 0, 0)
+            (None, h, hd), lambda bi, j, pt, ln, wn: (bi, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, hd), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, hd), jnp.float32),
         ],
     )
     win_arr = jnp.asarray(window, jnp.int32).reshape(1)
     out = pl.pallas_call(
         functools.partial(
-            _kernel, scale=scale, page_size=page_size, n_pages=n_pages
+            _kernel, scale=scale, page_size=page_size, n_pages=n_pages,
+            hkv=hkv, g=g,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, g, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
         interpret=interpret,
     )(
         page_table.astype(jnp.int32), lens.astype(jnp.int32), win_arr,
-        qg, kp, vp,
+        q, kp, vp,
     )
-    return out.reshape(b, h, hd)
+    return out

@@ -38,6 +38,12 @@ env.declare(
     "only unless BBTPU_PAGED_INTERPRET forces the interpreter (tests)",
 )
 env.declare(
+    "BBTPU_PAGED_MIN_CONTEXT", int, 512,
+    "use the paged decode kernel only when the bucketed context is at least "
+    "this many tokens (measured crossover vs the dense gather path on v5e: "
+    "dense wins at 256, paged wins 1k+ and is 1.5x at 4k)",
+)
+env.declare(
     "BBTPU_PAGED_INTERPRET", bool, False,
     "run the paged decode kernel in interpreter mode on non-TPU backends "
     "(CPU parity tests; far too slow for production)",
@@ -238,9 +244,12 @@ class SpanExecutor:
         # paged-kernel eligibility: plain single-token decode on a dense
         # arena (per-seq lens may differ — masked in-kernel, and sliding
         # windows ride the scan as a traced scalar, skipping out-of-window
-        # pages outright)
+        # pages outright). Short contexts stay on the dense path — the
+        # gather is cheap there and the kernel's page-granular grid costs
+        # more than it saves (measured crossover ~512 tokens).
         use_paged = bool(
             not getattr(self, "_paged_broken", False)
+            and pb * self.page_size >= env.get("BBTPU_PAGED_MIN_CONTEXT")
             and self.mesh is None  # Pallas kernels don't GSPMD-partition
             and not self.spec.heterogeneous
             and self.manager.quant is None

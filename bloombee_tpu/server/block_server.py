@@ -38,6 +38,7 @@ from bloombee_tpu.server.compute_queue import (
 )
 from bloombee_tpu.swarm.data import ServerInfo, ServerState
 from bloombee_tpu.utils import env
+from bloombee_tpu.wire.flow import FlowLimiter
 from bloombee_tpu.wire.rpc import Connection, RpcServer, Stream, connect
 from bloombee_tpu.wire.tensor_codec import name_for_dtype
 
@@ -104,6 +105,16 @@ class _PeerPool:
     def __init__(self):
         self._conns: dict[tuple[str, int], Connection] = {}
         self._locks: dict[tuple[str, int], asyncio.Lock] = {}
+        self._limiters: dict[tuple[str, int], FlowLimiter] = {}
+
+    def limiter(self, host: str, port: int) -> FlowLimiter:
+        """Per-peer adaptive push limiter (reference handler.py:255-370
+        AdaptivePushConcurrency role)."""
+        key = (host, port)
+        lim = self._limiters.get(key)
+        if lim is None:
+            lim = self._limiters[key] = FlowLimiter(name=f"{host}:{port}")
+        return lim
 
     async def get(self, host: str, port: int) -> Connection:
         key = (host, port)
@@ -691,7 +702,8 @@ class BlockServer:
             if tree_mask is not None:
                 push_tensors.append(tree_mask.astype(np.uint8))
             conn = await self.peers.get(nxt["host"], nxt["port"])
-            await conn.push("rpc_push", push_meta, push_tensors)
+            async with self.peers.limiter(nxt["host"], nxt["port"]).slot():
+                await conn.push("rpc_push", push_meta, push_tensors)
             # ack our own client stream so it can detect this hop succeeded
             await stream.send(
                 {"step": meta.get("step"), "ack": True, **timing_meta}

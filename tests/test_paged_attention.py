@@ -170,6 +170,9 @@ def test_span_decode_paged_kernel_matches_dense():
     async def run_one(paged: bool):
         os.environ["BBTPU_PAGED_ATTENTION"] = "1" if paged else "0"
         os.environ["BBTPU_PAGED_INTERPRET"] = "1"
+        # tiny test contexts sit below the production paged/dense
+        # crossover threshold; force the kernel on
+        os.environ["BBTPU_PAGED_MIN_CONTEXT"] = "0"
         try:
             manager = CacheManager(
                 num_layers=2, num_pages=16, page_size=16,
@@ -185,6 +188,7 @@ def test_span_decode_paged_kernel_matches_dense():
         finally:
             del os.environ["BBTPU_PAGED_ATTENTION"]
             del os.environ["BBTPU_PAGED_INTERPRET"]
+            del os.environ["BBTPU_PAGED_MIN_CONTEXT"]
 
     outs_paged = asyncio.run(run_one(True))
     outs_dense = asyncio.run(run_one(False))
@@ -231,6 +235,9 @@ def test_span_decode_paged_kernel_sliding_windows():
     async def run_one(paged: bool):
         os.environ["BBTPU_PAGED_ATTENTION"] = "1" if paged else "0"
         os.environ["BBTPU_PAGED_INTERPRET"] = "1"
+        # tiny test contexts sit below the production paged/dense
+        # crossover threshold; force the kernel on
+        os.environ["BBTPU_PAGED_MIN_CONTEXT"] = "0"
         try:
             manager = CacheManager(
                 num_layers=2, num_pages=16, page_size=16,
@@ -247,8 +254,70 @@ def test_span_decode_paged_kernel_sliding_windows():
         finally:
             del os.environ["BBTPU_PAGED_ATTENTION"]
             del os.environ["BBTPU_PAGED_INTERPRET"]
+            del os.environ["BBTPU_PAGED_MIN_CONTEXT"]
 
     outs_paged = asyncio.run(run_one(True))
     outs_dense = asyncio.run(run_one(False))
     for got, want in zip(outs_paged, outs_dense):
         np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_paged_kernel_context_threshold():
+    """The executor engages the paged kernel only at/above
+    BBTPU_PAGED_MIN_CONTEXT (measured dense/paged crossover): long-context
+    decode calls it, short-context decode stays dense."""
+    import asyncio
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from bloombee_tpu.kv.cache_manager import CacheManager
+    from bloombee_tpu.models.llama.block import init_block_params
+    from bloombee_tpu.models.spec import ModelSpec
+    from bloombee_tpu.ops.pallas import paged_attention as pk
+    from bloombee_tpu.runtime.executor import SpanExecutor
+    from bloombee_tpu.utils.tree import stack_params
+
+    spec = ModelSpec(
+        family="llama", hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=64,
+        num_hidden_layers=2, vocab_size=64,
+    )
+    params = stack_params(
+        [init_block_params(jax.random.PRNGKey(i), spec, dtype=jnp.float32)
+         for i in range(2)]
+    )
+    rng = np.random.default_rng(0)
+    calls = []
+    orig = pk.paged_decode_attention
+
+    def spy(*a, **k):
+        calls.append(True)
+        return orig(*a, **k)
+
+    async def run(ctx):
+        manager = CacheManager(
+            num_layers=2, num_pages=80, page_size=16,
+            n_kv_heads=2, head_dim=64, dtype=jnp.float32,
+        )
+        ex = SpanExecutor(params, spec, manager, compute_dtype=jnp.float32,
+                          max_chunk_tokens=512)
+        async with manager.allocate(1, ctx + 4) as handle:
+            h = (rng.standard_normal((1, ctx, 64)) * 0.1).astype(np.float32)
+            ex.prefill(handle, h)
+            step = (rng.standard_normal((1, 1, 64)) * 0.1).astype(np.float32)
+            ex.decode(handle, step)
+
+    os.environ["BBTPU_PAGED_INTERPRET"] = "1"  # CPU backend
+    pk.paged_decode_attention = spy
+    try:
+        # default threshold is 512: a 600-token context buckets above it
+        asyncio.run(run(600))
+        assert calls, "kernel not engaged at long context"
+        calls.clear()
+        asyncio.run(run(24))  # buckets to 64 tokens, below 512
+        assert not calls, "kernel engaged below the crossover threshold"
+    finally:
+        pk.paged_decode_attention = orig
+        del os.environ["BBTPU_PAGED_INTERPRET"]

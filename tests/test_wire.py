@@ -214,3 +214,63 @@ def test_transport_stats_counters():
     assert st["tx"]["wire_bytes"] < st["tx"]["raw_bytes"]
     assert 0.0 < st["tx"]["ratio"] < 1.0
     assert st["tx"]["s"] >= 0.0
+
+
+def test_flow_limiter_adapts():
+    """The adaptive push limiter grows under queue pressure with fast sends,
+    shrinks under slow sends or failures, and stays within bounds."""
+    import asyncio
+
+    from bloombee_tpu.wire.flow import FlowLimiter
+
+    async def drive(lim, n, send_s=0.0, fail=False, waiters=1):
+        async def one():
+            try:
+                async with lim.slot():
+                    if send_s:
+                        await asyncio.sleep(send_s)
+                    if fail:
+                        raise OSError("boom")
+            except OSError:
+                pass
+
+        for _ in range(n):
+            await asyncio.gather(*[one() for _ in range(waiters)])
+
+    async def run():
+        # queue pressure with instant sends -> limit grows
+        lim = FlowLimiter(initial=1, decide_every=4, wait_up_ms=0.0)
+        await drive(lim, 16, waiters=4)
+        assert lim.limit > 1, lim.limit
+
+        # consecutive failures -> limit shrinks to the floor, never below
+        lim2 = FlowLimiter(initial=3, lo=1, decide_every=2)
+        await drive(lim2, 32, fail=True)
+        assert lim2.limit == 1, lim2.limit
+
+        # slow sends with no waiters -> backpressure shrink
+        lim3 = FlowLimiter(
+            initial=4, decide_every=2, send_slow_ms=1.0
+        )
+        await drive(lim3, 8, send_s=0.005)
+        assert lim3.limit < 4, lim3.limit
+
+        # concurrent holders must not share timing state: a slow send
+        # overlapped by fast ones still registers as slow
+        lim4 = FlowLimiter(initial=4, decide_every=1000)
+
+        async def slow():
+            async with lim4.slot():
+                await asyncio.sleep(0.05)
+
+        async def fast():
+            await asyncio.sleep(0.01)  # start after slow() holds its slot
+            async with lim4.slot():
+                pass
+
+        await asyncio.gather(slow(), fast(), fast(), fast())
+        # EWMA saw one 50 ms sample among ~0 ms ones; with alpha=0.2 and
+        # the slow sample landing last it must remain clearly visible
+        assert lim4.ewma_send_ms > 5.0, lim4.ewma_send_ms
+
+    asyncio.run(run())
