@@ -70,6 +70,11 @@ def main(argv=None):
                         help="weight-only quantization for the served span "
                              "(int8 halves / int4 quarters weight HBM "
                              "bytes per decode step; compute stays bf16)")
+    parser.add_argument("--offload-layers", type=int, default=0,
+                        help="stream the span's last N layers' weights from "
+                             "host memory per step (serve spans larger than "
+                             "HBM; pair with --weight-quant to shrink the "
+                             "streamed bytes)")
     parser.add_argument("--kv-quant", default=None,
                         choices=["none", "int4"],
                         help="KV cache quantization (int4 = ~3.2x capacity)")
@@ -138,6 +143,7 @@ def main(argv=None):
             weight_quant=args.weight_quant,
             oversubscribe=args.oversubscribe,
             idle_park_s=args.idle_park_s,
+            offload_layers=args.offload_layers,
         )
         await server.start()
         if args.warmup_batches:

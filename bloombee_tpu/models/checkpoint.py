@@ -129,6 +129,50 @@ def load_span_params(
     return stack_params(layers), spec
 
 
+def load_span_params_split(
+    model_dir: str, start: int, end: int, resident: int, dtype=None,
+    adapter_dirs: list[str] | None = None, weight_quant: str | None = None,
+):
+    """Weight-offload loader: returns (stacked_prefix, host_layers, spec).
+
+    The first `resident` layers stack on device as usual; the remaining
+    layers are pulled back to HOST memory (numpy pytrees) one at a time —
+    the span's device footprint never exceeds the prefix plus one layer, so
+    a server can serve a span larger than its HBM (reference FlexGen Policy
+    weight percentages). `weight_quant` quantizes every layer (int8 halves
+    / int4 quarters the host->device bytes streamed per step — the main
+    lever on offloaded decode speed)."""
+    import jax
+
+    from bloombee_tpu.models import wquant
+    from bloombee_tpu.models.auto import get_family
+    from bloombee_tpu.utils.tree import stack_params
+
+    reader = CheckpointReader(model_dir)
+    family = get_family(reader.model_type())
+    spec = family.spec_from_config_dict(reader.config)
+    if spec.heterogeneous:
+        raise ValueError("weight offload + heterogeneous spans unsupported")
+    adapters = [LoraAdapter(d) for d in (adapter_dirs or [])]
+    bits = {"int8": 8, "int4": 4}.get(weight_quant or "")
+    prefix, host = [], []
+    for i in range(start, end):
+        params = family.load_block_params(reader, i, dtype=dtype)
+        for adapter in adapters:
+            params = adapter.merge_into(params, i)
+        if bits:
+            # per-layer dict leaves are [in, out]; quantize via a 1-stack so
+            # the eligibility check (stacked ndim>=3) applies unchanged
+            one = wquant.quantize_span_params(stack_params([params]), bits)
+            params = jax.tree.map(lambda x: x[0], one)
+        if i - start < resident:
+            prefix.append(params)
+        else:
+            host.append(jax.device_get(params))
+    stacked = stack_params(prefix) if prefix else None
+    return stacked, host, spec
+
+
 class LoraAdapter:
     """A PEFT-format LoRA adapter directory (adapter_config.json +
     adapter_model.safetensors)."""

@@ -73,8 +73,43 @@ class SpanExecutor:
         start_block: int = 0,
         mesh=None,  # jax.sharding.Mesh with a "tp" axis: TP-sharded serving
         adapters: dict[str, dict] | None = None,  # name -> stacked factors
+        host_layers: list | None = None,  # weight-offload: per-layer host
+        # param pytrees for the span's LAST len(host_layers) layers; they
+        # stream to the device per step with one-ahead prefetch (reference
+        # FlexGen Policy weight percentages / convert_block.py
+        # PipelineParallelWrapper pre-forward H2D)
     ):
         self.mesh = mesh
+        self.host_layers = list(host_layers or [])
+        self.resident = manager.num_layers - len(self.host_layers)
+        if self.host_layers:
+            if spec.heterogeneous:
+                raise ValueError(
+                    "weight offload + heterogeneous head_dim spans not "
+                    "supported together"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "weight offload + TP serving not supported together"
+                )
+            if manager.quant is not None:
+                raise ValueError(
+                    "weight offload + quantized KV arena not supported "
+                    "together"
+                )
+            if self.resident < 0:
+                raise ValueError(
+                    f"{len(self.host_layers)} host layers > "
+                    f"{manager.num_layers} span layers"
+                )
+            lead = jax.tree.leaves(stacked_params)[0].shape[0] if (
+                self.resident > 0
+            ) else 0
+            if self.resident and lead != self.resident:
+                raise ValueError(
+                    f"resident params stack has {lead} layers, expected "
+                    f"{self.resident}"
+                )
         if spec.heterogeneous and mesh is not None:
             raise ValueError(
                 "TP serving + heterogeneous head_dim not supported together"
@@ -171,6 +206,75 @@ class SpanExecutor:
         """Materialize a fetch=False result on host in the wire dtype
         (blocks on the device round trip — call off the compute queue)."""
         return np.asarray(out).astype(self.transfer_dtype)
+
+    def _run_offloaded(
+        self, h_pad, slots_pad, pt_pad, positions, lens_pad, layer_active,
+        tm_pad, lora, bb, tb, pb, use_flash, use_paged,
+    ):
+        """Weight-offload step: scan the device-resident prefix, then stream
+        each offloaded layer's params host->device with ONE-AHEAD prefetch
+        (jax transfers are async, so layer l+1's H2D copy overlaps layer l's
+        compute — the copy-engine overlap of the reference's
+        PipelineParallelWrapper pre-forward H2D, convert_block.py:138-263).
+        The arena never leaves the device; each layer_step updates its slab
+        in place via donation."""
+        from bloombee_tpu.runtime.step import layer_step
+
+        ak, av = self.manager.arena["k"], self.manager.arena["v"]
+        resident = self.resident
+        tm_dev = jnp.asarray(tm_pad) if tm_pad is not None else None
+        use_tm = tm_pad is not None
+
+        la_res = layer_active[:resident].copy()
+        if resident and la_res.any():
+            plan_res = pack_plan(
+                slots_pad, pt_pad, positions, lens_pad, la_res
+            )
+            lora_res = (
+                jax.tree.map(lambda x: x[:resident], lora)
+                if lora is not None else None
+            )
+            hidden, ak, av = span_step_packed(
+                self.params, ak, av,
+                jnp.asarray(pack_step_payload(h_pad, plan_res)), tm_dev,
+                lora_res,
+                spec=self.spec, b=bb, t=tb, page_size=self.page_size,
+                max_pages=pb, use_tree_mask=use_tm,
+                windows=self.windows[:resident], use_flash=use_flash,
+                use_paged=use_paged, resident=resident,
+            )
+        else:
+            hidden = jnp.asarray(h_pad)
+
+        idxs = [
+            l for l in range(resident, self.manager.num_layers)
+            if layer_active[l]
+        ]
+        if not idxs:
+            return hidden, ak, av
+        plan1 = jnp.asarray(
+            pack_plan(
+                slots_pad, pt_pad, positions, lens_pad,
+                np.ones((1,), np.int32),
+            )
+        )
+        nxt = jax.device_put(self.host_layers[idxs[0] - resident])
+        for i, l in enumerate(idxs):
+            cur, nxt = nxt, (
+                jax.device_put(self.host_layers[idxs[i + 1] - resident])
+                if i + 1 < len(idxs) else None
+            )
+            lora_l = (
+                jax.tree.map(lambda x: x[l], lora)
+                if lora is not None else None
+            )
+            hidden, ak, av = layer_step(
+                cur, ak, av, hidden, plan1, jnp.int32(l), tm_dev, lora_l,
+                spec=self.spec, page_size=self.page_size, max_pages=pb,
+                use_tree_mask=use_tm, window=int(self.windows[l]),
+                use_flash=use_flash, use_paged=use_paged,
+            )
+        return hidden, ak, av
 
     # --------------------------------------------------------------- internals
     def _step(
@@ -289,10 +393,38 @@ class SpanExecutor:
         )
 
         arena = self.manager.arena
-        payload = pack_step_payload(h_pad, plan)
-        if self.spec.heterogeneous:
+        if self.host_layers:
+            def _run_off(use_paged_now: bool):
+                return self._run_offloaded(
+                    h_pad, slots_pad, pt_pad, positions, lens_pad,
+                    layer_active, tm_pad, lora, bb, tb, pb, use_flash,
+                    use_paged_now,
+                )
+
+            try:
+                out, new_k, new_v = _run_off(use_paged)
+            except Exception:
+                # same self-heal contract as the dense branch below: retry
+                # on the gather path only if the donated arena buffers are
+                # still alive (a compile failure surfaces before donation
+                # consumes them; a mid-chain runtime failure does not)
+                if not use_paged or any(
+                    getattr(a, "is_deleted", lambda: False)()
+                    for a in (arena["k"], arena["v"])
+                ):
+                    raise
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "paged decode kernel failed in the offload path; "
+                    "retrying on the dense gather path"
+                )
+                out, new_k, new_v = _run_off(False)
+                self._paged_broken = True
+        elif self.spec.heterogeneous:
             from bloombee_tpu.runtime.hetero import span_step_hetero
 
+            payload = pack_step_payload(h_pad, plan)
             out, new_k, new_v = span_step_hetero(
                 self.params,
                 arena["k"],
@@ -309,6 +441,7 @@ class SpanExecutor:
                 layer_active=tuple(int(x) for x in layer_active),
             )
         else:
+            payload = pack_step_payload(h_pad, plan)
             if self.mesh is not None:
                 from bloombee_tpu.parallel import serving as tp_serving
 

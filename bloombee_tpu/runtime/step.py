@@ -112,23 +112,41 @@ def span_step_packed_impl(
     windows: tuple | None = None,
     use_flash: bool = False,
     use_paged: bool = False,
+    resident: int | None = None,
 ):
-    """span_step over a pack_step_payload buffer (one h2d per step)."""
+    """span_step over a pack_step_payload buffer (one h2d per step).
+
+    `resident` (weight-offload mode): the params stack covers only the
+    first `resident` of the arena's layers — scan over that prefix, write
+    the updated slabs back into the full donated arena, and leave the
+    offloaded layers' slabs untouched (they get their own layer_step calls
+    with host-streamed weights)."""
     hidden, plan = unpack_step_payload(payload, b, t, spec.hidden_size)
-    return span_step_impl(
-        stacked_params, arena_k, arena_v, hidden, plan, tree_mask,
-        lora=lora,
+    if resident is None:
+        return span_step_impl(
+            stacked_params, arena_k, arena_v, hidden, plan, tree_mask,
+            lora=lora,
+            spec=spec, page_size=page_size, max_pages=max_pages,
+            use_tree_mask=use_tree_mask, windows=windows, use_flash=use_flash,
+            use_paged=use_paged,
+        )
+    hidden, ak, av = span_step_impl(
+        stacked_params, arena_k[:resident], arena_v[:resident], hidden, plan,
+        tree_mask, lora=lora,
         spec=spec, page_size=page_size, max_pages=max_pages,
         use_tree_mask=use_tree_mask, windows=windows, use_flash=use_flash,
         use_paged=use_paged,
     )
+    arena_k = jax.lax.dynamic_update_slice_in_dim(arena_k, ak, 0, 0)
+    arena_v = jax.lax.dynamic_update_slice_in_dim(arena_v, av, 0, 0)
+    return hidden, arena_k, arena_v
 
 
 span_step_packed = functools.partial(
     jax.jit,
     static_argnames=(
         "spec", "b", "t", "page_size", "max_pages", "use_tree_mask",
-        "windows", "use_flash", "use_paged",
+        "windows", "use_flash", "use_paged", "resident",
     ),
     donate_argnames=("arena_k", "arena_v"),
 )(span_step_packed_impl)
@@ -227,3 +245,65 @@ span_step = functools.partial(
     ),
     donate_argnames=("arena_k", "arena_v"),
 )(span_step_impl)
+
+
+def layer_step_impl(
+    params_l: dict,  # ONE layer's params (no leading L dim)
+    arena_k: jax.Array,  # [L, S_tot, Hkv, hd] (donated; updated at layer_idx)
+    arena_v: jax.Array,
+    hidden: jax.Array,  # [B, T, D]
+    plan: jax.Array,  # packed with ONE layer_active entry
+    layer_idx: jax.Array,  # traced i32 scalar: which arena slab to touch
+    tree_mask: jax.Array | None = None,
+    lora_l: dict | None = None,
+    *,
+    spec: ModelSpec,
+    page_size: int,
+    max_pages: int,
+    use_tree_mask: bool = False,
+    window: int = 0,  # static per-layer window (<= 2 distinct compiles)
+    use_flash: bool = False,
+    use_paged: bool = False,
+):
+    """One layer of the span as its own compiled step — the unit of the
+    weight-offload path (reference FlexGen Policy weight percentages /
+    convert_block.py PipelineParallelWrapper pre-forward H2D): offloaded
+    layers' params arrive from host per step, so they can't ride the
+    resident stack's scan. The layer's K/V slab is read out of and written
+    back into the DONATED arena in place (dynamic_update_index aliases the
+    buffer), so the persistent KV state never leaves the device."""
+    b, t, _ = hidden.shape
+    slots, page_table, q_positions, total_lens, _ = unpack_plan(
+        plan, b, t, max_pages, 1
+    )
+    local = bool(
+        window > 0
+        and spec.rope_local_theta
+        and spec.rope_local_theta != spec.rope_theta
+    )
+    theta = spec.rope_local_theta if local else spec.rope_theta
+    cos, sin = rotary_cos_sin(q_positions, spec.head_dim, theta)
+    cos = cos.astype(hidden.dtype)
+    sin = sin.astype(hidden.dtype)
+    k_l = jax.lax.dynamic_index_in_dim(arena_k, layer_idx, 0, keepdims=False)
+    v_l = jax.lax.dynamic_index_in_dim(arena_v, layer_idx, 0, keepdims=False)
+    hidden, k_l, v_l = layer_body(
+        spec, page_size, hidden, params_l, k_l, v_l, cos, sin, slots,
+        page_table, q_positions, total_lens,
+        tree_mask if use_tree_mask else None,
+        jnp.int32(window),
+        use_flash=use_flash, use_paged=use_paged, lora=lora_l,
+    )
+    arena_k = jax.lax.dynamic_update_index_in_dim(arena_k, k_l, layer_idx, 0)
+    arena_v = jax.lax.dynamic_update_index_in_dim(arena_v, v_l, layer_idx, 0)
+    return hidden, arena_k, arena_v
+
+
+layer_step = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "spec", "page_size", "max_pages", "use_tree_mask", "window",
+        "use_flash", "use_paged",
+    ),
+    donate_argnames=("arena_k", "arena_v"),
+)(layer_step_impl)

@@ -160,14 +160,38 @@ class BlockServer:
         weight_quant: str | None = None,  # "int8"/"int4" -> quantized weights
         oversubscribe: float = 1.0,  # admit > capacity; park idle sessions
         idle_park_s: float = 5.0,  # a session this idle may be parked
+        offload_layers: int = 0,  # stream the span's last N layers' weights
+        # from host per step (FlexGen weight-offload: serve spans larger
+        # than HBM; combine with --weight-quant to shrink the streamed
+        # bytes 2-4x)
     ):
         self.model_dir = model_dir
-        if params is None:
+        if weight_quant is None:
+            weight_quant = env.get("BBTPU_WEIGHT_QUANT")
+        host_layers: list = []
+        if params is None and offload_layers > 0:
+            from bloombee_tpu.models.checkpoint import load_span_params_split
+
+            resident = max(0, (end - start) - offload_layers)
+            params, host_layers, spec = load_span_params_split(
+                model_dir, start, end, resident, dtype=compute_dtype,
+                adapter_dirs=adapter_dirs, weight_quant=(
+                    None if not weight_quant or weight_quant == "none"
+                    else weight_quant
+                ),
+            )
+            weight_quant = "none"  # already applied per layer
+        elif params is None:
             from bloombee_tpu.models.checkpoint import load_span_params
 
             params, spec = load_span_params(
                 model_dir, start, end, dtype=compute_dtype,
                 adapter_dirs=adapter_dirs,
+            )
+        elif offload_layers > 0:
+            raise ValueError(
+                "offload_layers needs model_dir loading (pre-built params "
+                "are already fully device-resident)"
             )
         assert spec is not None
         if weight_quant is None:
@@ -257,10 +281,12 @@ class BlockServer:
             start_block=start,
             mesh=mesh,
             adapters=self.adapter_factors,
+            host_layers=host_layers,
         )
         self.wire_dtype = name_for_dtype(self.executor.transfer_dtype)
-        if spec.heterogeneous:
-            self.training = None  # hetero training path not implemented
+        if spec.heterogeneous or host_layers:
+            # hetero / weight-offloaded spans: no dense training stack
+            self.training = None
         else:
             from bloombee_tpu.runtime.training import TrainingExecutor
 

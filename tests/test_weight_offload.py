@@ -1,0 +1,221 @@
+"""Weight offload: serve a span whose tail layers' weights live in HOST
+memory and stream to the device per step (reference FlexGen Policy weight
+percentages / convert_block.py PipelineParallelWrapper pre-forward H2D).
+
+The offloaded executor must be numerically identical to the fully-resident
+one — same arena, same paging, same windows, same adapters — and the e2e
+server path must still match HF logits.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bloombee_tpu.kv.cache_manager import CacheManager
+from bloombee_tpu.models.llama.block import init_block_params
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.runtime.executor import SpanExecutor
+from bloombee_tpu.utils.tree import stack_params, unstack_params
+
+
+def _spec(**kw):
+    base = dict(
+        family="llama", hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        num_hidden_layers=4, vocab_size=64,
+    )
+    base.update(kw)
+    return ModelSpec(**base)
+
+
+def _params(spec, n):
+    return stack_params(
+        [init_block_params(jax.random.PRNGKey(i), spec, dtype=jnp.float32)
+         for i in range(n)]
+    )
+
+
+def _manager(n):
+    return CacheManager(
+        num_layers=n, num_pages=32, page_size=4, n_kv_heads=2, head_dim=16,
+        dtype=jnp.float32,
+    )
+
+
+def _host_tail(stacked, n_layers, resident):
+    layers = unstack_params(stacked, n_layers)
+    prefix = stack_params(layers[:resident]) if resident else None
+    host = [jax.device_get(p) for p in layers[resident:]]
+    return prefix, host
+
+
+async def _drive(ex, manager, prefill, steps, layers=None, adapter=None):
+    outs = []
+    async with manager.allocate(prefill.shape[0], 64) as handle:
+        outs.append(
+            np.asarray(ex.prefill(handle, prefill, layers=layers,
+                                  adapter=adapter))
+        )
+        for s in steps:
+            outs.append(
+                np.asarray(ex.decode(handle, s, layers=layers,
+                                     adapter=adapter))
+            )
+    return outs
+
+
+@pytest.mark.parametrize("resident", [0, 2])
+def test_offload_matches_resident(resident):
+    spec = _spec()
+    stacked = _params(spec, 4)
+    rng = np.random.default_rng(0)
+    prefill = (rng.standard_normal((2, 9, 64)) * 0.1).astype(np.float32)
+    steps = [(rng.standard_normal((2, 1, 64)) * 0.1).astype(np.float32)
+             for _ in range(3)]
+
+    m1 = _manager(4)
+    full = SpanExecutor(stacked, spec, m1, compute_dtype=jnp.float32)
+    want = asyncio.run(_drive(full, m1, prefill, steps))
+
+    prefix, host = _host_tail(stacked, 4, resident)
+    m2 = _manager(4)
+    off = SpanExecutor(prefix, spec, m2, compute_dtype=jnp.float32,
+                       host_layers=host)
+    got = asyncio.run(_drive(off, m2, prefill, steps))
+
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_offload_sliding_windows_and_subspan():
+    """Per-layer sliding windows ride the per-layer steps (gemma-style
+    alternating layers), and session sub-span gating skips offloaded
+    layers host-side."""
+    spec = _spec(
+        sliding_window=4,
+        layer_types=("sliding", "full", "sliding", "full"),
+    )
+    stacked = _params(spec, 4)
+    rng = np.random.default_rng(1)
+    prefill = (rng.standard_normal((1, 7, 64)) * 0.1).astype(np.float32)
+    steps = [(rng.standard_normal((1, 1, 64)) * 0.1).astype(np.float32)
+             for _ in range(2)]
+
+    for layers in (None, (1, 3)):
+        m1 = _manager(4)
+        full = SpanExecutor(stacked, spec, m1, compute_dtype=jnp.float32)
+        want = asyncio.run(_drive(full, m1, prefill, steps, layers=layers))
+        prefix, host = _host_tail(stacked, 4, 1)
+        m2 = _manager(4)
+        off = SpanExecutor(prefix, spec, m2, compute_dtype=jnp.float32,
+                           host_layers=host)
+        got = asyncio.run(_drive(off, m2, prefill, steps, layers=layers))
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_offload_quantized_weights():
+    """int8 weight quantization composes with offload: quantized resident
+    == quantized offloaded (identical codes stream from host)."""
+    from bloombee_tpu.models import wquant
+
+    spec = _spec()
+    stacked = wquant.quantize_span_params(_params(spec, 4), 8)
+    rng = np.random.default_rng(2)
+    prefill = (rng.standard_normal((2, 5, 64)) * 0.1).astype(np.float32)
+    steps = [(rng.standard_normal((2, 1, 64)) * 0.1).astype(np.float32)]
+
+    m1 = _manager(4)
+    full = SpanExecutor(stacked, spec, m1, compute_dtype=jnp.float32)
+    want = asyncio.run(_drive(full, m1, prefill, steps))
+    prefix, host = _host_tail(stacked, 4, 2)
+    m2 = _manager(4)
+    off = SpanExecutor(prefix, spec, m2, compute_dtype=jnp.float32,
+                       host_layers=host)
+    got = asyncio.run(_drive(off, m2, prefill, steps))
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_offload_with_adapter():
+    """Per-request LoRA applies identically on offloaded layers (factors
+    slice per layer and ride the stream)."""
+    spec = _spec()
+    stacked = _params(spec, 4)
+    rng = np.random.default_rng(3)
+    lora = {
+        "q_proj": {
+            "a": jnp.asarray(rng.standard_normal((4, 64, 4)) * 0.05,
+                             jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((4, 4, 64)) * 0.05,
+                             jnp.float32),
+        }
+    }
+    prefill = (rng.standard_normal((1, 6, 64)) * 0.1).astype(np.float32)
+    steps = [(rng.standard_normal((1, 1, 64)) * 0.1).astype(np.float32)]
+
+    m1 = _manager(4)
+    full = SpanExecutor(stacked, spec, m1, compute_dtype=jnp.float32,
+                        adapters={"t": lora})
+    want = asyncio.run(_drive(full, m1, prefill, steps, adapter="t"))
+    prefix, host = _host_tail(stacked, 4, 2)
+    m2 = _manager(4)
+    off = SpanExecutor(prefix, spec, m2, compute_dtype=jnp.float32,
+                       adapters={"t": lora}, host_layers=host)
+    got = asyncio.run(_drive(off, m2, prefill, steps, adapter="t"))
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_offload_e2e_matches_hf(tmp_path):
+    """A BlockServer with offload_layers serves HF-exact logits through the
+    full swarm path (registry + wire + client)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "m")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        server = BlockServer(
+            model_uid="m", start=0, end=3, model_dir=d,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=32, page_size=4,
+            offload_layers=2,
+        )
+        assert server.executor.resident == 1
+        assert len(server.executor.host_layers) == 2
+        await server.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, RegistryClient("127.0.0.1", reg.port), model_uid="m"
+        )
+        input_ids = np.arange(8)[None, :]
+        out = await model.generate(input_ids, max_new_tokens=4)
+        await server.stop()
+        await reg.stop()
+        return out
+
+    out = asyncio.run(run())
+    with torch.no_grad():
+        ref = hf.generate(
+            torch.tensor(np.arange(8)[None, :]), max_new_tokens=4,
+            do_sample=False,
+        ).numpy()
+    np.testing.assert_array_equal(out, ref)
