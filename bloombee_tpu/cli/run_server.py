@@ -70,6 +70,10 @@ def main(argv=None):
                         help="weight-only quantization for the served span "
                              "(int8 halves / int4 quarters weight HBM "
                              "bytes per decode step; compute stays bf16)")
+    parser.add_argument("--attn-sparsity", type=float, default=1.0,
+                        help="<1.0: approximate decode attention keeping "
+                             "only the top fraction of past keys per query "
+                             "(FlexGen Policy.attn_sparsity)")
     parser.add_argument("--offload-layers", type=int, default=0,
                         help="stream the span's last N layers' weights from "
                              "host memory per step (serve spans larger than "
@@ -144,6 +148,7 @@ def main(argv=None):
             oversubscribe=args.oversubscribe,
             idle_park_s=args.idle_park_s,
             offload_layers=args.offload_layers,
+            attn_sparsity=args.attn_sparsity,
         )
         await server.start()
         if args.warmup_batches:

@@ -78,7 +78,14 @@ class SpanExecutor:
         # stream to the device per step with one-ahead prefetch (reference
         # FlexGen Policy weight percentages / convert_block.py
         # PipelineParallelWrapper pre-forward H2D)
+        attn_sparsity: float = 1.0,  # <1: keep only the top
+        # attn_sparsity*(S-1) past keys per query plus the newest token
+        # (reference FlexGen Policy.attn_sparsity,
+        # pytorch_backend.py:564-638); approximate — dense path only
     ):
+        if not 0.0 < attn_sparsity <= 1.0:
+            raise ValueError(f"attn_sparsity in (0, 1], got {attn_sparsity}")
+        self.attn_sparsity = float(attn_sparsity)
         self.mesh = mesh
         self.host_layers = list(host_layers or [])
         self.resident = manager.num_layers - len(self.host_layers)
@@ -117,6 +124,11 @@ class SpanExecutor:
         if spec.heterogeneous and adapters:
             raise ValueError(
                 "per-request adapters + heterogeneous head_dim spans not "
+                "supported together"
+            )
+        if spec.heterogeneous and attn_sparsity < 1.0:
+            raise ValueError(
+                "attn_sparsity + heterogeneous head_dim spans not "
                 "supported together"
             )
         if mesh is not None:
@@ -209,7 +221,7 @@ class SpanExecutor:
 
     def _run_offloaded(
         self, h_pad, slots_pad, pt_pad, positions, lens_pad, layer_active,
-        tm_pad, lora, bb, tb, pb, use_flash, use_paged,
+        tm_pad, lora, bb, tb, pb, use_flash, use_paged, attn_topk=0,
     ):
         """Weight-offload step: scan the device-resident prefix, then stream
         each offloaded layer's params host->device with ONE-AHEAD prefetch
@@ -241,7 +253,7 @@ class SpanExecutor:
                 spec=self.spec, b=bb, t=tb, page_size=self.page_size,
                 max_pages=pb, use_tree_mask=use_tm,
                 windows=self.windows[:resident], use_flash=use_flash,
-                use_paged=use_paged, resident=resident,
+                use_paged=use_paged, resident=resident, attn_topk=attn_topk,
             )
         else:
             hidden = jnp.asarray(h_pad)
@@ -273,6 +285,7 @@ class SpanExecutor:
                 spec=self.spec, page_size=self.page_size, max_pages=pb,
                 use_tree_mask=use_tm, window=int(self.windows[l]),
                 use_flash=use_flash, use_paged=use_paged,
+                attn_topk=attn_topk,
             )
         return hidden, ak, av
 
@@ -353,6 +366,7 @@ class SpanExecutor:
         # more than it saves (measured crossover ~512 tokens).
         use_paged = bool(
             not getattr(self, "_paged_broken", False)
+            and self.attn_sparsity >= 1.0  # kernel has no top-k path
             and pb * self.page_size >= env.get("BBTPU_PAGED_MIN_CONTEXT")
             and self.mesh is None  # Pallas kernels don't GSPMD-partition
             and not self.spec.heterogeneous
@@ -373,6 +387,7 @@ class SpanExecutor:
         s_ctx = pb * self.page_size
         use_flash = bool(
             self.mesh is None  # Pallas kernels don't GSPMD-partition
+            # (attn_sparsity is decode-only, so flash PREFILL is unaffected)
             and not self.spec.heterogeneous
             and tree_mask is None
             and tb >= 128
@@ -392,13 +407,21 @@ class SpanExecutor:
             )
         )
 
+        attn_topk = 0
+        if self.attn_sparsity < 1.0 and tb == 1 and tree_mask is None:
+            # decode-only approximation (FlexGen applies sparsity at
+            # generation only): sparsifying prefill would corrupt the
+            # cached context every layer feeds the next
+            s_ctx_b = pb * self.page_size
+            attn_topk = max(1, int(self.attn_sparsity * (s_ctx_b - 1)))
+
         arena = self.manager.arena
         if self.host_layers:
             def _run_off(use_paged_now: bool):
                 return self._run_offloaded(
                     h_pad, slots_pad, pt_pad, positions, lens_pad,
                     layer_active, tm_pad, lora, bb, tb, pb, use_flash,
-                    use_paged_now,
+                    use_paged_now, attn_topk,
                 )
 
             try:
@@ -464,6 +487,7 @@ class SpanExecutor:
                     payload_dev,
                     tm_dev,
                     lora,
+                    attn_topk=attn_topk,
                     spec=spec,
                     b=bb,
                     t=tb,

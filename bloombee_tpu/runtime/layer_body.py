@@ -114,6 +114,10 @@ def attend_paged(
     total_lens: jax.Array,  # [B]
     tree_mask: jax.Array | None,
     window,  # traced int32 scalar; 0 = full attention
+    attn_topk: int = 0,  # >0: keep only the top-k keys per query (FlexGen
+    # Policy.attn_sparsity, pytorch_backend.py:564-638 _sparse_attention_value
+    # — there the top-k of past weights plus the newest token; here the
+    # equivalent pre-softmax mask, so kept weights renormalize)
 ) -> jax.Array:
     b, t = q.shape[:2]
     s = k_ctx.shape[1]
@@ -147,6 +151,10 @@ def attend_paged(
         slopes = jnp.asarray(alibi_slopes(spec.num_attention_heads))
         logits = logits + slopes[None, :, None, None] * key_pos[:, :, None, :].astype(jnp.float32)
     logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    if attn_topk and attn_topk < s:
+        kth = jax.lax.top_k(logits, attn_topk)[0][..., -1:]  # [B,H,T,1]
+        own = (key_pos == q_pos)[:, None, :, :]  # the newest token survives
+        logits = jnp.where((logits >= kth) | own, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bshd->bthd", probs, v_r)
 
@@ -169,6 +177,8 @@ def layer_body(
     use_flash: bool = False,  # static: executor's shape heuristic said yes
     use_paged: bool = False,  # static: T=1 decode via the paged kernel
     lora: dict | None = None,  # this layer's per-request LoRA factors
+    attn_topk: int = 0,  # sparse attention (executor disables the Pallas
+    # kernels when this is on)
 ):
     b, t, d = hidden.shape
     h_heads, kv_heads, hd = (
@@ -237,7 +247,8 @@ def layer_body(
         )
     else:
         attn = attend_paged(
-            spec, q, k_ctx, v_ctx, q_positions, total_lens, tree_mask, window
+            spec, q, k_ctx, v_ctx, q_positions, total_lens, tree_mask,
+            window, attn_topk,
         )
     attn_out = _proj(attn.reshape(b, t, h_heads * hd), params, "o_proj", lora)
     return _finish_layer(

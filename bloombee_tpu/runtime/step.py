@@ -113,6 +113,7 @@ def span_step_packed_impl(
     use_flash: bool = False,
     use_paged: bool = False,
     resident: int | None = None,
+    attn_topk: int = 0,
 ):
     """span_step over a pack_step_payload buffer (one h2d per step).
 
@@ -128,14 +129,14 @@ def span_step_packed_impl(
             lora=lora,
             spec=spec, page_size=page_size, max_pages=max_pages,
             use_tree_mask=use_tree_mask, windows=windows, use_flash=use_flash,
-            use_paged=use_paged,
+            use_paged=use_paged, attn_topk=attn_topk,
         )
     hidden, ak, av = span_step_impl(
         stacked_params, arena_k[:resident], arena_v[:resident], hidden, plan,
         tree_mask, lora=lora,
         spec=spec, page_size=page_size, max_pages=max_pages,
         use_tree_mask=use_tree_mask, windows=windows, use_flash=use_flash,
-        use_paged=use_paged,
+        use_paged=use_paged, attn_topk=attn_topk,
     )
     arena_k = jax.lax.dynamic_update_slice_in_dim(arena_k, ak, 0, 0)
     arena_v = jax.lax.dynamic_update_slice_in_dim(arena_v, av, 0, 0)
@@ -146,7 +147,7 @@ span_step_packed = functools.partial(
     jax.jit,
     static_argnames=(
         "spec", "b", "t", "page_size", "max_pages", "use_tree_mask",
-        "windows", "use_flash", "use_paged", "resident",
+        "windows", "use_flash", "use_paged", "resident", "attn_topk",
     ),
     donate_argnames=("arena_k", "arena_v"),
 )(span_step_packed_impl)
@@ -169,6 +170,7 @@ def span_step_impl(
     windows: tuple | None = None,
     use_flash: bool = False,
     use_paged: bool = False,
+    attn_topk: int = 0,
 ):
     """Run all local blocks over one step; returns (hidden, arena_k, arena_v).
 
@@ -225,6 +227,7 @@ def span_step_impl(
                 spec, page_size, h, params_l, k_l, v_l, cos_l, sin_l, slots,
                 page_table, q_positions, total_lens, tm, window_l,
                 use_flash=use_flash, use_paged=use_paged, lora=lora_l,
+                attn_topk=attn_topk,
             )
 
         def skip(h, k_l, v_l):
@@ -241,7 +244,7 @@ span_step = functools.partial(
     jax.jit,
     static_argnames=(
         "spec", "page_size", "max_pages", "use_tree_mask", "windows",
-        "use_flash", "use_paged",
+        "use_flash", "use_paged", "attn_topk",
     ),
     donate_argnames=("arena_k", "arena_v"),
 )(span_step_impl)
@@ -264,6 +267,7 @@ def layer_step_impl(
     window: int = 0,  # static per-layer window (<= 2 distinct compiles)
     use_flash: bool = False,
     use_paged: bool = False,
+    attn_topk: int = 0,
 ):
     """One layer of the span as its own compiled step — the unit of the
     weight-offload path (reference FlexGen Policy weight percentages /
@@ -293,6 +297,7 @@ def layer_step_impl(
         tree_mask if use_tree_mask else None,
         jnp.int32(window),
         use_flash=use_flash, use_paged=use_paged, lora=lora_l,
+        attn_topk=attn_topk,
     )
     arena_k = jax.lax.dynamic_update_index_in_dim(arena_k, k_l, layer_idx, 0)
     arena_v = jax.lax.dynamic_update_index_in_dim(arena_v, v_l, layer_idx, 0)
@@ -303,7 +308,7 @@ layer_step = functools.partial(
     jax.jit,
     static_argnames=(
         "spec", "page_size", "max_pages", "use_tree_mask", "window",
-        "use_flash", "use_paged",
+        "use_flash", "use_paged", "attn_topk",
     ),
     donate_argnames=("arena_k", "arena_v"),
 )(layer_step_impl)

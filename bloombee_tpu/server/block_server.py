@@ -61,7 +61,13 @@ env.declare(
 env.declare(
     "BBTPU_PRUNER_CKPT", str, "",
     "pruner-head checkpoint path: loaded at init if present, saved every "
-    "50 train steps",
+    "50 train steps (the neural scorer uses a '.net' sidecar)",
+)
+env.declare(
+    "BBTPU_PRUNER_METHOD", str, "simple",
+    "draft-tree pruning strategy: 'simple' (probability threshold, "
+    "reference simple_probability_pruner) or 'neural' (learned MLP over "
+    "probability features, reference adaptive_neural_pruner)",
 )
 env.declare(
     "BBTPU_WEIGHT_QUANT", str, "none",
@@ -160,6 +166,7 @@ class BlockServer:
         weight_quant: str | None = None,  # "int8"/"int4" -> quantized weights
         oversubscribe: float = 1.0,  # admit > capacity; park idle sessions
         idle_park_s: float = 5.0,  # a session this idle may be parked
+        attn_sparsity: float = 1.0,  # <1: top-k sparse decode attention
         offload_layers: int = 0,  # stream the span's last N layers' weights
         # from host per step (FlexGen weight-offload: serve spans larger
         # than HBM; combine with --weight-quant to shrink the streamed
@@ -194,8 +201,6 @@ class BlockServer:
                 "are already fully device-resident)"
             )
         assert spec is not None
-        if weight_quant is None:
-            weight_quant = env.get("BBTPU_WEIGHT_QUANT")
         if weight_quant and weight_quant != "none":
             # weight-only quantization (reference compression.py's weight
             # half): decode reads every projection once per token, so int8
@@ -282,6 +287,7 @@ class BlockServer:
             mesh=mesh,
             adapters=self.adapter_factors,
             host_layers=host_layers,
+            attn_sparsity=attn_sparsity,
         )
         self.wire_dtype = name_for_dtype(self.executor.transfer_dtype)
         if spec.heterogeneous or host_layers:
@@ -856,7 +862,7 @@ class BlockServer:
         if mgr is None or getattr(mgr, "trainer", None) is None:
             session.last_tree = None
             return
-        hidden, tokens, _parents = session.last_tree
+        hidden, tokens, parents = session.last_tree
         session.last_tree = None
         feats, targets = [], []
         for i, acc in enumerate(accept):
@@ -874,6 +880,10 @@ class BlockServer:
         except Exception as e:
             logger.warning("pruner-head train step failed: %s", e)
             return
+        if getattr(mgr, "neural_trainer", None) is not None:
+            await self._train_neural_pruner(
+                mgr, hidden, tokens, parents, accept
+            )
         if env.log_channel_enabled("spec"):
             logger.info(
                 "[pruner-train] step=%d pairs=%d loss=%.3f",
@@ -885,6 +895,59 @@ class BlockServer:
                 await asyncio.to_thread(mgr.trainer.save, ckpt)
             except Exception as e:
                 logger.warning("pruner checkpoint save failed: %s", e)
+
+    async def _train_neural_pruner(self, mgr, hidden, tokens, parents,
+                                   accept):
+        """Online BCE training of the learned keep/prune scorer (reference
+        adaptive_neural_pruner collect_training_data): recompute each
+        row's probability features under the CURRENT head, label
+        accepted-path nodes 1 and drafted-but-rejected nodes 0."""
+        from bloombee_tpu.spec.pruner import node_features
+        from bloombee_tpu.spec.tree import DraftTree
+
+        def _build_and_train():
+            # device forward + O(T*V) feature loop both belong on the
+            # compute thread (the event loop must stay free for RPC and
+            # the liveness announce)
+            bsz, t = tokens.shape
+            all_probs = mgr._head.probs(
+                hidden.reshape(bsz * t, -1).astype(np.float32)
+            ).reshape(bsz, t, -1)
+            feat_rows, label_rows = [], []
+            for i, acc in enumerate(accept):
+                tree = DraftTree(tokens=tokens[i], parents=parents)
+                root = np.zeros(all_probs.shape[2], dtype=np.float64)
+                root[int(tokens[i, 0])] = 1.0
+                feat_rows.append(node_features(tree, all_probs[i], root))
+                lbl = np.zeros((t,), dtype=np.float32)
+                for node in np.asarray(acc).ravel():
+                    if 0 <= int(node) < t:
+                        lbl[int(node)] = 1.0
+                label_rows.append(lbl)
+            return mgr.neural_trainer.train_step(
+                np.concatenate(feat_rows), np.concatenate(label_rows)
+            )
+
+        try:
+            loss = await self.compute.submit(
+                PRIORITY_TRAINING, _build_and_train
+            )
+        except Exception as e:
+            logger.warning("neural pruner train step failed: %s", e)
+            return
+        if env.log_channel_enabled("spec"):
+            logger.info(
+                "[pruner-net-train] step=%d loss=%.3f",
+                mgr.neural_trainer.steps, loss,
+            )
+        ckpt = env.get("BBTPU_PRUNER_CKPT")
+        if ckpt and mgr.neural_trainer.steps % 50 == 0:
+            try:
+                await asyncio.to_thread(
+                    mgr.neural_trainer.save, f"{ckpt}.net"
+                )
+            except Exception as e:
+                logger.warning("neural pruner checkpoint save failed: %s", e)
 
     def _prune_tree(self, out: np.ndarray, prune: dict):
         """Per-row keep indices from the MidLMHead over this span's output
@@ -929,10 +992,38 @@ class BlockServer:
         try:
             import os
 
-            from bloombee_tpu.spec.pruner import MidHeadTrainer, PrunerManager
+            from bloombee_tpu.spec.pruner import (
+                MidHeadTrainer,
+                NeuralPrunerTrainer,
+                PrunerManager,
+            )
 
-            mgr = PrunerManager()
+            method = env.get("BBTPU_PRUNER_METHOD")
+            mgr = PrunerManager(method=method)
             ckpt = env.get("BBTPU_PRUNER_CKPT")
+            if method == "neural":
+                # the learned scorer has its own sidecar checkpoint
+                net_ckpt = f"{ckpt}.net" if ckpt else ""
+                import os as _os
+
+                if net_ckpt and _os.path.exists(
+                    MidHeadTrainer.ckpt_path(net_ckpt)
+                ):
+                    try:
+                        mgr.neural_trainer = NeuralPrunerTrainer.load(
+                            net_ckpt
+                        )
+                        mgr._pruner = mgr.neural_trainer.pruner
+                    except Exception as e:
+                        logger.warning(
+                            "neural pruner checkpoint unreadable (%s); "
+                            "fresh init", e,
+                        )
+                        mgr.neural_trainer = NeuralPrunerTrainer(mgr._pruner)
+                else:
+                    mgr.neural_trainer = NeuralPrunerTrainer(mgr._pruner)
+            else:
+                mgr.neural_trainer = None
             trainer = None
             if ckpt and os.path.exists(MidHeadTrainer.ckpt_path(ckpt)):
                 try:
@@ -969,7 +1060,7 @@ class BlockServer:
     def _ensure_pruner(self, threshold: float):
         if self._pruner_manager is None:
             return None
-        self._pruner_manager._pruner.threshold = threshold
+        self._pruner_manager.set_request_threshold(threshold)
         return self._pruner_manager
 
     async def _rpc_push(self, meta: dict, tensors) -> None:

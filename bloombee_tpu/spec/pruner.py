@@ -188,12 +188,29 @@ class MidHeadTrainer:
 
 
 class PrunerManager:
-    """Lazy-init + method dispatch (reference pruner_manager.py): owns the
-    MidLMHead and the active pruning strategy."""
+    """Lazy-init + method dispatch (reference pruner_manager.py +
+    pruner_factory.py): owns the MidLMHead and the active pruning strategy
+    ("simple" probability rule or the "neural" learned scorer)."""
 
-    def __init__(self, threshold: float = 0.05):
+    def __init__(self, threshold: float = 0.05, method: str = "simple",
+                 neural_params: dict | None = None):
         self._head: MidLMHead | None = None
-        self._pruner = SimpleProbabilityPruner(threshold=threshold)
+        self.method = method
+        if method == "neural":
+            self._pruner = AdaptiveNeuralPruner(
+                neural_params
+                if neural_params is not None else init_neural_params()
+            )
+        elif method == "simple":
+            self._pruner = SimpleProbabilityPruner(threshold=threshold)
+        else:
+            raise ValueError(f"unknown pruner method {method!r}")
+
+    def set_request_threshold(self, threshold: float) -> None:
+        """The wire threshold tunes the probability rule only; the neural
+        pruner's sigmoid cutoff is a server-side knob."""
+        if isinstance(self._pruner, SimpleProbabilityPruner):
+            self._pruner.threshold = threshold
 
     def ensure_head(
         self, lm_head_weight, norm=None, eps: float = 1e-5
@@ -218,3 +235,160 @@ class PrunerManager:
             np.concatenate([root_hidden[None], hidden], axis=0)
         )
         return self._pruner.keep_indices(tree, all_rows[1:], all_rows[0])
+
+
+def node_features(
+    tree: DraftTree, probs: np.ndarray, root_probs: np.ndarray
+) -> np.ndarray:
+    """Per-node probability features (reference adaptive_neural_pruner.py
+    `_compute_prob_features_batched`): from the PARENT's distribution at
+    each node — [max_prob, normalized_entropy, log_ratio(own token vs
+    max)]. Shape [T, 3] float32."""
+    t = tree.size
+    v = probs.shape[-1]
+    eps = 1e-9
+    feats = np.zeros((t, 3), dtype=np.float32)
+    log_v = np.log(v)
+    # siblings share a parent: compute each distinct parent distribution's
+    # (max, entropy) once, not once per child — the entropy pass is a full
+    # vocab sweep and this runs per row per speculative step
+    stats: dict[int, tuple[float, float]] = {}
+    for c in range(t):
+        parent = int(tree.parents[c])
+        dist = root_probs if parent < 0 else probs[parent]
+        if parent not in stats:
+            d64 = np.asarray(dist, np.float64)
+            stats[parent] = (
+                float(d64.max()),
+                float(-(d64 * np.log(d64 + eps)).sum()) / log_v,
+            )
+        mx, ent = stats[parent]
+        p_tok = float(dist[int(tree.tokens[c])])
+        feats[c] = (mx, ent, np.log((p_tok + eps) / (mx + eps)))
+    return feats
+
+
+def init_neural_params(seed: int = 0, hidden: int = 16) -> dict:
+    """Tiny keep/prune MLP (reference NodePruner quality path): 3 features
+    -> hidden -> 1 sigmoid score. The output bias starts positive so an
+    untrained net KEEPS nodes (prune aggressiveness must be learned, not
+    default)."""
+    rng = np.random.default_rng(seed)
+    return {
+        "w1": jnp.asarray(rng.normal(0, 0.5, (3, hidden)), jnp.float32),
+        "b1": jnp.zeros((hidden,), jnp.float32),
+        "w2": jnp.asarray(rng.normal(0, 0.5, (hidden, 1)), jnp.float32),
+        "b2": jnp.full((1,), 1.5, jnp.float32),
+    }
+
+
+@jax.jit
+def _neural_scores(params: dict, feats: jax.Array) -> jax.Array:
+    h = jnp.tanh(feats @ params["w1"] + params["b1"])
+    return jax.nn.sigmoid((h @ params["w2"] + params["b2"])[:, 0])
+
+
+@dataclasses.dataclass
+class AdaptiveNeuralPruner:
+    """MLP-scored pruning (reference adaptive_neural_pruner.py:41-519):
+    same keep_indices contract as SimpleProbabilityPruner, but the decision
+    comes from a learned score over probability features instead of a fixed
+    probability threshold. The sigmoid cutoff is the server's own knob —
+    the wire threshold (tuned for the probability rule) does not apply."""
+
+    params: dict
+    threshold: float = 0.5  # sigmoid cutoff
+    max_keep: int | None = None
+
+    def keep_indices(
+        self, tree: DraftTree, probs: np.ndarray, root_probs: np.ndarray
+    ) -> np.ndarray:
+        t = tree.size
+        feats = node_features(tree, probs, root_probs)
+        scores = np.asarray(_neural_scores(self.params, jnp.asarray(feats)))
+        keep = np.zeros(t, dtype=bool)
+        for c in range(t):
+            parent = int(tree.parents[c])
+            parent_ok = parent < 0 or keep[parent]
+            keep[c] = parent_ok and scores[c] >= self.threshold
+        if not keep.any():
+            # never prune the whole tree: keep the highest-scoring root
+            # child so generation always advances (reference pads with the
+            # best node)
+            roots = tree.children_of(-1)
+            if len(roots):
+                keep[int(roots[int(np.argmax(scores[roots]))])] = True
+        kept = np.nonzero(keep)[0]
+        cap = self.max_keep or t
+        if len(kept) > cap:
+            kept = kept[:cap]
+        out = np.full(cap, -1, dtype=np.int32)
+        out[: len(kept)] = kept
+        return out
+
+
+class NeuralPrunerTrainer:
+    """Online BCE training of the keep/prune MLP from accepts (reference
+    collect_training_data + train loop): accepted-path nodes are positives,
+    the rest of the drafted tree negatives."""
+
+    def __init__(self, pruner: AdaptiveNeuralPruner, lr: float = 5e-3):
+        self.pruner = pruner
+        self.lr = lr
+        self.steps = 0
+
+    @staticmethod
+    @jax.jit
+    def _step(params, lr, feats, labels, valid):
+        def loss_fn(p):
+            h = jnp.tanh(feats @ p["w1"] + p["b1"])
+            logits = (h @ p["w2"] + p["b2"])[:, 0]
+            per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+                jnp.exp(-jnp.abs(logits))
+            )
+            return (per * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        return jax.tree.map(lambda w, gw: w - lr * gw, params, g), loss
+
+    def train_step(self, feats: np.ndarray, labels: np.ndarray) -> float:
+        n = len(labels)
+        if n == 0:
+            return 0.0
+        from bloombee_tpu.runtime.executor import next_pow2
+
+        nb = next_pow2(n, floor=8)
+        f_pad = np.zeros((nb, 3), dtype=np.float32)
+        f_pad[:n] = feats
+        l_pad = np.zeros((nb,), dtype=np.float32)
+        l_pad[:n] = labels
+        v_pad = np.zeros((nb,), dtype=np.float32)
+        v_pad[:n] = 1.0
+        new, loss = self._step(
+            self.pruner.params, self.lr, jnp.asarray(f_pad),
+            jnp.asarray(l_pad), jnp.asarray(v_pad),
+        )
+        self.pruner.params = new
+        self.steps += 1
+        return float(loss)
+
+    def save(self, path: str) -> None:
+        import os
+
+        path = MidHeadTrainer.ckpt_path(path)
+        tmp = f"{path}.tmp.npz"
+        np.savez(
+            tmp, steps=self.steps,
+            **{k: np.asarray(v) for k, v in self.pruner.params.items()},
+        )
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str, lr: float = 5e-3) -> "NeuralPrunerTrainer":
+        data = np.load(MidHeadTrainer.ckpt_path(path))
+        params = {
+            k: jnp.asarray(data[k]) for k in ("w1", "b1", "w2", "b2")
+        }
+        trainer = cls(AdaptiveNeuralPruner(params), lr=lr)
+        trainer.steps = int(data["steps"])
+        return trainer

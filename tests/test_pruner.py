@@ -153,3 +153,155 @@ def test_e2e_pruner_online_training(tmp_path, monkeypatch):
         await reg.stop()
 
     asyncio.run(run())
+
+
+def test_neural_pruner_keeps_subtree_contract():
+    """Untrained neural pruner (positive output bias) keeps everything;
+    forcing the cutoff above 1 keeps exactly the best root child (the
+    never-empty guarantee); subtree propagation holds."""
+    from bloombee_tpu.spec.pruner import (
+        AdaptiveNeuralPruner,
+        init_neural_params,
+    )
+
+    tree = DraftTree(
+        tokens=np.asarray([1, 2, 3, 4]),
+        parents=np.asarray([-1, -1, 0, 1]),
+    )
+    vocab = 8
+    root = _probs(vocab, [{1: 0.9, 2: 0.01}])[0]
+    probs = _probs(vocab, [{3: 0.8}, {4: 0.9}, {}, {}])
+
+    pruner = AdaptiveNeuralPruner(init_neural_params())
+    kept = pruner.keep_indices(tree, probs, root)
+    assert set(kept[kept >= 0].tolist()) == {0, 1, 2, 3}  # fresh net keeps
+
+    pruner.threshold = 1.1  # impossible cutoff -> best-root-child fallback
+    kept = pruner.keep_indices(tree, probs, root)
+    kept_set = set(kept[kept >= 0].tolist())
+    assert len(kept_set) == 1 and kept_set <= {0, 1}
+
+
+def test_neural_pruner_learns_probability_rule(tmp_path):
+    """Online BCE training teaches the scorer to keep high-probability
+    nodes and drop low ones (labels mimic accepted paths), and the
+    checkpoint round-trips."""
+    from bloombee_tpu.spec.pruner import (
+        AdaptiveNeuralPruner,
+        NeuralPrunerTrainer,
+        init_neural_params,
+        node_features,
+    )
+
+    rng = np.random.default_rng(0)
+    vocab = 16
+    # synthetic nodes: feature = parent dist + own token; label = own
+    # conditional prob high
+    feats, labels = [], []
+    tree1 = DraftTree(tokens=np.asarray([1, 2]), parents=np.asarray([-1, -1]))
+    for _ in range(400):
+        p_good = rng.uniform(0.6, 0.95)
+        p_bad = rng.uniform(0.001, 0.05)
+        root = _probs(vocab, [{1: p_good, 2: p_bad}])[0]
+        f = node_features(tree1, np.zeros((2, vocab)), root)
+        feats.append(f)
+        labels.append(np.asarray([1.0, 0.0], np.float32))
+    feats = np.concatenate(feats)
+    labels = np.concatenate(labels)
+
+    pruner = AdaptiveNeuralPruner(init_neural_params())
+    trainer = NeuralPrunerTrainer(pruner, lr=0.05)
+    for i in range(0, len(labels), 64):
+        trainer.train_step(feats[i : i + 64], labels[i : i + 64])
+
+    # after training: a strong child survives, a weak one is pruned
+    root = _probs(vocab, [{1: 0.9, 2: 0.01}])[0]
+    kept = pruner.keep_indices(tree1, np.zeros((2, vocab)), root)
+    assert set(kept[kept >= 0].tolist()) == {0}
+
+    trainer.save(str(tmp_path / "net"))
+    loaded = NeuralPrunerTrainer.load(str(tmp_path / "net"))
+    assert loaded.steps == trainer.steps
+    kept2 = loaded.pruner.keep_indices(tree1, np.zeros((2, vocab)), root)
+    np.testing.assert_array_equal(kept2, kept)
+
+
+def test_e2e_neural_pruner_online_training(tmp_path, monkeypatch):
+    """BBTPU_PRUNER_METHOD=neural: the served pruned-spec path runs the
+    learned scorer and trains it online from accepts (greedy output stays
+    token-exact — greedy spec decode is exact under any pruner)."""
+    import asyncio
+
+    import torch
+    import jax.numpy as jnp
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.client.speculative import generate_speculative
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "m")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    monkeypatch.setenv("BBTPU_PRUNER_METHOD", "neural")
+    monkeypatch.setenv("BBTPU_PRUNER_TRAIN", "1")
+    monkeypatch.setenv("BBTPU_PRUNER_CKPT", str(tmp_path / "head"))
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        server = BlockServer(
+            model_uid="m", start=0, end=2, model_dir=d,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=256, page_size=4,
+        )
+        s2 = BlockServer(
+            model_uid="m", start=2, end=3, model_dir=d,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=256, page_size=4,
+        )
+        await server.start()
+        await s2.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, RegistryClient("127.0.0.1", reg.port), model_uid="m",
+            use_push=False,
+        )
+        drafter = GreedyTreeDrafter(
+            LocalJaxDraftModel.from_dir(d), branching=(2, 2)
+        )
+        input_ids = np.arange(6)[None, :]
+        out = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=8,
+            prune_threshold=0.45,
+        )
+        # let background training tasks drain
+        await asyncio.sleep(0.5)
+        mgr = server._pruner_manager
+        trained = (
+            mgr is not None
+            and getattr(mgr, "neural_trainer", None) is not None
+            and mgr.neural_trainer.steps > 0
+        )
+        await server.stop()
+        await s2.stop()
+        await reg.stop()
+        return out, trained
+
+    out, trained = asyncio.run(run())
+    with torch.no_grad():
+        ref = hf.generate(
+            torch.tensor(np.arange(6)[None, :]), max_new_tokens=8,
+            do_sample=False,
+        ).numpy()
+    np.testing.assert_array_equal(out, ref)
+    assert trained, "neural pruner saw no online training steps"
