@@ -24,14 +24,18 @@ noise, and the bytes read are exactly one pass over the context.
 Scope: single-token decode (T=1) with standard causal semantics —
 per-sequence lengths may differ (masked per page), and sliding windows are
 supported (the per-layer window arrives as a traced scalar; pages wholly
-below the window are skipped, DMA included, via an index-map clamp). Tree
-masks, ALiBi, logit soft-caps, and quantized arenas take the dense path
-(the executor checks eligibility host-side, like the flash prefill kernel).
+below the window are skipped, DMA included, via an index-map clamp).
+int4-quantized arenas run the `paged_decode_attention_int4` variant, which
+dequantizes pages in VMEM. Tree masks, ALiBi, and logit soft-caps take the
+dense path (the executor checks eligibility host-side, like the flash
+prefill kernel).
 """
 
 from __future__ import annotations
 
 import functools
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -42,24 +46,24 @@ from jax.experimental.pallas import tpu as pltpu
 NEG = -1e30
 
 
-def _kernel(
-    pt_ref,  # [B, NP] i32 scalar prefetch: logical page j of seq b
-    lens_ref,  # [B] i32 scalar prefetch: context length per sequence
-    win_ref,  # [1] i32 scalar prefetch: sliding window (0 = full attention)
-    q_ref,  # [H, hd] — every query head of this sequence
-    k_ref,  # [page_size * Hkv, hd] — current physical page, ALL kv heads
-    v_ref,  # [page_size * Hkv, hd]
-    o_ref,  # [H, hd]
-    m_scr,  # [H, 1] f32
-    l_scr,  # [H, 1] f32
-    acc_scr,  # [H, hd] f32
-    *,
-    scale: float,
-    page_size: int,
-    n_pages: int,
-    hkv: int,
-    g: int,  # query heads per kv head (H = hkv * g)
+def _online_softmax_body(
+    load_kv,  # () -> (k [rows, hd], v [rows, hd]) f32 for the current page
+    lens_ref, win_ref, q_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale, page_size, n_pages, hkv, g,
 ):
+    """The page-streaming online-softmax state machine shared by the dense
+    and int4 kernels (they differ ONLY in how a K/V page is materialized).
+
+    - block row r holds token (r // hkv) of the page for kv head (r % hkv)
+      (row-major flatten of [page_size, Hkv]); query head i belongs to kv
+      head i // g. Positions past `length` (page-table padding included)
+      and off-group rows mask to NEG before the online-softmax max.
+    - sliding window: the decode query sits at position length-1 and sees
+      keys in [length - win, length) (matching attend_paged's
+      `key_pos > q_pos - window`); win == 0 means full attention. Pages
+      wholly below the window are skipped outright — for long contexts
+      that is most of them, which is the point of a sliding window.
+    """
     b = pl.program_id(0)
     j = pl.program_id(1)
     h = hkv * g
@@ -73,16 +77,7 @@ def _kernel(
 
     length = lens_ref[b]
     win = win_ref[0]
-    # sliding window: the decode query sits at position length-1 and sees
-    # keys in [length - win, length) (matching attend_paged's
-    # `key_pos > q_pos - window`); win == 0 means full attention. Pages
-    # wholly below the window are skipped outright — for long contexts
-    # that is most of them, which is the point of a sliding window.
     low = jnp.where(win > 0, jnp.maximum(length - win, 0), 0)
-    # block row r holds token (r // hkv) of the page for kv head (r % hkv)
-    # (row-major flatten of [page_size, Hkv]); query head i belongs to kv
-    # head i // g. Positions past `length` (page-table padding included)
-    # and off-group rows mask to NEG before the online-softmax max.
     r = jax.lax.broadcasted_iota(jnp.int32, (h, rows), 1)
     qh = jax.lax.broadcasted_iota(jnp.int32, (h, rows), 0)
     pos = j * page_size + r // hkv
@@ -92,8 +87,7 @@ def _kernel(
     @pl.when(page_live)
     def _update():
         q = q_ref[...].astype(jnp.float32) * scale
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
+        k, v = load_kv()
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -120,6 +114,204 @@ def _kernel(
         o_ref[...] = (
             acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
         ).astype(o_ref.dtype)
+
+
+def _make_kv_index(page_size: int):
+    """Index map steering each grid step's K/V block to the right physical
+    page. Out-of-window grid steps must not cost HBM bandwidth: clamp the
+    logical page to the first in-window page, so dead steps re-name the
+    same block and Pallas elides the duplicate DMA entirely (their compute
+    is skipped by pl.when(page_live) in the kernel)."""
+
+    def kv_index(bi, j, pt, ln, wn):
+        first = jnp.where(
+            wn[0] > 0,
+            jnp.maximum(ln[bi] - wn[0], 0) // page_size,
+            0,
+        )
+        return (pt[bi, jnp.maximum(j, first)], 0, 0)
+
+    return kv_index
+
+
+def _kernel(
+    pt_ref,  # [B, NP] i32 scalar prefetch: logical page j of seq b
+    lens_ref,  # [B] i32 scalar prefetch: context length per sequence
+    win_ref,  # [1] i32 scalar prefetch: sliding window (0 = full attention)
+    q_ref,  # [H, hd] — every query head of this sequence
+    k_ref,  # [page_size * Hkv, hd] — current physical page, ALL kv heads
+    v_ref,  # [page_size * Hkv, hd]
+    o_ref,  # [H, hd]
+    m_scr,  # [H, 1] f32
+    l_scr,  # [H, 1] f32
+    acc_scr,  # [H, hd] f32
+    *,
+    scale: float,
+    page_size: int,
+    n_pages: int,
+    hkv: int,
+    g: int,  # query heads per kv head (H = hkv * g)
+):
+    def load_kv():
+        return k_ref[...].astype(jnp.float32), v_ref[...].astype(jnp.float32)
+
+    _online_softmax_body(
+        load_kv, lens_ref, win_ref, q_ref, o_ref, m_scr, l_scr, acc_scr,
+        scale=scale, page_size=page_size, n_pages=n_pages, hkv=hkv, g=g,
+    )
+
+
+def _int4_kernel(
+    pt_ref,  # [B, NP] i32 scalar prefetch
+    lens_ref,  # [B] i32
+    win_ref,  # [1] i32
+    q_ref,  # [H, hd] — PERMUTED head dim (evens then odds)
+    kc_ref,  # [page_size * Hkv, hd // 2] u8 int4 codes, current page
+    ks_ref,  # [page_size * Hkv, groups] f16 scales
+    kz_ref,  # [page_size * Hkv, groups] f16 zeros
+    vc_ref,
+    vs_ref,
+    vz_ref,
+    o_ref,  # [H, hd] — PERMUTED
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    page_size: int,
+    n_pages: int,
+    hkv: int,
+    g: int,
+    groups: int,
+):
+    """int4 variant of _kernel: the shared online-softmax body runs over
+    pages dequantized in VMEM (reference TorchCompressedDevice decompress,
+    compression.py:163-210). Nibble unpack avoids lane interleaving: low
+    nibbles are the EVEN original head positions and high nibbles the ODD
+    ones, so concat(lo, hi) is the dequantized row in a permuted head
+    order — the caller permutes q and un-permutes the output instead.
+    Group-wise scales stay compact: original group i covers permuted lanes
+    [i*gs/2, (i+1)*gs/2) in each half (evens of a contiguous group are
+    contiguous), so dequant is an unrolled per-group slice-scale-concat."""
+    half = kc_ref.shape[-1]
+    per = half // groups  # permuted lanes per original group, per half
+
+    def deq(codes_ref, s_ref, z_ref):
+        codes = codes_ref[...]
+        s = s_ref[...].astype(jnp.float32)
+        z = z_ref[...].astype(jnp.float32)
+        lo = (codes & 0xF).astype(jnp.float32)
+        hi = (codes >> 4).astype(jnp.float32)
+        halves = []
+        for nib in (lo, hi):
+            parts = [
+                nib[:, i * per : (i + 1) * per] * s[:, i : i + 1]
+                + z[:, i : i + 1]
+                for i in range(groups)
+            ]
+            halves.append(
+                parts[0] if len(parts) == 1
+                else jnp.concatenate(parts, axis=-1)
+            )
+        return jnp.concatenate(halves, axis=-1)  # [rows, hd] permuted
+
+    def load_kv():
+        return deq(kc_ref, ks_ref, kz_ref), deq(vc_ref, vs_ref, vz_ref)
+
+    _online_softmax_body(
+        load_kv, lens_ref, win_ref, q_ref, o_ref, m_scr, l_scr, acc_scr,
+        scale=scale, page_size=page_size, n_pages=n_pages, hkv=hkv, g=g,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "scale", "interpret"),
+)
+def paged_decode_attention_int4(
+    q: jax.Array,  # [B, H, hd]
+    k_slab,  # QuantSlab (codes [S_tot, Hkv, hd/2] u8, scale/zero f16)
+    v_slab,
+    page_table: jax.Array,
+    lens: jax.Array,
+    page_size: int,
+    scale: float | None = None,
+    interpret: bool = False,
+    window=0,
+) -> jax.Array:
+    """Paged decode attention straight off an int4-quantized arena: one HBM
+    pass over ~1/3 the bytes of the bf16 slab (codes + group scales), with
+    dequantization in VMEM."""
+    b, h, hd = q.shape
+    s_tot, hkv = k_slab.codes.shape[0], k_slab.codes.shape[1]
+    if h % hkv:
+        raise ValueError(f"H={h} must be a multiple of Hkv={hkv}")
+    if s_tot % page_size:
+        raise ValueError(f"arena slots {s_tot} % page_size {page_size}")
+    g = h // hkv
+    groups = k_slab.scale.shape[-1]
+    n_pages = page_table.shape[1]
+    if scale is None:
+        scale = hd**-0.5
+    rows = page_size * hkv
+
+    # permuted head order: evens then odds (see kernel docstring)
+    q_perm = jnp.concatenate([q[..., 0::2], q[..., 1::2]], axis=-1)
+
+    def pages(x, last):
+        return x.reshape(-1, rows, last)
+
+    kc, ks, kz = (
+        pages(k_slab.codes, hd // 2),
+        pages(k_slab.scale, groups),
+        pages(k_slab.zero, groups),
+    )
+    vc, vs, vz = (
+        pages(v_slab.codes, hd // 2),
+        pages(v_slab.scale, groups),
+        pages(v_slab.zero, groups),
+    )
+
+    kv_index = _make_kv_index(page_size)
+
+    def q_index(bi, j, pt, ln, wn):
+        return (bi, 0, 0)
+
+    kv_spec = lambda last: pl.BlockSpec((None, rows, last), kv_index)  # noqa: E731
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, n_pages),
+        in_specs=[
+            pl.BlockSpec((None, h, hd), q_index),
+            kv_spec(hd // 2), kv_spec(groups), kv_spec(groups),
+            kv_spec(hd // 2), kv_spec(groups), kv_spec(groups),
+        ],
+        out_specs=pl.BlockSpec((None, h, hd), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, hd), jnp.float32),
+        ],
+    )
+    win_arr = jnp.asarray(window, jnp.int32).reshape(1)
+    out = pl.pallas_call(
+        functools.partial(
+            _int4_kernel, scale=scale, page_size=page_size, n_pages=n_pages,
+            hkv=hkv, g=g, groups=groups,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
+        interpret=interpret,
+    )(
+        page_table.astype(jnp.int32), lens.astype(jnp.int32), win_arr,
+        q_perm, kc, ks, kz, vc, vs, vz,
+    )
+    # un-permute: permuted lane i < hd/2 holds original 2i; i >= hd/2 holds
+    # original 2(i - hd/2) + 1
+    inv = np.empty((hd,), np.int32)
+    inv[0::2] = np.arange(hd // 2)
+    inv[1::2] = np.arange(hd // 2) + hd // 2
+    return out[..., jnp.asarray(inv)]
 
 
 @functools.partial(
@@ -153,17 +345,7 @@ def paged_decode_attention(
     kp = k_slab.reshape(-1, page_size * hkv, hd)
     vp = v_slab.reshape(-1, page_size * hkv, hd)
 
-    def kv_index(bi, j, pt, ln, wn):
-        # out-of-window grid steps must not cost HBM bandwidth: clamp the
-        # logical page to the first in-window page, so dead steps re-name
-        # the same block and Pallas elides the duplicate DMA entirely
-        # (their compute is skipped by pl.when(page_live) in the kernel)
-        first = jnp.where(
-            wn[0] > 0,
-            jnp.maximum(ln[bi] - wn[0], 0) // page_size,
-            0,
-        )
-        return (pt[bi, jnp.maximum(j, first)], 0, 0)
+    kv_index = _make_kv_index(page_size)
 
     grid = (b, n_pages)
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -370,7 +370,7 @@ class SpanExecutor:
             and pb * self.page_size >= env.get("BBTPU_PAGED_MIN_CONTEXT")
             and self.mesh is None  # Pallas kernels don't GSPMD-partition
             and not self.spec.heterogeneous
-            and self.manager.quant is None
+            and self.manager.quant in (None, "int4")  # int4: in-kernel deq
             and tree_mask is None
             and tb == 1
             and not self.spec.alibi

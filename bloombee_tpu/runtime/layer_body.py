@@ -209,13 +209,22 @@ def layer_body(
         # single-token decode: the Pallas kernel streams K/V pages straight
         # from the arena (page table as scalar prefetch) — no gathered
         # [B, S, Hkv, hd] context buffer in HBM at all. Eligibility (T==1,
-        # no tree/alibi/softcap, dense arena) was checked host-side;
-        # sliding windows are handled in-kernel (per-layer traced scalar).
+        # no tree/alibi/softcap) was checked host-side; sliding windows are
+        # handled in-kernel (per-layer traced scalar). int4-quantized
+        # arenas dequantize inside the kernel (one pass over ~1/3 the
+        # bytes).
+        from bloombee_tpu.kv.quant import QuantSlab
         from bloombee_tpu.ops.pallas.paged_attention import (
             paged_decode_attention,
+            paged_decode_attention_int4,
         )
 
-        attn = paged_decode_attention(
+        kernel = (
+            paged_decode_attention_int4
+            if isinstance(k_slab, QuantSlab)
+            else paged_decode_attention
+        )
+        attn = kernel(
             q[:, 0], k_slab, v_slab, page_table, total_lens,
             page_size=page_size, scale=attn_scale(spec),
             # Mosaic only exists on TPU; any other backend that reaches
