@@ -37,3 +37,11 @@ class ClientConfig:
     # them to apply it (reference config.py active_adapter + peft.py
     # using_adapter); None serves the base model
     active_adapter: str | None = None
+    # opt-in server-side multi-step decode: when a greedy generate routes
+    # through ONE span covering the whole model, ask the server to run
+    # `server_decode_chunk` embed->span->head->select steps per RPC
+    # (runtime/decode_loop.py), amortizing the per-token host<->device round
+    # trip; servers that cannot (sub-span, sharded, no client params)
+    # decline and the client falls back to per-step decoding
+    server_decode: bool = False
+    server_decode_chunk: int = 32

@@ -21,44 +21,19 @@ import jax
 import jax.numpy as jnp
 
 from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
-from bloombee_tpu.client.session import InferenceSession
+from bloombee_tpu.client.session import DecodeNUnsupported, InferenceSession
+from bloombee_tpu.models.head import embed_impl, norm_head_impl
 from bloombee_tpu.models.spec import ModelSpec
 from bloombee_tpu.ops import rms_norm
 from bloombee_tpu.ops.norms import layer_norm
 
-
-@functools.partial(
+_embed = functools.partial(
     jax.jit, static_argnames=("embedding_multiplier", "has_embed_norm", "eps")
-)
-def _embed(
-    params,
-    input_ids,
-    embedding_multiplier: float = 1.0,
-    has_embed_norm: bool = False,
-    eps: float = 1e-5,
-):
-    h = params["embed"][input_ids]
-    if embedding_multiplier != 1.0:
-        h = h * embedding_multiplier
-    if has_embed_norm:  # bloom: word_embeddings_layernorm
-        h = layer_norm(h, params["embed_norm"], params["embed_norm_bias"], eps)
-    return h
+)(embed_impl)
 
-
-@functools.partial(
+_norm_head = functools.partial(
     jax.jit, static_argnames=("eps", "soft_cap", "norm_type")
-)
-def _norm_head(
-    params, hidden, eps: float, soft_cap: float = 0.0, norm_type: str = "rms"
-):
-    if norm_type == "ln":
-        h = layer_norm(hidden, params["norm"], params.get("norm_bias"), eps)
-    else:
-        h = rms_norm(hidden, params["norm"], eps)
-    logits = (h @ params["lm_head"]).astype(jnp.float32)
-    if soft_cap:
-        logits = jnp.tanh(logits / soft_cap) * soft_cap
-    return logits
+)(norm_head_impl)
 
 
 @functools.partial(
@@ -225,6 +200,7 @@ class DistributedModelForCausalLM:
         eos_token_id: int | None = None,
         session: InferenceSession | None = None,
         seed: int = 0,
+        server_decode: bool | None = None,  # None -> config.server_decode
     ) -> np.ndarray:
         input_ids = np.asarray(input_ids)
         b, s = input_ids.shape
@@ -234,7 +210,25 @@ class DistributedModelForCausalLM:
             session = self.inference_session(max_length, b)
             await session.__aenter__()
         rng = np.random.default_rng(seed)
+        use_sd = (
+            server_decode
+            if server_decode is not None
+            else self.config.server_decode
+        )
         try:
+            if (
+                use_sd
+                and not do_sample
+                and max_new_tokens > 0
+                and len(session._spans) == 1
+                and session._spans[0].span.start == 0
+                and session._spans[0].span.end == self.spec.num_hidden_layers
+            ):
+                # a declining server is handled INSIDE (per-step continuation
+                # on the same session — its KV already holds the prefill)
+                return await self._generate_server_decode(
+                    session, input_ids, max_length, eos_token_id
+                )
             hidden = self.embed(input_ids)
             out = await session.step(hidden, ids=input_ids)
             ids = input_ids
@@ -244,9 +238,9 @@ class DistributedModelForCausalLM:
                 next_ids = self._select(
                     logits, do_sample, temperature, top_p, rng
                 )
-                if eos_token_id is not None:
-                    next_ids = np.where(finished, eos_token_id, next_ids)
-                    finished |= next_ids == eos_token_id
+                next_ids, finished = self._mask_finished(
+                    next_ids, finished, eos_token_id
+                )
                 ids = np.concatenate([ids, next_ids[:, None]], axis=1)
                 if eos_token_id is not None and finished.all():
                     break
@@ -259,6 +253,107 @@ class DistributedModelForCausalLM:
         finally:
             if own_session:
                 await session.__aexit__(None, None, None)
+
+    async def _generate_server_decode(
+        self, session, input_ids, max_length, eos_token_id
+    ) -> np.ndarray:
+        """Greedy generation with server-side multi-step decode: prefill +
+        first token as usual, then chunks of `server_decode_chunk` tokens per
+        RPC via session.decode_n. Token-identical to the per-step loop on
+        the same backend (runtime/decode_loop.py exactness contract)."""
+        b = input_ids.shape[0]
+        chunk = max(1, int(self.config.server_decode_chunk))
+        head_dtype = str(self.params["lm_head"].dtype)
+        hidden = self.embed(input_ids)
+        out = await session.step(hidden, ids=input_ids)
+        logits = self.logits(out[:, -1:])[:, 0]
+        finished = np.zeros((b,), dtype=bool)
+        next_ids, finished = self._greedy_next(logits, finished, eos_token_id)
+        ids = np.concatenate([input_ids, next_ids[:, None]], axis=1)
+        while ids.shape[1] < max_length and not (
+            eos_token_id is not None and finished.all()
+        ):
+            # partial chunks round DOWN to a power of two: the server
+            # buckets n to next_pow2 and runs the whole bucket, so a
+            # non-pow2 request would burn discarded full-model steps
+            remaining = max_length - ids.shape[1]
+            n = min(chunk, 1 << (remaining.bit_length() - 1))
+            try:
+                toks = await session.decode_n(
+                    next_ids, n, eos_token_id=eos_token_id,
+                    finished=finished, head_dtype=head_dtype,
+                )
+            except DecodeNUnsupported as e:
+                # the server declined (or a recovery re-routed onto a
+                # multi-span chain): continue per-step on the SAME session —
+                # its KV already holds everything generated so far
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "server-side decode declined (%s); per-step path", e
+                )
+                return await self._continue_per_step(
+                    session, ids, next_ids, finished, max_length,
+                    eos_token_id,
+                )
+            if eos_token_id is not None:
+                # truncate where the per-step loop would have stopped: the
+                # first column after which every row is finished (the server
+                # clamps later columns to eos; appending them would make the
+                # output longer than the per-step path's)
+                cut = toks.shape[1]
+                fin = finished
+                for j in range(toks.shape[1]):
+                    fin = fin | (toks[:, j] == eos_token_id)
+                    if fin.all():
+                        cut = j + 1
+                        break
+                finished = fin
+                if cut < toks.shape[1]:
+                    # the server's KV/history ran past the stopping point;
+                    # rewind the session's record so a REUSED session sees
+                    # exactly the per-step path's context (the rewind marks
+                    # the chain for a rebuild-and-replay on next use)
+                    session.rewind_decoded_tail(toks.shape[1] - cut)
+                toks = toks[:, :cut]
+            ids = np.concatenate([ids, toks], axis=1)
+            next_ids = toks[:, -1]
+        return ids
+
+    async def _continue_per_step(
+        self, session, ids, next_ids, finished, max_length, eos_token_id
+    ) -> np.ndarray:
+        """Per-step continuation from mid-generation state (`ids` holds all
+        tokens so far; `next_ids` is selected but not yet stepped). Same
+        select semantics as the main per-step loop in generate()."""
+        while ids.shape[1] < max_length and not (
+            eos_token_id is not None and finished.all()
+        ):
+            out = await session.step(
+                self.embed(next_ids[:, None]), ids=next_ids[:, None]
+            )
+            logits = self.logits(out[:, -1:])[:, 0]
+            next_ids, finished = self._greedy_next(
+                logits, finished, eos_token_id
+            )
+            ids = np.concatenate([ids, next_ids[:, None]], axis=1)
+        return ids
+
+    @staticmethod
+    def _mask_finished(next_ids, finished, eos_token_id):
+        """EOS masking — the one definition every decode path shares so
+        their semantics cannot drift."""
+        if eos_token_id is not None:
+            next_ids = np.where(finished, eos_token_id, next_ids)
+            finished = finished | (next_ids == eos_token_id)
+        return next_ids, finished
+
+    @classmethod
+    def _greedy_next(cls, logits, finished, eos_token_id):
+        return cls._mask_finished(
+            np.argmax(logits, axis=-1).astype(np.int64), finished,
+            eos_token_id,
+        )
 
     @staticmethod
     def _select(logits, do_sample, temperature, top_p, rng):

@@ -33,6 +33,12 @@ env.declare(
 )
 
 
+class DecodeNUnsupported(RuntimeError):
+    """The server cannot run server-side multi-step decode for this session
+    (no client params / sub-span route / sharded span). Not a failure — the
+    caller falls back to per-step decoding without banning the peer."""
+
+
 class _SpanSession:
     """One open rpc_inference stream to one server
     (reference _ServerInferenceSession)."""
@@ -100,6 +106,10 @@ class InferenceSession:
         self._history: list[np.ndarray] = []  # legacy hidden replay
         self._step_counter = 0
         self.position = 0
+        # set when the server-side KV ran past the committed history (e.g.
+        # a decode_n chunk truncated at EOS); the next step rebuilds the
+        # chain and replays the true history before proceeding
+        self._needs_rebuild = False
         # per-step timing rows (the client half of the reference's
         # [TIMING_TABLE], handler.py:1276-1605): one entry per step with
         # per-span compute ms and the end-to-end wall ms
@@ -157,6 +167,9 @@ class InferenceSession:
         attempt = 0
         while True:
             try:
+                if self._needs_rebuild:
+                    await self._recover()
+                    self._needs_rebuild = False
                 if prune is not None or accept_per_span is not None:
                     return await self._step_pruned(
                         hidden, tree_mask, depths, prune, accept_per_span
@@ -202,6 +215,8 @@ class InferenceSession:
 
         Returns (out [B, K, D] fp32, keep [B, K] or None if the pruning
         span has no pruner weight)."""
+        if not self._spans:
+            raise RpcError("session chain is closed (recovery pending)")
         if self.use_push and len(self._spans) > 1:
             raise ValueError("pruned tree steps need relay mode (use_push=False)")
         assert tree_mask is not None and depths is not None
@@ -271,6 +286,10 @@ class InferenceSession:
         self, hidden, commit, tree_mask, depths=None, accept=None,
         commit_lens=None,
     ):
+        if not self._spans:
+            # a failed recovery left no open chain; surface as a retryable
+            # wire error so the caller's retry loop attempts recovery again
+            raise RpcError("session chain is closed (recovery pending)")
         step_id = self._step_counter
         self._step_counter += 1
         meta_base = {
@@ -430,6 +449,112 @@ class InferenceSession:
             "transport": transport_stats(),
         }
 
+    async def decode_n(
+        self,
+        ids: np.ndarray,  # [B] int: input token of the first step
+        n: int,
+        eos_token_id: int | None = None,
+        finished: np.ndarray | None = None,  # [B] bool rows already at EOS
+        head_dtype: str | None = None,  # client's lm_head dtype; servers
+        # decline on mismatch so logits stay identical across both paths
+    ) -> np.ndarray:
+        """Server-side multi-step greedy decode: one RPC returns [B, n] token
+        ids (runtime/decode_loop.py — the round-trip-amortizing fast path).
+        Only valid when the session's route is ONE span covering the whole
+        model; raises DecodeNUnsupported when the server declines, so the
+        caller can fall back to per-step decoding.
+
+        The server writes n tokens of KV (the input token plus the first
+        n-1 selected tokens), so position advances by n and those ids enter
+        the replay history."""
+        if len(self._spans) != 1:
+            raise DecodeNUnsupported(
+                "decode_n needs a single-span route covering the whole model"
+            )
+        ids = np.asarray(ids).reshape(-1).astype(np.int32)
+        attempt = 0
+        while True:
+            try:
+                if self._needs_rebuild:
+                    await self._recover()
+                    self._needs_rebuild = False
+                    if len(self._spans) != 1:
+                        raise DecodeNUnsupported(
+                            "re-routed onto a multi-span chain"
+                        )
+                toks = await self._decode_n_once(
+                    ids, n, eos_token_id, finished, head_dtype
+                )
+            except (RpcError, OSError, asyncio.TimeoutError) as e:
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise
+                logger.warning(
+                    "decode_n failed (%s); re-routing (attempt %d)",
+                    e, attempt,
+                )
+                try:
+                    await self._recover()
+                    if len(self._spans) != 1:
+                        raise DecodeNUnsupported(
+                            "re-routed onto a multi-span chain"
+                        )
+                except (RpcError, OSError, asyncio.TimeoutError) as e2:
+                    logger.warning("recovery attempt failed: %s", e2)
+                    await asyncio.sleep(min(0.2 * attempt, 2.0))
+                continue
+            # KV now holds [input, toks[:, :-1]] per row: record for replay
+            written = np.concatenate([ids[:, None], toks[:, :-1]], axis=1)
+            for i, row in enumerate(written):
+                self._id_rows[i].extend(int(t) for t in row)
+            self.position += n
+            return toks
+
+    async def _decode_n_once(
+        self, ids, n, eos_token_id, finished, head_dtype=None
+    ) -> np.ndarray:
+        if not self._spans:
+            raise RpcError("session chain is closed (recovery pending)")
+        step_id = self._step_counter
+        self._step_counter += 1
+        meta = {"step": step_id, "decode_n": int(n), "reply": "tensor"}
+        if eos_token_id is not None:
+            meta["eos_token_id"] = int(eos_token_id)
+        if finished is not None:
+            meta["finished"] = np.asarray(finished, dtype=bool).tolist()
+        if head_dtype is not None:
+            meta["head_dtype"] = head_dtype
+        span_sess = self._spans[0]
+        import time
+
+        t_start = time.perf_counter()
+        try:
+            await span_sess.stream.send(meta, [ids])
+            item = await asyncio.wait_for(
+                span_sess.stream.recv(), self.step_timeout
+            )
+        except (RpcError, OSError, asyncio.TimeoutError):
+            self.manager.ban_peer(span_sess.span.peer_id)
+            raise
+        if item is None:
+            self.manager.ban_peer(span_sess.span.peer_id)
+            raise RpcError("span closed mid-session")
+        resp_meta, resp_tensors = item
+        if resp_meta.get("decode_n_unsupported"):
+            raise DecodeNUnsupported(
+                "server declined decode_n for this session"
+            )
+        self.timings.append(
+            {
+                "step": step_id,
+                "tokens": n,
+                "decode_n": True,
+                "span_compute_ms": [resp_meta.get("t_compute_ms")],
+                "total_ms": (time.perf_counter() - t_start) * 1000.0,
+            }
+        )
+        return np.asarray(resp_tensors[0], dtype=np.int64)
+
     async def send_accept(
         self, accept: list, per_span: list | None = None
     ) -> None:
@@ -455,6 +580,24 @@ class InferenceSession:
             if item is None:
                 raise RpcError(f"span {i} closed during accept")
 
+    def rewind_decoded_tail(self, n_drop: int) -> None:
+        """Drop the last `n_drop` tokens from the committed history (every
+        row) after a decode_n chunk over-ran an EOS stop. The server-side KV
+        still holds them, so the chain is marked for a rebuild-and-replay on
+        the session's next use — which restores exactly the rewound context.
+        Requires embed_fn (the replay re-embeds ids)."""
+        if self.embed_fn is None:
+            raise ValueError(
+                "rewind_decoded_tail needs a session with embed_fn to "
+                "replay the rewound history"
+            )
+        if n_drop <= 0:
+            return
+        for row in self._id_rows:
+            del row[len(row) - n_drop:]
+        self.position -= n_drop
+        self._needs_rebuild = True
+
     def record_history_ids(self, rows: list[list[int]]) -> None:
         """Ragged per-row committed token ids (batched speculative rounds:
         each row accepts a different count). Requires embed_fn — id history
@@ -473,6 +616,16 @@ class InferenceSession:
         (v1 of reference `_update_sequence`: suffix-only rebuild is an
         optimization; full rebuild is correct because servers key KV caches by
         session, and new sessions start empty)."""
+        if any(self._id_rows) and self.embed_fn is None:
+            # id history can only be replayed by re-embedding; a session
+            # that recorded ids without an embed_fn (e.g. decode_n from a
+            # raw-hidden harness) must fail loudly, not resume with an
+            # empty-KV chain
+            await self.close()
+            raise RuntimeError(
+                "session recorded token-id history but has no embed_fn to "
+                "replay it"
+            )
         if any(self._id_rows) and self._history:
             # both histories populated -> replay interleaving is unknowable;
             # refuse before touching the chain (sessions must record ids

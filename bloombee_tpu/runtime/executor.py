@@ -219,6 +219,127 @@ class SpanExecutor:
         (blocks on the device round trip — call off the compute queue)."""
         return np.asarray(out).astype(self.transfer_dtype)
 
+    def decode_n(
+        self,
+        handle: CacheHandle,
+        ids: np.ndarray,  # [B] int: the input token of the first step
+        n: int,
+        client_params: dict,  # embed + norm + lm_head (checkpoint's trio)
+        eos_token_id: int | None = None,
+        finished: np.ndarray | None = None,  # [B] bool rows already at EOS
+        adapter: str | None = None,
+    ):
+        """Run N greedy decode steps entirely on device and return the [B, n]
+        selected token ids as a lazy device array (caller fetches off-queue).
+
+        One jitted lax.scan does embed -> span -> norm+head -> argmax per
+        step (runtime/decode_loop.py), so an RPC pays ONE host<->device round
+        trip for n tokens instead of n round trips. Valid only when this
+        span is the whole model (the server checks), dense, fully
+        device-resident, and un-sharded. N is bucketed to the next power of
+        two; padding steps write to out-of-bounds slots (dropped) and their
+        tokens are sliced away, so no garbage reaches the KV arena.
+        """
+        spec = self.spec
+        if self.host_layers or spec.heterogeneous or self.mesh is not None:
+            raise ValueError(
+                "decode_n needs a dense, fully device-resident, un-sharded "
+                "span"
+            )
+        if self.manager.quant is not None:
+            raise ValueError("decode_n + quantized KV arena not supported")
+        if self.attn_sparsity < 1.0:
+            # the per-step path recomputes top-k from the CURRENT context
+            # length every step; a k frozen at trace time would diverge
+            raise ValueError("decode_n + attn_sparsity not supported")
+        from bloombee_tpu.models.checkpoint import resolve_adapter
+
+        lora = resolve_adapter(self.adapters, adapter)
+        self.manager.ensure_resident(handle)
+        b = int(ids.shape[0])
+        bb = next_pow2(b)
+        nb = next_pow2(n)
+        arena_tokens = self.manager.capacity_tokens
+        lens_now = self.manager.context_lens(handle)
+        final_max = int(lens_now.max()) + n
+        pb = min(
+            next_pow2(max(-(-final_max // self.page_size), 1), floor=4),
+            arena_tokens // self.page_size,
+        )
+        oob = arena_tokens
+        layer_active = np.ones((self.manager.num_layers,), np.int32)
+        pt_pad = np.zeros((bb, pb), np.int32)
+        lens_pad = np.zeros((bb,), np.int32)
+        pos_pad = np.zeros((bb, 1), np.int32)
+        plans = []
+        for i in range(nb):
+            slots_pad = np.full((bb, 1), oob, np.int32)
+            if i < n:
+                slots_pad[:b, 0] = self.manager.write_slots(
+                    handle, 1, commit=True
+                )
+                total_lens = self.manager.context_lens(handle)
+                pt_pad[:b] = self.manager.page_table(handle, pb)
+                lens_pad[:b] = total_lens
+                pos_pad[:b, 0] = total_lens - 1
+            plans.append(
+                pack_plan(slots_pad, pt_pad, pos_pad, lens_pad, layer_active)
+            )
+        plans = np.stack(plans)
+
+        use_paged = bool(
+            not getattr(self, "_paged_broken", False)
+            and pb * self.page_size >= env.get("BBTPU_PAGED_MIN_CONTEXT")
+            and not spec.alibi
+            and not spec.attn_logit_softcap
+            and env.get("BBTPU_PAGED_ATTENTION")
+            and (
+                jax.default_backend() == "tpu"
+                or env.get("BBTPU_PAGED_INTERPRET")
+            )
+        )
+        ids_pad = np.zeros((bb,), np.int32)
+        ids_pad[:b] = np.asarray(ids).reshape(-1)
+        fin_pad = np.ones((bb,), bool)  # padding rows never select real ids
+        fin_pad[:b] = (
+            np.asarray(finished, dtype=bool) if finished is not None else False
+        )
+        arena = self.manager.arena
+
+        from bloombee_tpu.runtime.decode_loop import decode_loop
+
+        def _run(use_paged_now: bool):
+            return decode_loop(
+                client_params, self.params, arena["k"], arena["v"],
+                jnp.asarray(ids_pad), jnp.asarray(fin_pad),
+                jnp.asarray(plans), lora,
+                spec=spec, page_size=self.page_size, max_pages=pb,
+                eos_id=-1 if eos_token_id is None else int(eos_token_id),
+                compute_dtype=self.compute_dtype, windows=self.windows,
+                use_paged=use_paged_now,
+            )
+
+        try:
+            toks, new_k, new_v = _run(use_paged)
+        except Exception:
+            # same self-heal contract as _step: retry on the gather path
+            # only if the donated arena buffers are still alive
+            if not use_paged or any(
+                getattr(a, "is_deleted", lambda: False)()
+                for a in (arena["k"], arena["v"])
+            ):
+                raise
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "paged decode kernel failed in decode_n; retrying on the "
+                "dense gather path"
+            )
+            toks, new_k, new_v = _run(False)
+            self._paged_broken = True
+        self.manager.arena = {"k": new_k, "v": new_v}
+        return toks[:b, :n]
+
     def _run_offloaded(
         self, h_pad, slots_pad, pt_pad, positions, lens_pad, layer_active,
         tm_pad, lora, bb, tb, pb, use_flash, use_paged, attn_topk=0,
@@ -411,9 +532,18 @@ class SpanExecutor:
         if self.attn_sparsity < 1.0 and tb == 1 and tree_mask is None:
             # decode-only approximation (FlexGen applies sparsity at
             # generation only): sparsifying prefill would corrupt the
-            # cached context every layer feeds the next
-            s_ctx_b = pb * self.page_size
-            attn_topk = max(1, int(self.attn_sparsity * (s_ctx_b - 1)))
+            # cached context every layer feeds the next. k derives from the
+            # pow2 bucket of the largest TRUE row length — attn_topk is a
+            # static jit arg, so an exact per-step k would retrace the span
+            # every few tokens; pow2 bucketing caps compiles at O(log S) at
+            # the cost of k being up to 2x looser right after a boundary.
+            attn_topk = max(
+                1,
+                int(
+                    self.attn_sparsity
+                    * (next_pow2(int(total_lens.max())) - 1)
+                ),
+            )
 
         arena = self.manager.arena
         if self.host_layers:

@@ -167,6 +167,9 @@ class BlockServer:
         oversubscribe: float = 1.0,  # admit > capacity; park idle sessions
         idle_park_s: float = 5.0,  # a session this idle may be parked
         attn_sparsity: float = 1.0,  # <1: top-k sparse decode attention
+        client_params: dict | None = None,  # embed/norm/lm_head for the
+        # server-side multi-step decode loop (decode_n); lazy-loaded from
+        # model_dir when omitted
         offload_layers: int = 0,  # stream the span's last N layers' weights
         # from host per step (FlexGen weight-offload: serve spans larger
         # than HBM; combine with --weight-quant to shrink the streamed
@@ -302,6 +305,11 @@ class BlockServer:
             )
         self.compute = ComputeQueue()
         self.peers = _PeerPool()
+        # server-side multi-step decode (decode_n): needs the checkpoint's
+        # embed/norm/lm_head trio; lazy-loaded from model_dir on first use
+        self._client_params = client_params
+        self._client_params_unavailable = False
+        self._client_params_lock: asyncio.Lock | None = None
         # mid-chain draft-tree pruning (reference speculative_pruner/): the
         # MidLMHead weight lazy-loads from the checkpoint's lm_head
         self._pruner_manager = None
@@ -612,6 +620,9 @@ class BlockServer:
         if meta.get("accept_only"):
             await stream.send({"step": meta.get("step"), "ack": True})
             return
+        if meta.get("decode_n"):
+            await self._run_decode_n(session, stream, meta, tensors)
+            return
 
         # keep the sender's dtype (bf16 on the production wire); the executor
         # casts to compute dtype on device
@@ -752,6 +763,120 @@ class BlockServer:
             if keep is not None:
                 resp["keep"] = keep.tolist()
             await stream.send(resp, [out])
+
+    async def _run_decode_n(
+        self, session: _Session, stream: Stream, meta: dict, tensors: list
+    ) -> None:
+        """Server-side multi-step greedy decode (runtime/decode_loop.py):
+        one RPC returns N token ids, amortizing the host<->device round trip
+        that floors per-step serving. Valid only when this session runs the
+        WHOLE model on this server (the client routes it that way); an
+        ineligible server replies decode_n_unsupported so the client falls
+        back to per-step decoding without banning the peer."""
+        n = int(meta["decode_n"])
+        eligible = (
+            session.layers is None
+            # the loop applies the LM head after THIS span, so the span must
+            # be the whole model, not a prefix
+            and self.start_block == 0
+            and self.end_block == self.spec.num_hidden_layers
+            and not self.spec.heterogeneous
+            and not self.executor.host_layers
+            and self.executor.mesh is None
+            and self.manager.quant is None
+            # sparse decode recomputes k per step on the per-step path; a
+            # frozen k inside the scan would break token-exactness
+            and self.executor.attn_sparsity >= 1.0
+        )
+        if eligible:
+            await self._ensure_client_params()
+        if eligible and self._client_params is not None:
+            want_dt = meta.get("head_dtype")
+            have_dt = str(self._client_params["lm_head"].dtype)
+            if want_dt is not None and want_dt != have_dt:
+                # client loaded its head with a dtype override; different
+                # weights would yield different logits than its per-step path
+                eligible = False
+        if not eligible or self._client_params is None:
+            await stream.send(
+                {"step": meta.get("step"), "decode_n_unsupported": True}
+            )
+            return
+        ids = np.asarray(tensors[0]).reshape(-1)
+        if ids.shape[0] != session.handle.batch_size:
+            raise ValueError(
+                f"decode_n ids carry batch {ids.shape[0]} != "
+                f"{session.handle.batch_size} cache rows"
+            )
+        eos = meta.get("eos_token_id")
+        finished = (
+            np.asarray(meta["finished"], dtype=bool)
+            if meta.get("finished") is not None else None
+        )
+        import time as _time
+
+        def _dispatch():
+            session.last_step_at = _time.monotonic()
+            t0 = _time.perf_counter()
+            out = self.executor.decode_n(
+                session.handle, ids, n, self._client_params,
+                eos_token_id=eos, finished=finished,
+                adapter=session.adapter,
+            )
+            return out, (_time.perf_counter() - t0) * 1000.0
+
+        out_dev, t_dispatch_ms = await self.compute.submit(
+            PRIORITY_INFERENCE, _dispatch
+        )
+        t0 = _time.perf_counter()
+        toks = await asyncio.to_thread(
+            lambda: np.asarray(out_dev, dtype=np.int32)
+        )
+        t_fetch_ms = (_time.perf_counter() - t0) * 1000.0
+        session.n_steps += n
+        session.sum_tokens += int(ids.shape[0]) * n
+        session.sum_dispatch_ms += t_dispatch_ms
+        session.sum_fetch_ms += t_fetch_ms
+        await stream.send(
+            {
+                "step": meta.get("step"),
+                "t_compute_ms": t_dispatch_ms + t_fetch_ms,
+                "t_dispatch_ms": t_dispatch_ms,
+                "t_fetch_ms": t_fetch_ms,
+            },
+            [toks],
+        )
+
+    async def _ensure_client_params(self) -> None:
+        if (
+            self._client_params is not None
+            or self._client_params_unavailable
+        ):
+            return
+        if self.model_dir is None:
+            self._client_params_unavailable = True
+            return
+        if self._client_params_lock is None:
+            self._client_params_lock = asyncio.Lock()
+        async with self._client_params_lock:
+            if (
+                self._client_params is None
+                and not self._client_params_unavailable
+            ):
+                # multi-GB safetensors read: off the event loop
+                await asyncio.to_thread(self._load_client_params)
+
+    def _load_client_params(self) -> None:
+        try:
+            from bloombee_tpu.models.checkpoint import load_client_params
+
+            # checkpoint-native dtype: the client loads the same tensors the
+            # same way, keeping the server loop's logits identical to the
+            # client's per-step head on the same backend
+            self._client_params = load_client_params(self.model_dir)
+        except Exception as e:
+            logger.warning("decode_n unavailable (client params): %s", e)
+            self._client_params_unavailable = True
 
     def _compute_step(
         self, session: _Session, handle, hidden, commit, tree_mask,

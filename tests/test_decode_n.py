@@ -1,0 +1,296 @@
+"""Server-side multi-step decode (decode_n): token-exactness + fallback.
+
+The decode loop (runtime/decode_loop.py) must be token-identical to the
+per-step client path on the same backend — it replaces N client round trips
+with one jitted on-device loop, so any drift would silently change greedy
+outputs. Reference analog: `_fast_generate_greedy`
+(/root/reference/src/bloombee/client/remote_generation.py:286-386), which
+this path beats by not round-tripping per token.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.client.session import DecodeNUnsupported
+from bloombee_tpu.server.block_server import BlockServer
+from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=3,
+        vocab_size=128,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = tmp_path_factory.mktemp("tiny_llama_dn")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model, config
+
+
+def _server(model_dir, registry, start, end, **kw):
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 4)
+    return BlockServer(
+        model_uid="tiny", start=start, end=end, model_dir=model_dir,
+        registry=registry, **kw,
+    )
+
+
+def _hf_greedy(model, input_ids, max_new_tokens):
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor(input_ids), max_new_tokens=max_new_tokens,
+            do_sample=False, use_cache=True,
+        )
+    return out.numpy()
+
+
+def test_server_decode_matches_per_step_and_hf(tiny_model_dir):
+    """Single full-model span: server_decode generate == per-step generate
+    == HF greedy, across multiple decode_n chunks (chunk=4, 11 new tokens
+    -> prefill token + chunks of 4, 4, 2)."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s1 = _server(model_dir, RegistryClient("127.0.0.1", reg.port), 0, 3)
+        await s1.start()
+
+        from bloombee_tpu.client.config import ClientConfig
+
+        cfg = ClientConfig(server_decode=True, server_decode_chunk=4)
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, RegistryClient("127.0.0.1", reg.port),
+            model_uid="tiny", config=cfg,
+        )
+        rng = np.random.default_rng(7)
+        input_ids = rng.integers(0, config.vocab_size, size=(2, 5))
+        ids_sd = await model.generate(input_ids, max_new_tokens=11)
+        ids_ps = await model.generate(
+            input_ids, max_new_tokens=11, server_decode=False
+        )
+        ref = _hf_greedy(hf_model, input_ids, 11)
+        np.testing.assert_array_equal(ids_sd, ids_ps)
+        np.testing.assert_array_equal(ids_sd, ref)
+
+        await s1.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_server_decode_falls_back_on_multi_span(tiny_model_dir):
+    """A 2-server chain cannot run decode_n; generate must fall back to the
+    per-step path and still match HF."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s1 = _server(model_dir, RegistryClient("127.0.0.1", reg.port), 0, 2)
+        s2 = _server(model_dir, RegistryClient("127.0.0.1", reg.port), 2, 3)
+        await s1.start()
+        await s2.start()
+
+        from bloombee_tpu.client.config import ClientConfig
+
+        cfg = ClientConfig(server_decode=True, server_decode_chunk=4)
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, RegistryClient("127.0.0.1", reg.port),
+            model_uid="tiny", config=cfg,
+        )
+        rng = np.random.default_rng(3)
+        input_ids = rng.integers(0, config.vocab_size, size=(1, 4))
+        ids = await model.generate(input_ids, max_new_tokens=6)
+        ref = _hf_greedy(hf_model, input_ids, 6)
+        np.testing.assert_array_equal(ids, ref)
+
+        await s1.stop()
+        await s2.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_decode_n_session_level_exactness_and_eos(tiny_model_dir):
+    """Direct session decode_n vs manual per-step loop: same tokens, same
+    position; finished rows are clamped to eos."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s1 = _server(model_dir, RegistryClient("127.0.0.1", reg.port), 0, 3)
+        await s1.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, RegistryClient("127.0.0.1", reg.port), model_uid="tiny"
+        )
+        rng = np.random.default_rng(11)
+        input_ids = rng.integers(0, config.vocab_size, size=(2, 4))
+
+        # per-step reference tokens
+        async with model.inference_session(16, 2) as sess:
+            out = await sess.step(model.embed(input_ids), ids=input_ids)
+            cur = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+            ref_toks = []
+            for _ in range(5):
+                out = await sess.step(
+                    model.embed(cur[:, None]), ids=cur[:, None]
+                )
+                cur = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+                ref_toks.append(cur)
+        ref_toks = np.stack(ref_toks, axis=1)  # [B, 5]
+
+        # decode_n in two chunks
+        async with model.inference_session(16, 2) as sess:
+            out = await sess.step(model.embed(input_ids), ids=input_ids)
+            first = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+            t1 = await sess.decode_n(first, 3)
+            t2 = await sess.decode_n(t1[:, -1], 2)
+            assert sess.position == input_ids.shape[1] + 5
+        np.testing.assert_array_equal(
+            np.concatenate([t1, t2], axis=1), ref_toks
+        )
+
+        # finished rows emit only eos
+        async with model.inference_session(16, 2) as sess:
+            await sess.step(model.embed(input_ids), ids=input_ids)
+            toks = await sess.decode_n(
+                np.array([1, 2]), 4, eos_token_id=5,
+                finished=np.array([True, True]),
+            )
+        np.testing.assert_array_equal(toks, np.full((2, 4), 5))
+
+        await s1.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_server_decode_eos_mid_chunk_and_session_reuse(tiny_model_dir):
+    """EOS landing mid-chunk: output must truncate exactly where the
+    per-step loop stops, and a REUSED session must see the same context
+    (the over-run KV is rewound via rebuild-and-replay)."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s1 = _server(model_dir, RegistryClient("127.0.0.1", reg.port), 0, 3)
+        await s1.start()
+
+        from bloombee_tpu.client.config import ClientConfig
+
+        cfg = ClientConfig(server_decode=True, server_decode_chunk=4)
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, RegistryClient("127.0.0.1", reg.port),
+            model_uid="tiny", config=cfg,
+        )
+        rng = np.random.default_rng(13)
+        x = rng.integers(0, config.vocab_size, size=(1, 4))
+        # learn the greedy continuation; pick its 3rd new token as "eos" so
+        # it lands mid-chunk (prefill token + chunk of 4 -> column 1)
+        plain = await model.generate(x, max_new_tokens=8, server_decode=False)
+        eos = int(plain[0, x.shape[1] + 2])
+
+        ids_ps = await model.generate(
+            x, max_new_tokens=8, eos_token_id=eos, server_decode=False
+        )
+        ids_sd = await model.generate(
+            x, max_new_tokens=8, eos_token_id=eos, server_decode=True
+        )
+        np.testing.assert_array_equal(ids_sd, ids_ps)
+
+        # two-turn session reuse: turn 1 stops at eos mid-chunk, turn 2
+        # continues on the same session — both modes must agree
+        y = rng.integers(0, config.vocab_size, size=(1, 3))
+
+        async def two_turns(server_decode: bool):
+            sess = model.inference_session(40, 1)
+            async with sess:
+                a1 = await model.generate(
+                    x, max_new_tokens=8, eos_token_id=eos, session=sess,
+                    server_decode=server_decode,
+                )
+                a2 = await model.generate(
+                    y, max_new_tokens=5, session=sess,
+                    server_decode=server_decode,
+                )
+            return a1, a2
+
+        sd1, sd2 = await two_turns(True)
+        ps1, ps2 = await two_turns(False)
+        np.testing.assert_array_equal(sd1, ps1)
+        np.testing.assert_array_equal(sd2, ps2)
+
+        await s1.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_decode_n_declined_without_client_params(tiny_model_dir):
+    """A server built from raw params (no model_dir, no client_params) must
+    decline decode_n instead of erroring the stream."""
+    model_dir, _, config = tiny_model_dir
+
+    async def run():
+        from bloombee_tpu.models.checkpoint import load_span_params
+
+        params, spec = load_span_params(model_dir, 0, 3, dtype=jnp.float32)
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s1 = BlockServer(
+            model_uid="tiny", start=0, end=3, params=params, spec=spec,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=64, page_size=4,
+        )
+        await s1.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, RegistryClient("127.0.0.1", reg.port), model_uid="tiny"
+        )
+        async with model.inference_session(16, 1) as sess:
+            ids = np.array([[3, 4, 5]])
+            await sess.step(model.embed(ids), ids=ids)
+            with pytest.raises(DecodeNUnsupported):
+                await sess.decode_n(np.array([1]), 2)
+
+        # generate(server_decode=True) against the declining server must
+        # continue per-step on the same session (no double prefill) and
+        # still match HF greedy
+        from transformers import LlamaForCausalLM
+
+        hf_model = LlamaForCausalLM.from_pretrained(model_dir).eval()
+        rng = np.random.default_rng(5)
+        input_ids = rng.integers(0, config.vocab_size, size=(2, 4))
+        ids = await model.generate(
+            input_ids, max_new_tokens=6, server_decode=True
+        )
+        ref = _hf_greedy(hf_model, input_ids, 6)
+        np.testing.assert_array_equal(ids, ref)
+
+        await s1.stop()
+        await reg.stop()
+
+    asyncio.run(run())
