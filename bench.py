@@ -63,6 +63,12 @@ def _emit_json_locked():
         "value": round(value, 2),
         "unit": "tokens/sec/seq",
         "vs_baseline": round(value / 35.0, 3),
+        # per-step serving (one round trip per token) vs the headline,
+        # which uses server-side multi-step decode when available
+        "per_step_equiv_per_seq": round(
+            served.get("per_step_equiv_per_seq", 0.0), 2
+        ),
+        "server_decode_chunk": served.get("server_decode_chunk", 0),
         "effective_equiv_tok_per_s": round(
             served.get("effective_equiv_tok_per_s", 0.0), 1
         ),
@@ -75,6 +81,11 @@ def _emit_json_locked():
         # (production PCIe-attached v5e pays microseconds here)
         "host_device_round_trip_ms": round(RESULTS.get("fence_ms", 0.0), 1),
     }
+    ctx = RESULTS.get("ctx4k")
+    if ctx:
+        out["ctx4k_paged_steps_per_s"] = round(ctx.get("paged", 0.0), 1)
+        out["ctx4k_dense_steps_per_s"] = round(ctx.get("dense", 0.0), 1)
+        out["ctx4k_paged_speedup"] = round(ctx.get("speedup", 0.0), 2)
     if RESULTS.get("degraded"):
         out["degraded"] = RESULTS["degraded"]
     print(json.dumps(out), flush=True)
@@ -99,34 +110,63 @@ def start_watchdog():
     threading.Thread(target=watch, daemon=True).start()
 
 
-def _require_backend(timeout_s: float = 180.0):
-    """Fail fast (instead of hanging forever) when the TPU tunnel is down:
-    backend init on a dead tunnel blocks indefinitely inside PJRT."""
-    import threading
+def _require_backend():
+    """Wait for a usable JAX backend, retrying with backoff instead of
+    failing fast: the tunnel-attached TPU goes down for stretches, and a
+    round whose bench happens to start during one must still capture a
+    number if the tunnel recovers within the deadline.
 
-    devices = []
-    err = []
+    Probing runs in SUBPROCESSES: PJRT backend init on a dead tunnel blocks
+    forever with no way to interrupt it, and a wedged init would poison this
+    process's global backend state even after the tunnel recovers. Only
+    after a probe subprocess succeeds do we init the backend in-process.
+    Budget: half the watchdog deadline, leaving the other half for the
+    measurement phases."""
+    import subprocess
 
-    def probe():
+    deadline_s = float(os.environ.get("BBTPU_BENCH_DEADLINE_S", "1500"))
+    budget = max(120.0, deadline_s / 2)
+    t_start = time.time()
+    attempt = 0
+    while True:
+        attempt += 1
+        left = budget - (time.time() - t_start)
+        if left <= 0:
+            RESULTS.setdefault(
+                "degraded",
+                f"no usable jax backend within {budget:.0f}s "
+                f"({attempt - 1} probes); no phases ran",
+            )
+            log(f"FATAL: no usable jax backend within {budget:.0f}s — "
+                "emitting empty headline")
+            emit_json()
+            os._exit(3)
+        # the image's sitecustomize force-registers the TPU platform and
+        # ignores the JAX_PLATFORMS env var; honor an explicit cpu request
+        # inside the probe the same way main() does
+        probe_code = (
+            "import os, jax\n"
+            "if os.environ.get('JAX_PLATFORMS', '').strip() == 'cpu':\n"
+            "    jax.config.update('jax_platforms', 'cpu')\n"
+            "print(len(jax.devices()))\n"
+        )
         try:
-            import jax
-
-            devices.extend(jax.devices())
-        except Exception as e:  # pragma: no cover
-            err.append(e)
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive():
-        log(f"FATAL: jax backend init did not finish in {timeout_s:.0f}s "
-            "(TPU tunnel down?)")
-        import os
-
-        os._exit(3)
-    if err:
-        log(f"FATAL: jax backend init failed: {err[0]}")
-        raise SystemExit(3)
+            proc = subprocess.run(
+                [sys.executable, "-c", probe_code],
+                timeout=min(120.0, left), capture_output=True, text=True,
+                env=os.environ.copy(),
+            )
+            if proc.returncode == 0 and proc.stdout.strip().isdigit():
+                log(f"backend probe ok after {attempt} attempt(s) "
+                    f"({time.time() - t_start:.0f}s): "
+                    f"{proc.stdout.strip()} device(s)")
+                return
+            log(f"backend probe attempt {attempt} failed "
+                f"(rc={proc.returncode}): {proc.stderr.strip()[-200:]}")
+        except subprocess.TimeoutExpired:
+            log(f"backend probe attempt {attempt} timed out "
+                "(tunnel down?); retrying")
+        time.sleep(min(30.0, 5.0 * attempt))
 
 
 def main():
@@ -292,6 +332,15 @@ def main():
         f"prefill(ttft proxy) {ttft*1000:.0f} ms"
     )
 
+    # ---- long-context phase: paged Pallas kernel vs dense gather at 4k
+    # (committed harness for the paged kernel's headline win; previously
+    # only an ad-hoc loop in git history)
+    try:
+        run_longctx(spec, params, B, smoke)
+    except Exception as e:  # noqa: BLE001
+        RESULTS.setdefault("degraded", f"longctx phase failed: {e!r}")
+        log(f"longctx phase FAILED: {e!r}")
+
     # the span params + arena of the proxy phase were donated away; the
     # served phase builds its own server-side state from `params`
     try:
@@ -322,6 +371,96 @@ def main():
     emit_json()
 
 
+def run_longctx(spec, params, B, smoke: bool) -> None:
+    """Decode at long context: paged Pallas kernel (one HBM pass over K/V
+    pages) vs the dense gather-then-attend path (two passes). Both run the
+    SAME jitted span step with only the use_paged flag flipped; timing is a
+    chain of async dispatches fenced once (dispatch is async on this
+    backend, so wall time == device time once the queue is primed)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bloombee_tpu.kv.arena import make_arena
+    from bloombee_tpu.runtime.step import (
+        pack_plan,
+        pack_step_payload,
+        span_step_packed,
+    )
+    from bloombee_tpu.utils import env as _env
+
+    interpret = _env.get("BBTPU_PAGED_INTERPRET")
+    if jax.default_backend() != "tpu" and not interpret:
+        log("longctx: no TPU backend and no BBTPU_PAGED_INTERPRET; skipped")
+        return
+    CTX = 256 if smoke else 4096
+    page_size = 16
+    span_layers = spec.num_hidden_layers
+    pages_per_seq = (CTX + 1 + page_size - 1) // page_size + 1
+    pb = 1
+    while pb < pages_per_seq:
+        pb *= 2
+    num_pages = B * pb
+    arena = make_arena(
+        span_layers, num_pages, page_size, spec.num_key_value_heads,
+        spec.head_dim, jnp.bfloat16,
+    )
+    # context KV contents don't matter for timing; leave the arena zeroed
+    # and declare every row CTX tokens long
+    page_table = np.zeros((B, pb), np.int32)
+    for i in range(B):
+        page_table[i] = np.arange(i * pb, (i + 1) * pb)
+    slot = (
+        page_table[:, CTX // page_size] * page_size + CTX % page_size
+    ).reshape(B, 1)
+    positions = np.full((B, 1), CTX, np.int32)
+    lens = np.full((B,), CTX + 1, np.int32)
+    plan = pack_plan(
+        slot, page_table, positions, lens, np.ones((span_layers,), np.int32)
+    )
+    import ml_dtypes
+
+    rng = np.random.default_rng(1)
+    h = (rng.standard_normal((B, 1, spec.hidden_size)) * 0.02).astype(
+        ml_dtypes.bfloat16
+    )
+    payload = jnp.asarray(pack_step_payload(h, plan))
+
+    def fence(x) -> float:
+        return float(jnp.sum(x.astype(jnp.float32)))
+
+    results = {}
+    steps = 4 if smoke else 32
+    for name, use_paged in (("dense", False), ("paged", True)):
+        ak, av = arena["k"], arena["v"]
+        t0 = time.time()
+        out, ak, av = span_step_packed(
+            params, ak, av, payload, None, None,
+            spec=spec, b=B, t=1, page_size=page_size, max_pages=pb,
+            use_paged=use_paged,
+            windows=tuple(0 for _ in range(span_layers)),
+        )
+        fence(out)
+        log(f"longctx {name} compile+run: {time.time()-t0:.1f}s")
+        t0 = time.time()
+        for _ in range(steps):
+            out, ak, av = span_step_packed(
+                params, ak, av, payload, None, None,
+                spec=spec, b=B, t=1, page_size=page_size, max_pages=pb,
+                use_paged=use_paged,
+                windows=tuple(0 for _ in range(span_layers)),
+            )
+        fence(out)
+        dt = max(time.time() - t0 - RESULTS.get("fence_ms", 0.0) / 1e3, 1e-9)
+        results[name] = steps / dt
+        arena = {"k": ak, "v": av}
+    results["speedup"] = results["paged"] / max(results["dense"], 1e-9)
+    RESULTS["ctx4k"] = results
+    log(
+        f"longctx ctx={CTX}: paged {results['paged']:.1f} steps/s vs dense "
+        f"{results['dense']:.1f} steps/s ({results['speedup']:.2f}x)"
+    )
+
+
 def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
     """Registry + BlockServer + client session on loopback: the E2E serving
     path the reference's benchmark_inference.py measures."""
@@ -345,9 +484,26 @@ def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
         # x (PREFILL + DECODE + settle/compile steps) tokens
         N_SESS = 6
         SETTLE = 5  # 1 compile + 4 settle decode steps before the timed loop
+        # random embed/norm/head trio sized like the real checkpoint: the
+        # server-side multi-step decode phase runs the FULL per-token path
+        # (embed -> span -> norm+head -> argmax) on device
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        keys = _jax.random.split(_jax.random.PRNGKey(9), 2)
+        client_params = {
+            "embed": _jax.random.normal(
+                keys[0], (spec.vocab_size, spec.hidden_size), _jnp.bfloat16
+            ) * 0.02,
+            "norm": _jnp.ones((spec.hidden_size,), _jnp.bfloat16),
+            "lm_head": _jax.random.normal(
+                keys[1], (spec.hidden_size, spec.vocab_size), _jnp.bfloat16
+            ) * 0.02,
+        }
         server = BlockServer(
             model_uid="bench", start=0, end=span_layers, params=params,
             spec=spec, registry=rc(), num_pages=768, page_size=16,
+            client_params=client_params,
         )
         await server.start()
         manager = RemoteSequenceManager(rc(), "bench", span_layers)
@@ -382,12 +538,55 @@ def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
         result = {
             "steps_per_sec": steps_per_sec,
             "equiv_per_seq": steps_per_sec / spans_per_model,
+            "per_step_equiv_per_seq": steps_per_sec / spans_per_model,
+            "server_decode_chunk": 0,
             "ttft_ms": 0.0,
             "timing": timing,
             "n_sessions": N_SESS,
             "effective_equiv_tok_per_s": steps_per_sec * B / spans_per_model,
         }
         RESULTS["served"] = result
+
+        # ---- phase A2: server-side multi-step decode (decode_n) — the
+        # framework's answer to the per-token round-trip floor: one RPC
+        # returns CHUNK tokens from an on-device embed->span->head loop
+        CHUNK = 8 if DECODE <= 8 else 32
+        ROUNDS = max(1, DECODE // CHUNK)
+        try:
+            sess_sd = InferenceSession(
+                manager,
+                max_length=PREFILL + CHUNK * (ROUNDS + 2), batch_size=B,
+            )
+            async with sess_sd:
+                await sess_sd.step(hidden)  # prefill (warm bucket)
+                t0 = time.time()
+                toks = await sess_sd.decode_n(np.zeros((B,), np.int32), CHUNK)
+                log(
+                    f"served decode_n({CHUNK}) compile+run: "
+                    f"{time.time()-t0:.1f}s"
+                )
+                t0 = time.time()
+                for _ in range(ROUNDS):
+                    toks = await sess_sd.decode_n(toks[:, -1], CHUNK)
+                wall = time.time() - t0
+            sd_steps = ROUNDS * CHUNK / wall
+            result["server_decode_chunk"] = CHUNK
+            result["server_decode_steps_per_sec"] = sd_steps
+            # the headline becomes the multi-step served rate; the per-step
+            # rate stays on record as per_step_equiv_per_seq
+            result["equiv_per_seq"] = sd_steps / spans_per_model
+            result["effective_equiv_tok_per_s"] = max(
+                result["effective_equiv_tok_per_s"],
+                sd_steps * B / spans_per_model,
+            )
+            log(
+                f"served decode_n: {sd_steps:.1f} steps/s "
+                f"({sd_steps / spans_per_model:.1f} 8B-equiv tok/s/seq, "
+                f"chunk {CHUNK})"
+            )
+        except Exception as e:  # noqa: BLE001
+            RESULTS.setdefault("degraded", f"decode_n phase failed: {e!r}")
+            log(f"served decode_n phase FAILED: {e!r}")
 
         # ---- phase B: N_SESS concurrent sessions — round trips overlap,
         # aggregate throughput approaches the device ceiling (the role of

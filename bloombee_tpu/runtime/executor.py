@@ -287,9 +287,25 @@ class SpanExecutor:
             )
         plans = np.stack(plans)
 
+        # paged gating uses the STARTING length's page bucket (the same
+        # bucket the per-step path sees on the chunk's first step), so a
+        # chunk beginning below the paged crossover stays dense like its
+        # per-step equivalent. A chunk that CROSSES the crossover keeps one
+        # kernel throughout (the flag is static over the scan) while the
+        # per-step path would switch mid-way — the kernels agree to ~1e-5,
+        # so an exact argmax tie at the boundary could in principle flip;
+        # everywhere else greedy outputs are bitwise identical.
+        pb_start = min(
+            next_pow2(
+                max(-(-(int(lens_now.max()) + 1) // self.page_size), 1),
+                floor=4,
+            ),
+            arena_tokens // self.page_size,
+        )
         use_paged = bool(
             not getattr(self, "_paged_broken", False)
-            and pb * self.page_size >= env.get("BBTPU_PAGED_MIN_CONTEXT")
+            and pb_start * self.page_size
+            >= env.get("BBTPU_PAGED_MIN_CONTEXT")
             and not spec.alibi
             and not spec.attn_logit_softcap
             and env.get("BBTPU_PAGED_ATTENTION")
