@@ -262,7 +262,11 @@ class DistributedModelForCausalLM:
         RPC via session.decode_n. Token-identical to the per-step loop on
         the same backend (runtime/decode_loop.py exactness contract)."""
         b = input_ids.shape[0]
+        # the server buckets n to next_pow2 and runs the whole bucket, so a
+        # non-pow2 chunk (e.g. 24) would burn discarded full-model scan
+        # steps EVERY round — round the configured chunk down once
         chunk = max(1, int(self.config.server_decode_chunk))
+        chunk = 1 << (chunk.bit_length() - 1)
         head_dtype = str(self.params["lm_head"].dtype)
         hidden = self.embed(input_ids)
         out = await session.step(hidden, ids=input_ids)
@@ -277,7 +281,7 @@ class DistributedModelForCausalLM:
             # buckets n to next_pow2 and runs the whole bucket, so a
             # non-pow2 request would burn discarded full-model steps
             remaining = max_length - ids.shape[1]
-            n = min(chunk, 1 << (remaining.bit_length() - 1))
+            n = min(chunk, 1 << (remaining.bit_length() - 1))  # final partial
             try:
                 toks = await session.decode_n(
                     next_ids, n, eos_token_id=eos_token_id,
@@ -289,7 +293,9 @@ class DistributedModelForCausalLM:
                 # its KV already holds everything generated so far
                 import logging
 
-                logging.getLogger(__name__).info(
+                # warning, not debug: losing the fast path silently costs
+                # the operator the whole feature (round-3 verdict)
+                logging.getLogger(__name__).warning(
                     "server-side decode declined (%s); per-step path", e
                 )
                 return await self._continue_per_step(

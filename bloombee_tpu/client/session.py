@@ -110,6 +110,7 @@ class InferenceSession:
         # a decode_n chunk truncated at EOS); the next step rebuilds the
         # chain and replays the true history before proceeding
         self._needs_rebuild = False
+        self._warned_no_embed = False
         # per-step timing rows (the client half of the reference's
         # [TIMING_TABLE], handler.py:1276-1605): one entry per step with
         # per-span compute ms and the end-to-end wall ms
@@ -466,7 +467,24 @@ class InferenceSession:
 
         The server writes n tokens of KV (the input token plus the first
         n-1 selected tokens), so position advances by n and those ids enter
-        the replay history."""
+        the replay history.
+
+        Exactness caveat: a chunk whose context CROSSES the paged-attention
+        crossover (BBTPU_PAGED_MIN_CONTEXT) runs one kernel for the whole
+        chunk while the per-step path would switch mid-way; the kernels
+        agree to ~1e-5, so only an exact argmax tie at the boundary could
+        differ (runtime/executor.py decode_n gating)."""
+        if self.embed_fn is None and not self._warned_no_embed:
+            # ids recorded without an embed_fn cannot be replayed: a later
+            # transient transport failure becomes a hard RuntimeError in
+            # _recover instead of a transparent re-route (fail-loud is
+            # intentional; the warning makes the trade visible up front)
+            self._warned_no_embed = True
+            logger.warning(
+                "decode_n on a session without embed_fn: the session loses "
+                "failure recovery (id history cannot be re-embedded); use "
+                "model.inference_session() for recoverable decode"
+            )
         if len(self._spans) != 1:
             raise DecodeNUnsupported(
                 "decode_n needs a single-span route covering the whole model"
@@ -542,7 +560,8 @@ class InferenceSession:
         resp_meta, resp_tensors = item
         if resp_meta.get("decode_n_unsupported"):
             raise DecodeNUnsupported(
-                "server declined decode_n for this session"
+                resp_meta.get("reason")
+                or "server declined decode_n for this session"
             )
         self.timings.append(
             {

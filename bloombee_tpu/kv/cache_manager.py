@@ -185,15 +185,20 @@ class CacheManager:
                 )
             from bloombee_tpu.runtime.hetero import make_hetero_arena
 
-            self.arena = make_hetero_arena(
+            self._make_arena = lambda: make_hetero_arena(
                 hetero_spec, num_layers, start_block, num_pages, page_size,
                 dtype,
             )
         else:
-            self.arena = arena_ops.make_arena(
+            self._make_arena = lambda: arena_ops.make_arena(
                 num_layers, num_pages, page_size, n_kv_heads, head_dim,
                 dtype, quant=self.quant,
             )
+        self.arena = self._make_arena()
+        # bumped by rebuild_arena(); sessions opened under an older epoch
+        # hold table state describing KV that no longer exists
+        self.arena_epoch = 0
+        self._live_seqs: set[int] = set()
         self.num_layers = num_layers
         self.page_size = page_size
         self.capacity_tokens = num_pages * page_size
@@ -280,8 +285,10 @@ class CacheManager:
             seq_ids=[next(self._seq_counter) for _ in range(batch_size)],
             max_length=max_length,
         )
-        for sid in handle.seq_ids:
-            self.table.add_seq(sid)
+        with self._lock:
+            for sid in handle.seq_ids:
+                self.table.add_seq(sid)
+            self._live_seqs.update(handle.seq_ids)
         try:
             yield handle
         finally:
@@ -290,6 +297,7 @@ class CacheManager:
                     if self.table.has_seq(sid):
                         self.table.drop_seq(sid)
                     self._parked.pop(sid, None)
+                    self._live_seqs.discard(sid)
             async with cond:
                 self._reserved_tokens -= need
                 cond.notify_all()
@@ -543,3 +551,21 @@ class CacheManager:
 
     def parked_seqs(self) -> Iterator[int]:
         return iter(self._parked)
+
+    # ------------------------------------------------------------- recovery
+    @_locked
+    def rebuild_arena(self) -> None:
+        """Replace a consumed arena with a fresh zeroed one after a kernel
+        failure destroyed the donated buffers mid-chain (e.g. a paged
+        failure between layer_step calls on the offload path). Every live
+        device-resident sequence's KV is gone, so their table state resets
+        to zero length and `arena_epoch` bumps — the server fails any step
+        from a pre-rebuild session loudly and its client replays history
+        onto a fresh chain (the same path that handles a dead server).
+        Host-parked sequences keep their copies: they unpark into the new
+        arena intact."""
+        for sid in list(self._live_seqs):
+            if self.table.has_seq(sid) and sid not in self._parked:
+                self.table.reset_seq(sid)
+        self.arena = self._make_arena()
+        self.arena_epoch += 1

@@ -340,10 +340,10 @@ class SpanExecutor:
         except Exception:
             # same self-heal contract as _step: retry on the gather path
             # only if the donated arena buffers are still alive
-            if not use_paged or any(
-                getattr(a, "is_deleted", lambda: False)()
-                for a in (arena["k"], arena["v"])
-            ):
+            if self._arena_consumed(arena):
+                self._rebuild_after_failure("decode_n")
+                raise
+            if not use_paged:
                 raise
             import logging
 
@@ -355,6 +355,27 @@ class SpanExecutor:
             self._paged_broken = True
         self.manager.arena = {"k": new_k, "v": new_v}
         return toks[:b, :n]
+
+    @staticmethod
+    def _arena_consumed(arena) -> bool:
+        return any(
+            getattr(a, "is_deleted", lambda: False)()
+            for a in jax.tree.leaves((arena["k"], arena["v"]))
+        )
+
+    def _rebuild_after_failure(self, where: str) -> None:
+        """A failure consumed the donated arena mid-chain: without a fresh
+        arena every later step would compute on deleted buffers, bricking
+        the server. Rebuild (zeroed) and bump the epoch so pre-rebuild
+        sessions fail loudly and their clients replay (advisor, round 2)."""
+        import logging
+
+        logging.getLogger(__name__).error(
+            "%s failed after the donated arena was consumed; rebuilding a "
+            "fresh arena — live sessions' KV is lost and their clients "
+            "must replay", where,
+        )
+        self.manager.rebuild_arena()
 
     def _run_offloaded(
         self, h_pad, slots_pad, pt_pad, positions, lens_pad, layer_active,
@@ -577,10 +598,10 @@ class SpanExecutor:
                 # on the gather path only if the donated arena buffers are
                 # still alive (a compile failure surfaces before donation
                 # consumes them; a mid-chain runtime failure does not)
-                if not use_paged or any(
-                    getattr(a, "is_deleted", lambda: False)()
-                    for a in (arena["k"], arena["v"])
-                ):
+                if self._arena_consumed(arena):
+                    self._rebuild_after_failure("offloaded step")
+                    raise
+                if not use_paged:
                     raise
                 import logging
 
@@ -652,11 +673,12 @@ class SpanExecutor:
                 # donated arena buffers are still alive (a compile failure
                 # surfaces at call time BEFORE donation consumes them; if a
                 # runtime failure already ate the arena, retrying would
-                # compute on deleted buffers — re-raise the real error).
-                if not use_paged or any(
-                    getattr(a, "is_deleted", lambda: False)()
-                    for a in (arena["k"], arena["v"])
-                ):
+                # compute on deleted buffers — rebuild so the server
+                # survives, then re-raise the real error).
+                if self._arena_consumed(arena):
+                    self._rebuild_after_failure("span step")
+                    raise
+                if not use_paged:
                     raise
                 import logging
 

@@ -56,6 +56,27 @@ class MidLMHead:
         )
 
 
+def _cap_kept_by_score(
+    tree: DraftTree, keep: np.ndarray, scores: np.ndarray, cap: int
+) -> np.ndarray:
+    """Shrink a keep mask to `cap` nodes by repeatedly dropping the
+    lowest-SCORING kept leaf (a kept node with no kept children), so the
+    survivors are the best-scoring tree-consistent subset. Truncating by
+    node index would discard high-score deep nodes just for being drafted
+    late (advisor finding, round 2)."""
+    t = tree.size
+    while int(keep.sum()) > cap:
+        kept_now = np.nonzero(keep)[0]
+        has_kept_child = np.zeros(t, dtype=bool)
+        for c in kept_now:
+            parent = int(tree.parents[c])
+            if parent >= 0:
+                has_kept_child[parent] = True
+        leaves = kept_now[~has_kept_child[kept_now]]
+        keep[int(leaves[int(np.argmin(scores[leaves]))])] = False
+    return keep
+
+
 @dataclasses.dataclass
 class SimpleProbabilityPruner:
     """Keep children whose parent-conditioned renormalized probability
@@ -77,6 +98,7 @@ class SimpleProbabilityPruner:
         threshold AND its parent is kept (subtree pruning)."""
         t = tree.size
         keep = np.zeros(t, dtype=bool)
+        node_p = np.zeros(t, dtype=np.float64)  # for score-ordered capping
         # renormalize within each sibling group
         for parent in [-1] + list(range(t)):
             children = tree.children_of(parent)
@@ -93,10 +115,10 @@ class SimpleProbabilityPruner:
             for c, p in zip(children, child_p):
                 parent_ok = parent < 0 or keep[parent]
                 keep[c] = parent_ok and (p >= self.threshold)
-        kept = np.nonzero(keep)[0]
+                node_p[c] = p
         cap = self.max_keep or t
-        if len(kept) > cap:
-            kept = kept[:cap]
+        keep = _cap_kept_by_score(tree, keep, node_p, cap)
+        kept = np.nonzero(keep)[0]
         out = np.full(cap, -1, dtype=np.int32)
         out[: len(kept)] = kept
         return out
@@ -318,10 +340,9 @@ class AdaptiveNeuralPruner:
             roots = tree.children_of(-1)
             if len(roots):
                 keep[int(roots[int(np.argmax(scores[roots]))])] = True
-        kept = np.nonzero(keep)[0]
         cap = self.max_keep or t
-        if len(kept) > cap:
-            kept = kept[:cap]
+        keep = _cap_kept_by_score(tree, keep, scores, cap)
+        kept = np.nonzero(keep)[0]
         out = np.full(cap, -1, dtype=np.int32)
         out[: len(kept)] = kept
         return out

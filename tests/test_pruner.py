@@ -59,6 +59,24 @@ def test_keep_indices_padding_and_cap():
     )
     assert kept.tolist() == [0, 1, 2]  # single children renormalize to 1.0
 
+def test_cap_drops_lowest_scoring_leaves_not_late_indices():
+    # two root chains: nodes 0->2 (weak) and 1->3 (strong). Index-order
+    # truncation at cap=2 would keep [0, 1]; score-ordered capping must
+    # keep the STRONG chain [1, 3] by dropping the weakest leaves first
+    # (2 then 0), never orphaning a kept child.
+    tree = DraftTree(
+        tokens=np.asarray([1, 2, 3, 4]),
+        parents=np.asarray([-1, -1, 0, 1]),
+    )
+    vocab = 8
+    root = _probs(vocab, [{1: 0.3, 2: 0.7}])[0]
+    probs = _probs(vocab, [{3: 0.9}, {4: 0.95}, {}, {}])
+    kept = SimpleProbabilityPruner(threshold=0.05, max_keep=2).keep_indices(
+        tree, probs, root
+    )
+    assert kept.tolist() == [1, 3]
+
+
 def test_mid_head_trainer_learns_and_checkpoints(tmp_path):
     """Online MidLMHead training (reference lm_head_trainer): CE drops on a
     fixed batch, and save/load round-trips the trained weight."""
