@@ -262,11 +262,28 @@ class DistributedModelForCausalLM:
         RPC via session.decode_n. Token-identical to the per-step loop on
         the same backend (runtime/decode_loop.py exactness contract)."""
         b = input_ids.shape[0]
-        # the server buckets n to next_pow2 and runs the whole bucket, so a
-        # non-pow2 chunk (e.g. 24) would burn discarded full-model scan
-        # steps EVERY round — round the configured chunk down once
-        chunk = max(1, int(self.config.server_decode_chunk))
-        chunk = 1 << (chunk.bit_length() - 1)
+
+        def _chunk_now() -> int:
+            # the server buckets n to next_pow2 and runs the whole bucket,
+            # so a non-pow2 chunk (e.g. 24) would burn discarded full-model
+            # scan steps EVERY round — round the configured chunk down.
+            # Clamp to the CURRENT route's advertised decode_n_max FIRST
+            # (recomputed every round: a mid-generation re-route may land
+            # on a server with a smaller bound, and a chunk above it gets
+            # declined and silently costs the whole fast path — advisor,
+            # round 4).
+            c = max(1, int(self.config.server_decode_chunk))
+            server_max = min(
+                (
+                    s.span.server_info.decode_n_max
+                    for s in session._spans
+                    if s.span.server_info.decode_n_max
+                ),
+                default=None,
+            )
+            if server_max is not None:
+                c = min(c, int(server_max))
+            return 1 << (c.bit_length() - 1)
         head_dtype = str(self.params["lm_head"].dtype)
         hidden = self.embed(input_ids)
         out = await session.step(hidden, ids=input_ids)
@@ -281,7 +298,7 @@ class DistributedModelForCausalLM:
             # buckets n to next_pow2 and runs the whole bucket, so a
             # non-pow2 request would burn discarded full-model steps
             remaining = max_length - ids.shape[1]
-            n = min(chunk, 1 << (remaining.bit_length() - 1))  # final partial
+            n = min(_chunk_now(), 1 << (remaining.bit_length() - 1))
             try:
                 toks = await session.decode_n(
                     next_ids, n, eos_token_id=eos_token_id,

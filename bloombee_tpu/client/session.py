@@ -39,6 +39,15 @@ class DecodeNUnsupported(RuntimeError):
     caller falls back to per-step decoding without banning the peer."""
 
 
+def _raise_if_session_lost(resp_meta: dict) -> None:
+    """Typed `session_lost` reply: the server is healthy but this session's
+    KV is gone (arena rebuilt after a kernel failure). Raise a plain wire
+    error so the caller's retry loop recovers and replays WITHOUT banning
+    the peer (the ban paths only trigger on transport failures)."""
+    if resp_meta.get("session_lost"):
+        raise RpcError(resp_meta.get("reason", "session KV lost"))
+
+
 class _SpanSession:
     """One open rpc_inference stream to one server
     (reference _ServerInferenceSession)."""
@@ -260,6 +269,7 @@ class InferenceSession:
                 self.manager.ban_peer(span_sess.span.peer_id)
                 raise RpcError(f"span {i} closed mid-session")
             resp_meta, resp_tensors = item
+            _raise_if_session_lost(resp_meta)
             compute_ms.append(resp_meta.get("t_compute_ms"))
             chunk = resp_tensors[0]
             if i == 0 and resp_meta.get("keep") is not None:
@@ -380,6 +390,7 @@ class InferenceSession:
                     self.manager.ban_peer(span_sess.span.peer_id)
                     raise RpcError(f"span {i} closed mid-session")
                 resp_meta, resp_tensors = item
+                _raise_if_session_lost(resp_meta)
                 if resp_meta.get("t_compute_ms") is not None:
                     span_ms += resp_meta["t_compute_ms"]
                 if resp_meta.get("ack"):
@@ -558,6 +569,7 @@ class InferenceSession:
             self.manager.ban_peer(span_sess.span.peer_id)
             raise RpcError("span closed mid-session")
         resp_meta, resp_tensors = item
+        _raise_if_session_lost(resp_meta)
         if resp_meta.get("decode_n_unsupported"):
             raise DecodeNUnsupported(
                 resp_meta.get("reason")

@@ -64,6 +64,14 @@ class ParkedKVLost(RuntimeError):
     allocation (the same path that handles a dead server)."""
 
 
+class SessionKVLost(RuntimeError):
+    """A session's KV no longer exists (the arena was rebuilt after a
+    kernel failure consumed the donated buffers). Not a server fault: the
+    server replies a typed `session_lost` so the client replays its token
+    history onto a fresh chain WITHOUT banning the (healthy) peer
+    (advisor, round 4)."""
+
+
 @dataclasses.dataclass
 class _Parked:
     """One parked sequence's KV: either still in flight to host (`future`
@@ -198,6 +206,10 @@ class CacheManager:
         # bumped by rebuild_arena(); sessions opened under an older epoch
         # hold table state describing KV that no longer exists
         self.arena_epoch = 0
+        # per-seq validity epoch: stamped at allocation, RE-stamped on
+        # rebuild for sequences whose KV was host-parked at that moment
+        # (their copies survive the rebuild, so they stay servable)
+        self._seq_epoch: dict[int, int] = {}
         self._live_seqs: set[int] = set()
         self.num_layers = num_layers
         self.page_size = page_size
@@ -288,6 +300,7 @@ class CacheManager:
         with self._lock:
             for sid in handle.seq_ids:
                 self.table.add_seq(sid)
+                self._seq_epoch[sid] = self.arena_epoch
             self._live_seqs.update(handle.seq_ids)
         try:
             yield handle
@@ -297,6 +310,7 @@ class CacheManager:
                     if self.table.has_seq(sid):
                         self.table.drop_seq(sid)
                     self._parked.pop(sid, None)
+                    self._seq_epoch.pop(sid, None)
                     self._live_seqs.discard(sid)
             async with cond:
                 self._reserved_tokens -= need
@@ -558,14 +572,29 @@ class CacheManager:
         """Replace a consumed arena with a fresh zeroed one after a kernel
         failure destroyed the donated buffers mid-chain (e.g. a paged
         failure between layer_step calls on the offload path). Every live
-        device-resident sequence's KV is gone, so their table state resets
-        to zero length and `arena_epoch` bumps — the server fails any step
-        from a pre-rebuild session loudly and its client replays history
-        onto a fresh chain (the same path that handles a dead server).
-        Host-parked sequences keep their copies: they unpark into the new
-        arena intact."""
+        device-RESIDENT sequence's KV is gone: their table state resets to
+        zero length and their validity epoch goes stale, so the server
+        fails their next step with a typed `session_lost` and the client
+        replays history onto a fresh chain (the same path that handles a
+        dead server). Host-parked sequences keep their copies AND get
+        re-stamped to the new epoch: their next step unparks into the
+        fresh arena intact, no replay needed (advisor, round 4)."""
         for sid in list(self._live_seqs):
             if self.table.has_seq(sid) and sid not in self._parked:
                 self.table.reset_seq(sid)
         self.arena = self._make_arena()
         self.arena_epoch += 1
+        for sid in self._parked:
+            if sid in self._seq_epoch:
+                self._seq_epoch[sid] = self.arena_epoch
+
+    @_locked
+    def epoch_valid(self, handle: "CacheHandle") -> bool:
+        """True iff every sequence in `handle` still has servable KV: its
+        validity epoch matches the current arena epoch (either no rebuild
+        happened since allocation, or the seq was host-parked through every
+        rebuild)."""
+        return all(
+            self._seq_epoch.get(sid) == self.arena_epoch
+            for sid in handle.seq_ids
+        )

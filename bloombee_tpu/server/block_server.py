@@ -28,7 +28,11 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from bloombee_tpu.kv.cache_manager import CacheManager
+from bloombee_tpu.kv.cache_manager import (
+    CacheManager,
+    ParkedKVLost,
+    SessionKVLost,
+)
 from bloombee_tpu.models.spec import ModelSpec
 from bloombee_tpu.runtime.executor import SpanExecutor
 from bloombee_tpu.server.compute_queue import (
@@ -87,8 +91,6 @@ class _Session:
         self.batch_size = batch_size
         self.layers = layers  # relative (l0, l1) within this server's span
         self.adapter = adapter  # per-request LoRA adapter name (or base)
-        self.arena_epoch = 0  # manager.arena_epoch at open; a rebuild
-        # in between means this session's KV no longer exists
         self.push_inbox: asyncio.Queue = asyncio.Queue()
         self.step_tasks: set[asyncio.Task] = set()  # in-flight mb chunks
         self.last_step_at = 0.0  # idle measure for the parking reclaimer
@@ -420,6 +422,7 @@ class BlockServer:
             wire_dtype=self.wire_dtype,
             next_pings=self.next_pings.to_wire() or None,
             adapters=sorted(self.adapter_factors) or None,
+            decode_n_max=self.decode_n_max,
         )
 
     async def _announce(self, state: ServerState) -> None:
@@ -513,7 +516,6 @@ class BlockServer:
             import time as _time
 
             session = _Session(session_id, handle, batch, layers, adapter)
-            session.arena_epoch = self.manager.arena_epoch
             session.opened_at = _time.monotonic()
             session.last_step_at = session.opened_at
             self._sessions[session_id] = session
@@ -612,9 +614,44 @@ class BlockServer:
             except Exception:
                 pass
 
+    async def _maybe_reply_session_lost(
+        self, session: _Session, stream: Stream, meta: dict, e: Exception
+    ) -> bool:
+        """Classify a step failure: when this session's KV is gone (arena
+        rebuilt, or a parked copy lost), reply the typed `session_lost` so
+        the client replays WITHOUT banning the healthy server (advisor,
+        round 4). Covers both the step that finds a stale epoch and the
+        step whose own failure consumed the arena (the executor rebuilds
+        before re-raising, so the epoch is stale by reply time)."""
+        if isinstance(
+            e, (SessionKVLost, ParkedKVLost)
+        ) or not self.manager.epoch_valid(session.handle):
+            await stream.send(
+                {
+                    "step": meta.get("step"),
+                    "session_lost": True,
+                    "reason": str(e),
+                }
+            )
+            return True
+        return False
+
     async def _run_step(
         self, session: _Session, stream: Stream, meta: dict, tensors: list
     ) -> None:
+        if not self.manager.epoch_valid(session.handle):
+            # cheap pre-check so a stale session's accept/decode never
+            # touches zeroed table state (authoritative check re-runs on
+            # the compute thread, racing rebuilds are classified below)
+            await stream.send(
+                {
+                    "step": meta.get("step"),
+                    "session_lost": True,
+                    "reason": "server KV arena was rebuilt; session cache "
+                    "lost — replay",
+                }
+            )
+            return
         # speculative accept from the previous round: compact surviving KV
         # rows onto the committed prefix before this step's compute
         accept = meta.get("accept")
@@ -627,12 +664,19 @@ class BlockServer:
                 )
                 session.step_tasks.add(task)
                 task.add_done_callback(session.step_tasks.discard)
-            await self.compute.submit(
-                PRIORITY_INFERENCE,
-                self.manager.accept_speculative,
-                session.handle,
-                [np.asarray(a, dtype=np.int64) for a in accept],
-            )
+            try:
+                await self.compute.submit(
+                    PRIORITY_INFERENCE,
+                    self.manager.accept_speculative,
+                    session.handle,
+                    [np.asarray(a, dtype=np.int64) for a in accept],
+                )
+            except Exception as e:
+                if await self._maybe_reply_session_lost(
+                    session, stream, meta, e
+                ):
+                    return
+                raise
         if meta.get("accept_only"):
             await stream.send({"step": meta.get("step"), "ack": True})
             return
@@ -682,17 +726,24 @@ class BlockServer:
             commit_lens = [int(x) for x in commit_lens]
             if rows is not None:
                 commit_lens = commit_lens[rows[0]:rows[1]]
-        out_dev, t_dispatch_ms = await self.compute.submit(
-            PRIORITY_INFERENCE,
-            self._compute_step,
-            session,
-            handle,
-            hidden,
-            commit,
-            tree_mask,
-            depths,
-            commit_lens,
-        )
+        try:
+            out_dev, t_dispatch_ms = await self.compute.submit(
+                PRIORITY_INFERENCE,
+                self._compute_step,
+                session,
+                handle,
+                hidden,
+                commit,
+                tree_mask,
+                depths,
+                commit_lens,
+            )
+        except Exception as e:
+            if await self._maybe_reply_session_lost(
+                session, stream, meta, e
+            ):
+                return
+            raise
         import time as _time
 
         t0 = _time.perf_counter()
@@ -839,8 +890,8 @@ class BlockServer:
         import time as _time
 
         def _dispatch():
-            if session.arena_epoch != self.manager.arena_epoch:
-                raise RuntimeError(
+            if not self.manager.epoch_valid(session.handle):
+                raise SessionKVLost(
                     "server KV arena was rebuilt; session cache lost — "
                     "replay"
                 )
@@ -853,9 +904,16 @@ class BlockServer:
             )
             return out, (_time.perf_counter() - t0) * 1000.0
 
-        out_dev, t_dispatch_ms = await self.compute.submit(
-            PRIORITY_INFERENCE, _dispatch
-        )
+        try:
+            out_dev, t_dispatch_ms = await self.compute.submit(
+                PRIORITY_INFERENCE, _dispatch
+            )
+        except Exception as e:
+            if await self._maybe_reply_session_lost(
+                session, stream, meta, e
+            ):
+                return
+            raise
         t0 = _time.perf_counter()
         toks = await asyncio.to_thread(
             lambda: np.asarray(out_dev, dtype=np.int32)
@@ -952,11 +1010,13 @@ class BlockServer:
         handler.py:1276-1605)."""
         import time
 
-        if session.arena_epoch != self.manager.arena_epoch:
-            # the arena was rebuilt after a kernel failure: this session's
-            # table state describes KV that no longer exists — fail loudly
+        if not self.manager.epoch_valid(handle):
+            # the arena was rebuilt after a kernel failure and this
+            # session's KV was device-resident (not parked): its table
+            # state describes KV that no longer exists — fail loudly with
+            # the typed error so the client replays without banning us
             # (a silent step would compute on a zeroed context)
-            raise RuntimeError(
+            raise SessionKVLost(
                 "server KV arena was rebuilt; session cache lost — replay"
             )
         session.last_step_at = time.monotonic()
@@ -1102,16 +1162,17 @@ class BlockServer:
 
         bsz, t = tokens.shape
 
-        def _build_features():
-            # O(B*T) Python loop with full-vocab entropy sweeps: runs on a
-            # plain worker thread so it can never add jitter to decode steps
-            # waiting on the serialized compute queue (advisor, round 2).
-            # The head forward inside .probs() is a device call, but it is
-            # tiny and jax dispatch is itself thread-safe; only the TRAIN
-            # step below rides the queue (it mutates trainer state).
-            all_probs = mgr._head.probs(
+        def _head_probs():
+            # ONE small matmul: rides the compute queue at training
+            # priority like every other device forward (the queue's
+            # documented contract is that all device work funnels through
+            # its single thread — advisor, round 4), while the O(B*T)
+            # numpy feature loop below stays on a plain worker thread.
+            return mgr._head.probs(
                 hidden.reshape(bsz * t, -1).astype(np.float32)
             ).reshape(bsz, t, -1)
+
+        def _build_features(all_probs):
             feat_rows, label_rows = [], []
             for i, acc in enumerate(accept):
                 tree = DraftTree(tokens=tokens[i], parents=parents)
@@ -1126,7 +1187,12 @@ class BlockServer:
             return np.concatenate(feat_rows), np.concatenate(label_rows)
 
         try:
-            feats, labels = await asyncio.to_thread(_build_features)
+            all_probs = await self.compute.submit(
+                PRIORITY_TRAINING, _head_probs
+            )
+            feats, labels = await asyncio.to_thread(
+                _build_features, all_probs
+            )
             loss = await self.compute.submit(
                 PRIORITY_TRAINING, mgr.neural_trainer.train_step,
                 feats, labels,

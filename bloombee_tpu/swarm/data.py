@@ -38,6 +38,10 @@ class ServerInfo:
     # adapters field, data_structures.py); routing filters on these when the
     # client sets ClientConfig.active_adapter
     adapters: list[str] | None = None
+    # largest n accepted per decode_n RPC; the client clamps its chunk to
+    # this BEFORE the first call (a larger chunk would be declined and
+    # silently cost the whole fast path — advisor, round 4)
+    decode_n_max: int | None = None
 
     def to_wire(self) -> dict:
         d = dataclasses.asdict(self)
