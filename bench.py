@@ -44,6 +44,15 @@ _EMITTED = threading.Lock()
 _emitted = False
 
 
+def phase(name: str, status: str) -> None:
+    """Phase ledger: every phase records started/ok/failed/skipped so a
+    degraded run still shows WHICH phases are code-ready vs blocked (a
+    bare rc=3 JSON is indistinguishable from missing phases — round-4
+    verdict)."""
+    RESULTS.setdefault("phases", {})[name] = status
+    log(f"[phase] {name}: {status}")
+
+
 def emit_json():
     # exactly one JSON line, even if the watchdog fires while main is
     # finishing (both call emit_json around the same instant)
@@ -91,6 +100,18 @@ def _emit_json_locked():
         out["ctx4k_paged_steps_per_s"] = round(ctx.get("paged", 0.0), 1)
         out["ctx4k_dense_steps_per_s"] = round(ctx.get("dense", 0.0), 1)
         out["ctx4k_paged_speedup"] = round(ctx.get("speedup", 0.0), 2)
+        if "paged_int4" in ctx:
+            out["ctx4k_paged_int4_steps_per_s"] = round(
+                ctx["paged_int4"], 1
+            )
+    chain = RESULTS.get("chain")
+    if chain:
+        out["server_decode_chain_steps_per_s"] = round(
+            chain.get("steps_per_sec", 0.0), 1
+        )
+        out["server_decode_chain_chunk"] = chain.get("chunk", 0)
+    if RESULTS.get("phases"):
+        out["phases"] = RESULTS["phases"]
     if RESULTS.get("degraded"):
         out["degraded"] = RESULTS["degraded"]
     print(json.dumps(out), flush=True)
@@ -214,7 +235,9 @@ def main():
     if smoke:
         log("SMOKE MODE: tiny dims; numbers are meaningless")
 
+    phase("backend", "ok")
     log(f"devices: {jax.devices()}")
+    phase("fused_proxy", "started")
     params = stack_params(
         [
             init_block_params(jax.random.PRNGKey(i), spec, dtype=jnp.bfloat16)
@@ -331,6 +354,7 @@ def main():
     equiv_per_seq = steps_per_sec / spans_per_model
     equiv_batch = batch_tok_per_sec / spans_per_model
     RESULTS["proxy_equiv_per_seq"] = equiv_per_seq
+    phase("fused_proxy", "ok")
     log(
         f"fused-scan proxy: {steps_per_sec:.1f} steps/s; 8B-equiv per-seq "
         f"{equiv_per_seq:.1f} tok/s, batch({B}) {equiv_batch:.0f} tok/s; "
@@ -341,8 +365,10 @@ def main():
     # (committed harness for the paged kernel's headline win; previously
     # only an ad-hoc loop in git history)
     try:
-        run_longctx(spec, params, B, smoke)
+        phase("longctx", "started")
+        run_longctx(spec, params, B, smoke)  # marks itself ok/skipped
     except Exception as e:  # noqa: BLE001
+        phase("longctx", f"failed: {e!r}"[:200])
         RESULTS.setdefault("degraded", f"longctx phase failed: {e!r}")
         log(f"longctx phase FAILED: {e!r}")
 
@@ -395,7 +421,8 @@ def run_longctx(spec, params, B, smoke: bool) -> None:
 
     interpret = _env.get("BBTPU_PAGED_INTERPRET")
     if jax.default_backend() != "tpu" and not interpret:
-        log("longctx: no TPU backend and no BBTPU_PAGED_INTERPRET; skipped")
+        phase("longctx", "skipped: no TPU backend (set "
+              "BBTPU_PAGED_INTERPRET to force)")
         return
     CTX = 256 if smoke else 4096
     page_size = 16
@@ -435,34 +462,73 @@ def run_longctx(spec, params, B, smoke: bool) -> None:
 
     results = {}
     steps = 4 if smoke else 32
-    for name, use_paged in (("dense", False), ("paged", True)):
-        ak, av = arena["k"], arena["v"]
-        t0 = time.time()
-        out, ak, av = span_step_packed(
-            params, ak, av, payload, None, None,
-            spec=spec, b=B, t=1, page_size=page_size, max_pages=pb,
-            use_paged=use_paged,
-            windows=tuple(0 for _ in range(span_layers)),
-        )
-        fence(out)
-        log(f"longctx {name} compile+run: {time.time()-t0:.1f}s")
-        t0 = time.time()
-        for _ in range(steps):
+    # third variant: the int4-quantized arena through the in-VMEM-dequant
+    # paged kernel — never yet timed on real TPU hardware (round-4
+    # verdict: the quantized serving claim is untested until it is)
+    arena_q = None
+    for name, use_paged in (
+        ("dense", False), ("paged", True), ("paged_int4", True)
+    ):
+        try:
+            if name == "paged_int4":
+                # allocate only now: a second full arena held during the
+                # dense/paged timings would double KV memory (allocator
+                # pressure skews their numbers and can OOM large contexts)
+                arena_q = make_arena(
+                    span_layers, num_pages, page_size,
+                    spec.num_key_value_heads, spec.head_dim, jnp.bfloat16,
+                    quant="int4",
+                )
+            cur = arena_q if name == "paged_int4" else arena
+            ak, av = cur["k"], cur["v"]
+            t0 = time.time()
             out, ak, av = span_step_packed(
                 params, ak, av, payload, None, None,
                 spec=spec, b=B, t=1, page_size=page_size, max_pages=pb,
                 use_paged=use_paged,
                 windows=tuple(0 for _ in range(span_layers)),
             )
-        fence(out)
-        dt = max(time.time() - t0 - RESULTS.get("fence_ms", 0.0) / 1e3, 1e-9)
-        results[name] = steps / dt
-        arena = {"k": ak, "v": av}
-    results["speedup"] = results["paged"] / max(results["dense"], 1e-9)
+            fence(out)
+            log(f"longctx {name} compile+run: {time.time()-t0:.1f}s")
+            t0 = time.time()
+            for _ in range(steps):
+                out, ak, av = span_step_packed(
+                    params, ak, av, payload, None, None,
+                    spec=spec, b=B, t=1, page_size=page_size, max_pages=pb,
+                    use_paged=use_paged,
+                    windows=tuple(0 for _ in range(span_layers)),
+                )
+            fence(out)
+            dt = max(
+                time.time() - t0 - RESULTS.get("fence_ms", 0.0) / 1e3, 1e-9
+            )
+            results[name] = steps / dt
+            # donation consumed the inputs; carry the outputs forward
+            if name == "paged_int4":
+                arena_q = {"k": ak, "v": av}
+            else:
+                arena = {"k": ak, "v": av}
+            phase(f"longctx_{name}", "ok")
+        except Exception as e:  # noqa: BLE001 — one variant must not sink
+            # the rest, but a failed variant IS a degraded run: automated
+            # consumers key on 'degraded', not on a zero-valued metric
+            phase(f"longctx_{name}", f"failed: {e!r}"[:200])
+            RESULTS.setdefault("degraded", f"longctx {name} failed: {e!r}")
+            log(f"longctx {name} FAILED: {e!r}")
+    if "paged" in results and "dense" in results:
+        results["speedup"] = results["paged"] / max(results["dense"], 1e-9)
+        log(
+            f"longctx ctx={CTX}: paged {results['paged']:.1f} steps/s vs "
+            f"dense {results['dense']:.1f} steps/s "
+            f"({results['speedup']:.2f}x)"
+        )
+    if "paged_int4" in results:
+        log(f"longctx ctx={CTX}: paged_int4 {results['paged_int4']:.1f} "
+            "steps/s")
     RESULTS["ctx4k"] = results
-    log(
-        f"longctx ctx={CTX}: paged {results['paged']:.1f} steps/s vs dense "
-        f"{results['dense']:.1f} steps/s ({results['speedup']:.2f}x)"
+    phase(
+        "longctx",
+        "ok" if len(results) >= 4 else "partial (see longctx_* phases)",
     )
 
 
@@ -539,6 +605,7 @@ def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
             elapsed = time.time() - t0
         timing = sess.timing_summary()  # decode-step rows
         steps_per_sec = n_timed / elapsed
+        phase("served_per_step", "ok")
         # stash phase-A results now: phase B may wedge the backend
         result = {
             "steps_per_sec": steps_per_sec,
@@ -584,14 +651,82 @@ def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
                 result["effective_equiv_tok_per_s"],
                 sd_steps * B / spans_per_model,
             )
+            phase("served_decode_n", "ok")
             log(
                 f"served decode_n: {sd_steps:.1f} steps/s "
                 f"({sd_steps / spans_per_model:.1f} 8B-equiv tok/s/seq, "
                 f"chunk {CHUNK})"
             )
         except Exception as e:  # noqa: BLE001
+            phase("served_decode_n", f"failed: {e!r}"[:200])
             RESULTS.setdefault("degraded", f"decode_n phase failed: {e!r}")
             log(f"served decode_n phase FAILED: {e!r}")
+
+        # ---- phase A3: CHAINED decode_n across a 2-server split of the
+        # span — the north-star topology's answer to per-token client RTTs
+        # (spans push hidden server-to-server; the tail selects and pushes
+        # ids back to span 0; the client pays ONE RTT per chunk)
+        srv1 = srv2 = None
+        try:
+            phase("served_decode_n_chain", "started")
+            import jax as __jax
+
+            half = span_layers // 2
+            p_lo = __jax.tree.map(lambda x: x[:half], params)
+            p_hi = __jax.tree.map(lambda x: x[half:], params)
+            srv1 = BlockServer(
+                model_uid="bench_chain", start=0, end=half, params=p_lo,
+                spec=spec, registry=rc(), num_pages=384, page_size=16,
+                client_params=client_params,
+            )
+            srv2 = BlockServer(
+                model_uid="bench_chain", start=half, end=span_layers,
+                params=p_hi, spec=spec, registry=rc(), num_pages=384,
+                page_size=16, client_params=client_params,
+            )
+            await srv1.start()
+            await srv2.start()
+            mgr_ch = RemoteSequenceManager(rc(), "bench_chain", span_layers)
+            CH = 8 if DECODE <= 8 else 32
+            CH_ROUNDS = max(1, DECODE // CH)
+            sess_ch = InferenceSession(
+                mgr_ch, max_length=PREFILL + CH * (CH_ROUNDS + 2),
+                batch_size=B,
+            )
+            async with sess_ch:
+                await sess_ch.step(hidden)
+                t0 = time.time()
+                toks = await sess_ch.decode_n(np.zeros((B,), np.int32), CH)
+                log(
+                    f"chained decode_n({CH}) compile+run: "
+                    f"{time.time()-t0:.1f}s"
+                )
+                t0 = time.time()
+                for _ in range(CH_ROUNDS):
+                    toks = await sess_ch.decode_n(toks[:, -1], CH)
+                wall = time.time() - t0
+            ch_steps = CH_ROUNDS * CH / wall
+            RESULTS["chain"] = {"steps_per_sec": ch_steps, "chunk": CH}
+            phase("served_decode_n_chain", "ok")
+            log(
+                f"chained decode_n (2 spans): {ch_steps:.1f} steps/s "
+                f"(chunk {CH})"
+            )
+        except Exception as e:  # noqa: BLE001
+            phase("served_decode_n_chain", f"failed: {e!r}"[:200])
+            RESULTS.setdefault(
+                "degraded", f"decode_n_chain phase failed: {e!r}"
+            )
+            log(f"chained decode_n phase FAILED: {e!r}")
+        finally:
+            # stop even on failure: two leaked half-span servers would pin
+            # their arenas + params through the multi-session phase
+            for srv in (srv1, srv2):
+                if srv is not None:
+                    try:
+                        await asyncio.wait_for(srv.stop(), timeout=30.0)
+                    except Exception:  # noqa: BLE001
+                        pass
 
         # ---- phase B: N_SESS concurrent sessions — round trips overlap,
         # aggregate throughput approaches the device ceiling (the role of
@@ -617,6 +752,7 @@ def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
         if pending:
             wedged = True
             gather_task.cancel()  # best-effort; deliberately not awaited
+            phase("multisession", "failed: timed out after 300s")
             RESULTS.setdefault(
                 "degraded",
                 "multi-session phase timed out after 300s (backend wedged?); "
@@ -631,6 +767,7 @@ def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
             result["effective_equiv_tok_per_s"] = (
                 eff_steps_per_sec * B / spans_per_model
             )
+            phase("multisession", "ok")
 
         if not wedged:
             # TTFT on a fresh session with warm buckets (skipped when the

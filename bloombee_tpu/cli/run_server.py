@@ -65,6 +65,15 @@ def main(argv=None):
                              "(clients pick one via active_adapter; "
                              "bare DIR uses its basename as the name)")
     parser.add_argument("--announce-period", type=float, default=5.0)
+    parser.add_argument("--rebalance-period", type=float, default=None,
+                        help="seconds between swarm-balance checks; the "
+                             "server drains and moves its span when the "
+                             "least-served window beats the hysteresis "
+                             "(0 disables; default 300, or 0 when --blocks "
+                             "pins the span; reference server.py:479-542)")
+    parser.add_argument("--drain-timeout", type=float, default=30.0,
+                        help="how long a rebalance waits for live sessions "
+                             "before swapping the span under them")
     parser.add_argument("--weight-quant", default=None,
                         choices=["none", "int8", "int4"],
                         help="weight-only quantization for the served span "
@@ -121,6 +130,10 @@ def main(argv=None):
     async def run():
         if args.blocks:
             start, end = (int(x) for x in args.blocks.split(":"))
+            if args.rebalance_period is None:
+                # operator pinned the span: do not auto-move it out from
+                # under them unless they ALSO asked for rebalancing
+                args.rebalance_period = 0.0
         else:
             infos = await registry.get_module_infos(
                 model_uid, range(spec.num_hidden_layers)
@@ -149,6 +162,11 @@ def main(argv=None):
             idle_park_s=args.idle_park_s,
             offload_layers=args.offload_layers,
             attn_sparsity=args.attn_sparsity,
+            rebalance_period=(
+                300.0 if args.rebalance_period is None
+                else args.rebalance_period
+            ),
+            drain_timeout=args.drain_timeout,
         )
         await server.start()
         if args.warmup_batches:

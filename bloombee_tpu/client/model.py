@@ -220,9 +220,10 @@ class DistributedModelForCausalLM:
                 use_sd
                 and not do_sample
                 and max_new_tokens > 0
-                and len(session._spans) == 1
+                and session._spans
                 and session._spans[0].span.start == 0
-                and session._spans[0].span.end == self.spec.num_hidden_layers
+                and session._spans[-1].span.end == self.spec.num_hidden_layers
+                and (len(session._spans) == 1 or session.use_push)
             ):
                 # a declining server is handled INSIDE (per-step continuation
                 # on the same session — its KV already holds the prefill)

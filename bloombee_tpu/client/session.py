@@ -471,12 +471,17 @@ class InferenceSession:
         # decline on mismatch so logits stay identical across both paths
     ) -> np.ndarray:
         """Server-side multi-step greedy decode: one RPC returns [B, n] token
-        ids (runtime/decode_loop.py — the round-trip-amortizing fast path).
-        Only valid when the session's route is ONE span covering the whole
-        model; raises DecodeNUnsupported when the server declines, so the
-        caller can fall back to per-step decoding.
+        ids — the round-trip-amortizing fast path. Single-span routes run
+        the fused on-device scan (runtime/decode_loop.py) or the server's
+        host-driven loop; multi-span routes run CHAINED decode: span 0
+        embeds and coordinates, hidden states hop server-to-server via
+        rpc_push, the tail span applies norm+head+select and pushes each
+        next id back to span 0, which replies all n ids at once. Either
+        way the client pays ONE round trip per n tokens. Raises
+        DecodeNUnsupported when the server declines, so the caller can
+        fall back to per-step decoding.
 
-        The server writes n tokens of KV (the input token plus the first
+        The servers write n tokens of KV (the input token plus the first
         n-1 selected tokens), so position advances by n and those ids enter
         the replay history.
 
@@ -496,10 +501,7 @@ class InferenceSession:
                 "failure recovery (id history cannot be re-embedded); use "
                 "model.inference_session() for recoverable decode"
             )
-        if len(self._spans) != 1:
-            raise DecodeNUnsupported(
-                "decode_n needs a single-span route covering the whole model"
-            )
+        self._check_decode_n_route()
         ids = np.asarray(ids).reshape(-1).astype(np.int32)
         attempt = 0
         while True:
@@ -507,10 +509,7 @@ class InferenceSession:
                 if self._needs_rebuild:
                     await self._recover()
                     self._needs_rebuild = False
-                    if len(self._spans) != 1:
-                        raise DecodeNUnsupported(
-                            "re-routed onto a multi-span chain"
-                        )
+                    self._check_decode_n_route()
                 toks = await self._decode_n_once(
                     ids, n, eos_token_id, finished, head_dtype
                 )
@@ -524,10 +523,10 @@ class InferenceSession:
                 )
                 try:
                     await self._recover()
-                    if len(self._spans) != 1:
-                        raise DecodeNUnsupported(
-                            "re-routed onto a multi-span chain"
-                        )
+                    # recovery replayed the full history; a dirty-decline's
+                    # pending rebuild is satisfied
+                    self._needs_rebuild = False
+                    self._check_decode_n_route()
                 except (RpcError, OSError, asyncio.TimeoutError) as e2:
                     logger.warning("recovery attempt failed: %s", e2)
                     await asyncio.sleep(min(0.2 * attempt, 2.0))
@@ -538,6 +537,25 @@ class InferenceSession:
                 self._id_rows[i].extend(int(t) for t in row)
             self.position += n
             return toks
+
+    def _check_decode_n_route(self) -> None:
+        """decode_n needs a route whose spans cover the whole model: span 0
+        embeds (must enter at block 0) and the tail applies the head (must
+        end at the last block). Multi-span routes additionally chain via
+        server-to-server push."""
+        if not self._spans:
+            return  # closed chain surfaces as RpcError in _decode_n_once
+        if (
+            self._spans[0].span.start != 0
+            or self._spans[-1].span.end != self.manager.num_blocks
+        ):
+            raise DecodeNUnsupported(
+                "route does not cover the whole model"
+            )
+        if len(self._spans) > 1 and not self.use_push:
+            raise DecodeNUnsupported(
+                "chained decode_n needs push transport (use_push=True)"
+            )
 
     async def _decode_n_once(
         self, ids, n, eos_token_id, finished, head_dtype=None
@@ -553,14 +571,31 @@ class InferenceSession:
             meta["finished"] = np.asarray(finished, dtype=bool).tolist()
         if head_dtype is not None:
             meta["head_dtype"] = head_dtype
+        if len(self._spans) > 1:
+            # chained decode: span 0 coordinates; give it the downstream
+            # hops (same wire shape as the per-step push route)
+            meta["route"] = [
+                {
+                    "host": s.span.server_info.host,
+                    "port": s.span.server_info.port,
+                    "session_id": s.session_id,
+                }
+                for s in self._spans[1:]
+            ]
         span_sess = self._spans[0]
         import time
 
         t_start = time.perf_counter()
         try:
             await span_sess.stream.send(meta, [ids])
+            # one RPC covers n whole-model steps; chained routes also pay
+            # per-token server-to-server hops and may hit cold XLA
+            # compiles on MIDDLE/TAIL spans (the coordinator itself allows
+            # chain_step_timeout=120s per hop for that) — budget at least
+            # two cold compiles so a healthy coordinator is never banned
+            # for its downstream spans' first-step compile time
             item = await asyncio.wait_for(
-                span_sess.stream.recv(), self.step_timeout
+                span_sess.stream.recv(), 2 * self.step_timeout + float(n)
             )
         except (RpcError, OSError, asyncio.TimeoutError):
             self.manager.ban_peer(span_sess.span.peer_id)
@@ -571,6 +606,19 @@ class InferenceSession:
         resp_meta, resp_tensors = item
         _raise_if_session_lost(resp_meta)
         if resp_meta.get("decode_n_unsupported"):
+            if resp_meta.get("dirty"):
+                # a chained decode failed mid-way: spans hold ragged extra
+                # KV beyond the committed history — rebuild-and-replay on
+                # the session's next use restores exact state
+                self._needs_rebuild = True
+            if resp_meta.get("transient"):
+                # a span died mid-chain (not a capability decline): surface
+                # as a wire error so the retry loop rebuilds the route,
+                # replays, and RETRIES chained decode instead of dropping
+                # the fast path for the rest of the generation
+                raise RpcError(
+                    resp_meta.get("reason") or "chained decode_n failed"
+                )
             raise DecodeNUnsupported(
                 resp_meta.get("reason")
                 or "server declined decode_n for this session"

@@ -166,6 +166,9 @@ class CacheHandle:
 
 
 class CacheManager:
+    _global_seq_counter = itertools.count()
+    _global_handle_counter = itertools.count()
+
     def __init__(
         self,
         num_layers: int,
@@ -216,8 +219,13 @@ class CacheManager:
         self.capacity_tokens = num_pages * page_size
         self._reserved_tokens = 0
         self._cond: asyncio.Condition | None = None
-        self._seq_counter = itertools.count()
-        self._handle_counter = itertools.count()
+        # PROCESS-wide counters (class attributes set below), not
+        # per-manager: a server that rebalances swaps in a fresh manager
+        # while old sessions' handles are still live — per-manager counters
+        # restarting at 0 would alias an old handle's seq ids onto a new
+        # session's KV (epoch_valid would then wrongly pass)
+        self._seq_counter = CacheManager._global_seq_counter
+        self._handle_counter = CacheManager._global_handle_counter
         self._parked: dict[int, _Parked] = {}
         # d2h copies of parked KV run here so parking never stalls the
         # compute thread (the copy engine half of the reference's async

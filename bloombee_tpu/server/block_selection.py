@@ -42,17 +42,20 @@ def choose_best_blocks(
     return best_start, best_start + num_blocks
 
 
-def should_choose_other_blocks(
+def rebalance_target(
     peer_id: str,
     module_infos: list[ModuleInfo],
     spans: dict[str, RemoteSpanInfo],
-) -> bool:
-    """Would moving this server's span to the current best window improve
-    the swarm's bottleneck throughput by more than the hysteresis margin?
-    (reference :40-95 simulates the move the same way)."""
+) -> tuple[int, int] | None:
+    """The (start, end) this server should move its span to, or None when
+    staying put is within the hysteresis margin. Simulates leaving and
+    re-landing at every window, keeping the one that maximizes the swarm's
+    bottleneck (minimum per-block) throughput; a move only wins if it
+    beats the current bottleneck by more than BALANCE_QUALITY (reference
+    should_choose_other_blocks, block_selection.py:40-95)."""
     my_span = spans.get(peer_id)
     if my_span is None:
-        return True
+        return None
     tput = block_throughputs(module_infos)
     current_min = float(tput.min())
 
@@ -61,14 +64,28 @@ def should_choose_other_blocks(
     without[my_span.start : my_span.end] -= my_span.server_info.throughput or 0.0
     # best place to re-land
     n = my_span.length
-    best = None
+    best, best_start = None, None
     for start in range(len(tput) - n + 1):
         cand = without.copy()
         cand[start : start + n] += my_span.server_info.throughput or 0.0
         m = float(cand.min())
         if best is None or m > best:
-            best = m
-    return best is not None and best * BALANCE_QUALITY > current_min
+            best, best_start = m, start
+    if best is not None and best * BALANCE_QUALITY > current_min:
+        return (best_start, best_start + n)
+    return None
+
+
+def should_choose_other_blocks(
+    peer_id: str,
+    module_infos: list[ModuleInfo],
+    spans: dict[str, RemoteSpanInfo],
+) -> bool:
+    """Would moving this server's span improve the swarm's bottleneck
+    throughput by more than the hysteresis margin?"""
+    if spans.get(peer_id) is None:
+        return True
+    return rebalance_target(peer_id, module_infos, spans) is not None
 
 
 def estimate_block_bytes(spec, dtype) -> int:
@@ -119,14 +136,19 @@ def choose_num_blocks(
 
 
 async def rebalance_if_needed(server) -> bool:
-    """Periodic check a server can run: fetch swarm state, decide, and
-    report (the actual move = stop + restart with new blocks, driven by the
-    operator or a supervisor loop)."""
+    """Periodic check driven by the server's supervisor loop: fetch swarm
+    state, decide, and MOVE (drain, reload the new span, re-announce) via
+    server.rebalance_to. Returns True when a move happened (reference
+    server.py:479-542 _should_choose_other_blocks + restart loop)."""
     from bloombee_tpu.swarm.spans import compute_spans
 
     infos = await server.registry.get_module_infos(
         server.model_uid, range(server.spec.num_hidden_layers)
     )
-    return should_choose_other_blocks(
+    target = rebalance_target(
         server.server_id, infos, compute_spans(infos)
     )
+    if target is None or target == (server.start_block, server.end_block):
+        return False
+    await server.rebalance_to(*target)
+    return True

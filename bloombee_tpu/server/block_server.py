@@ -73,6 +73,19 @@ env.declare(
     "reference simple_probability_pruner) or 'neural' (learned MLP over "
     "probability features, reference adaptive_neural_pruner)",
 )
+class _ChainError(RuntimeError):
+    """A downstream span of a chained decode_n reported failure (pushed
+    back as `chain_error`). `permanent` distinguishes capability declines
+    (tail has no head params / dtype mismatch — retrying the same route
+    can never work, the client should fall back to per-step) from
+    transient route failures (a span died mid-chain — the client should
+    rebuild, replay, and RETRY chained decode on the fresh route)."""
+
+    def __init__(self, msg: str, permanent: bool = False):
+        super().__init__(msg)
+        self.permanent = permanent
+
+
 env.declare(
     "BBTPU_WEIGHT_QUANT", str, "none",
     "weight-only quantization for served spans: none | int8 (per-column "
@@ -92,6 +105,11 @@ class _Session:
         self.layers = layers  # relative (l0, l1) within this server's span
         self.adapter = adapter  # per-request LoRA adapter name (or base)
         self.push_inbox: asyncio.Queue = asyncio.Queue()
+        # chained decode_n control messages (the tail span's selected ids /
+        # errors) land here directly from rpc_push — NOT via push_inbox,
+        # whose consumer (the session loop) is blocked inside the
+        # coordinator while it waits for exactly these messages
+        self.chain_inbox: asyncio.Queue = asyncio.Queue()
         self.step_tasks: set[asyncio.Task] = set()  # in-flight mb chunks
         self.last_step_at = 0.0  # idle measure for the parking reclaimer
         # per-session timing accumulators (server half of the reference's
@@ -177,6 +195,13 @@ class BlockServer:
         decode_n_max: int = 256,  # largest decode_n accepted per RPC (a
         # bigger n eagerly commits n KV slots per row before compute, so an
         # unbounded request could exhaust the arena in one call)
+        rebalance_period: float = 0.0,  # >0: periodically check whether
+        # moving this span to the swarm's least-served window beats the
+        # hysteresis margin, and MOVE if so (reference server.py:479-542
+        # module_container restart loop); 0 disables
+        drain_timeout: float = 30.0,  # how long a rebalance waits for live
+        # sessions to finish before swapping the span under them (their
+        # next step then gets the typed session_lost and replays elsewhere)
         offload_layers: int = 0,  # stream the span's last N layers' weights
         # from host per step (FlexGen weight-offload: serve spans larger
         # than HBM; combine with --weight-quant to shrink the streamed
@@ -311,6 +336,10 @@ class BlockServer:
                 compute_dtype=compute_dtype, adapters=self.adapter_factors,
             )
         self.decode_n_max = int(decode_n_max)
+        # per-token budget for a chained decode_n round trip through the
+        # downstream spans (generous: the first chain step may hit a cold
+        # XLA compile on a middle/tail span)
+        self.chain_step_timeout = 120.0
         self.compute = ComputeQueue()
         self.peers = _PeerPool()
         # server-side multi-step decode (decode_n): needs the checkpoint's
@@ -333,6 +362,16 @@ class BlockServer:
         self._pending_pushes: dict[str, list] = {}
         self.pending_push_ttl = 30.0
         self._announce_task: asyncio.Task | None = None
+        self._supervisor_task: asyncio.Task | None = None
+        self._warmup_task: asyncio.Task | None = None
+        self._throughput_task: asyncio.Task | None = None
+        self.rebalance_period = float(rebalance_period)
+        self.drain_timeout = float(drain_timeout)
+        self._rebalancing = False
+        self._kv_quant = kv_quant
+        self._num_pages = num_pages
+        self._adapter_dirs = adapter_dirs
+        self._weight_quant = weight_quant
         self.rpc = RpcServer(
             unary_handlers={
                 "rpc_info": self._rpc_info,
@@ -356,12 +395,24 @@ class BlockServer:
         if self.registry is not None:
             await self._announce(ServerState.ONLINE)
             self._announce_task = asyncio.create_task(self._announce_loop())
+            # the announce loop IS the liveness signal: if it dies, the
+            # registry record expires and the swarm silently loses this
+            # server — supervise and restart it (reference restarts whole
+            # unhealthy containers, server.py:524-541); the supervisor
+            # also drives periodic rebalancing when enabled
+            self._supervisor_task = asyncio.create_task(
+                self._supervisor_loop()
+            )
         logger.info(
             "server %s serving %s[%d:%d] on port %d",
             self.server_id, self.model_uid, self.start_block, self.end_block, self.port,
         )
 
     async def stop(self) -> None:
+        for task in (self._supervisor_task, self._warmup_task,
+                     self._throughput_task):
+            if task is not None:
+                task.cancel()
         if self._announce_task is not None:
             self._announce_task.cancel()
         if self.registry is not None:
@@ -409,6 +460,167 @@ class BlockServer:
             except Exception as e:
                 logger.warning("warmup(batch=%d) failed: %s", b, e)
 
+    async def _supervisor_loop(self) -> None:
+        """Keep the server's background tasks alive and the span balanced.
+
+        - restarts a dead announce loop (its death would silently expire
+          this server from the swarm — reference server.py:524-541 restarts
+          unhealthy containers; here only the loop needs restarting)
+        - surfaces warmup/throughput task failures (one-shots: logged loud,
+          not restarted)
+        - every rebalance_period seconds, checks whether moving the span
+          to the least-served window beats the hysteresis and moves
+          (reference server.py:479-542)."""
+        import time as _time
+
+        last_rebalance = _time.monotonic()
+        tick = max(1.0, min(self.announce_period, 15.0))
+        while True:
+            await asyncio.sleep(tick)
+            if self._announce_task is not None and self._announce_task.done():
+                exc = (
+                    None if self._announce_task.cancelled()
+                    else self._announce_task.exception()
+                )
+                logger.error(
+                    "announce loop died (%s); restarting — without it this "
+                    "server would silently expire from the registry", exc,
+                )
+                self._announce_task = asyncio.create_task(
+                    self._announce_loop()
+                )
+            for name in ("_warmup_task", "_throughput_task"):
+                task = getattr(self, name)
+                if task is not None and task.done():
+                    setattr(self, name, None)  # report once
+                    if not task.cancelled() and task.exception() is not None:
+                        logger.error(
+                            "%s failed: %s", name.strip("_"),
+                            task.exception(),
+                        )
+            if (
+                self.rebalance_period > 0
+                and not self._rebalancing
+                and self.rebalance_unsupported() is None
+                and _time.monotonic() - last_rebalance
+                >= self.rebalance_period
+            ):
+                last_rebalance = _time.monotonic()
+                from bloombee_tpu.server.block_selection import (
+                    rebalance_if_needed,
+                )
+
+                try:
+                    moved = await rebalance_if_needed(self)
+                    if moved:
+                        logger.info(
+                            "rebalanced to [%d:%d)",
+                            self.start_block, self.end_block,
+                        )
+                except Exception as e:
+                    logger.warning("rebalance check failed: %s", e)
+
+    def rebalance_unsupported(self) -> str | None:
+        """Why this server cannot move its span at runtime; None if it can."""
+        if self.model_dir is None:
+            return "no model_dir to load a new span from"
+        if self.executor.host_layers:
+            return "weight-offloaded span"
+        if self.executor.mesh is not None:
+            return "TP-sharded span"
+        if self.spec.heterogeneous:
+            return "heterogeneous span"
+        if self.adapter_factors:
+            return "per-request adapters are span-sliced"
+        if self._weight_quant and self._weight_quant != "none":
+            return "weight-quantized span"
+        return None
+
+    async def rebalance_to(self, start: int, end: int) -> None:
+        """Move this server to blocks [start, end): tombstone the old span,
+        drain sessions (bounded), load the new span's params, swap the
+        manager/executor/training stack, and re-announce. Sessions that
+        outlive the drain get the typed session_lost on their next step
+        (their seq ids are unknown to the fresh manager) and replay onto
+        other servers — the same client path that handles a dead server."""
+        reason = self.rebalance_unsupported()
+        if reason is not None:
+            raise RuntimeError(f"rebalance unsupported: {reason}")
+        self._rebalancing = True
+        try:
+            logger.info(
+                "rebalancing %s [%d:%d) -> [%d:%d)",
+                self.server_id, self.start_block, self.end_block, start, end,
+            )
+            old_range = range(self.start_block, self.end_block)
+            if self.registry is not None:
+                try:
+                    await self.registry.revoke_blocks(
+                        self.model_uid, self.server_id, old_range,
+                        expiration=max(
+                            60.0, self.announce_period * 2.5 + 10.0
+                        ),
+                    )
+                except Exception as e:
+                    logger.warning("revoke of old span failed: %s", e)
+            import time as _time
+
+            deadline = _time.monotonic() + self.drain_timeout
+            while self._sessions and _time.monotonic() < deadline:
+                await asyncio.sleep(0.25)
+            if self._sessions:
+                logger.warning(
+                    "%d session(s) outlived the %.0fs drain; they will "
+                    "replay elsewhere", len(self._sessions),
+                    self.drain_timeout,
+                )
+            from bloombee_tpu.models.checkpoint import load_span_params
+
+            params, spec = await asyncio.to_thread(
+                load_span_params, self.model_dir, start, end,
+                self.compute_dtype, self._adapter_dirs,
+            )
+            manager = CacheManager(
+                num_layers=end - start,
+                num_pages=self._num_pages,
+                page_size=self.manager.page_size,
+                n_kv_heads=spec.num_key_value_heads,
+                head_dim=spec.head_dim,
+                dtype=self.compute_dtype,
+                quant=self._kv_quant,
+                start_block=start,
+                oversubscribe=self.manager.oversubscribe,
+            )
+            if self.manager.reclaimer is not None:
+                manager.reclaimer = self._reclaim_idle
+            executor = SpanExecutor(
+                params, spec, manager,
+                max_chunk_tokens=self.executor.max_chunk_tokens,
+                compute_dtype=self.compute_dtype,
+                start_block=start,
+                attn_sparsity=self.executor.attn_sparsity,
+            )
+            from bloombee_tpu.runtime.training import TrainingExecutor
+
+            training = TrainingExecutor(
+                params, spec, windows=executor.windows,
+                compute_dtype=self.compute_dtype,
+            )
+            # swap atomically from the event loop's view; any step already
+            # queued against the old stack fails its epoch check (the new
+            # manager knows none of the old seq ids) and replies
+            # session_lost
+            self.manager = manager
+            self.executor = executor
+            self.training = training
+            self.start_block = start
+            self.end_block = end
+            self.spec = spec
+            if self.registry is not None:
+                await self._announce(ServerState.ONLINE)
+        finally:
+            self._rebalancing = False
+
     def server_info(self) -> ServerInfo:
         return ServerInfo(
             state=ServerState.ONLINE,
@@ -439,6 +651,13 @@ class BlockServer:
     async def _announce_loop(self) -> None:
         while True:
             await asyncio.sleep(self.announce_period)
+            if self._rebalancing:
+                # mid-move: announcing the OLD span would overwrite the
+                # tombstone (registry merge is latest-write-wins) and keep
+                # routing new sessions onto blocks we are abandoning —
+                # exactly defeating the drain. rebalance_to re-announces
+                # the new span itself when the swap lands.
+                continue
             try:
                 # announce FIRST (liveness must not wait on pings — a slow
                 # successor would expire our registry record); the pings
@@ -481,18 +700,33 @@ class BlockServer:
 
         from bloombee_tpu.wire.tensor_codec import transport_stats
 
-        decline = self._decode_n_ineligible()
+        fused_decline = self._decode_n_ineligible()
+        params_ok = not self._client_params_unavailable and (
+            self._client_params is not None or self.model_dir is not None
+        )
+        whole = (
+            self.start_block == 0
+            and self.end_block == self.spec.num_hidden_layers
+        )
         info = {
             "server_id": self.server_id,
             "server_time": _time.time(),  # NTP-style clock sync anchor
             "transport": transport_stats(),
-            # operator visibility into the decode_n fast path: a client
-            # falling back to per-step decoding is otherwise invisible
-            "decode_n": decline is None,
+            # operator visibility into the decode_n fast paths: a client
+            # falling back to per-step decoding is otherwise invisible.
+            # decode_n: ANY single-span flavor (fused scan or host-driven
+            # stepped loop); decode_n_first/last: the chained-decode roles
+            # this span can play in a multi-server route
+            "decode_n": whole and params_ok,
+            "decode_n_fused": fused_decline is None,
+            "decode_n_first": self.start_block == 0 and params_ok,
+            "decode_n_last": (
+                self.end_block == self.spec.num_hidden_layers and params_ok
+            ),
             **self.server_info().to_wire(),
         }
-        if decline is not None:
-            info["decode_n_decline"] = decline
+        if fused_decline is not None:
+            info["decode_n_decline"] = fused_decline
         if self._client_params is not None:
             info["head_dtype"] = str(self._client_params["lm_head"].dtype)
         return info, []
@@ -639,6 +873,12 @@ class BlockServer:
     async def _run_step(
         self, session: _Session, stream: Stream, meta: dict, tensors: list
     ) -> None:
+        if meta.get("chain") is not None:
+            # pushed hop of a chained decode_n (never from the client
+            # stream): errors go back to the coordinator via chain_error,
+            # not to our own client's stream
+            await self._run_chain_step(session, meta, tensors)
+            return
         if not self.manager.epoch_valid(session.handle):
             # cheap pre-check so a stale session's accept/decode never
             # touches zeroed table state (authoritative check re-runs on
@@ -834,21 +1074,54 @@ class BlockServer:
     async def _run_decode_n(
         self, session: _Session, stream: Stream, meta: dict, tensors: list
     ) -> None:
-        """Server-side multi-step greedy decode (runtime/decode_loop.py):
-        one RPC returns N token ids, amortizing the host<->device round trip
-        that floors per-step serving. Valid only when this session runs the
-        WHOLE model on this server (the client routes it that way); an
-        ineligible server replies decode_n_unsupported so the client falls
-        back to per-step decoding without banning the peer."""
+        """Server-side multi-step greedy decode: one RPC returns N token
+        ids, amortizing the client<->server round trip that floors served
+        throughput. Three flavors, picked per request:
+
+        - FUSED (route empty, dense un-sharded whole-model span): one
+          jitted lax.scan runs embed -> span -> head -> select N times
+          entirely on device (runtime/decode_loop.py) — one host<->device
+          round trip for N tokens.
+        - LOCAL STEPPED (route empty, whole-model span that the scan can't
+          fuse: TP-sharded / quantized KV / weight-offloaded / hetero /
+          sparse): the same loop driven per-step from the host through the
+          ordinary executor paths. Still ONE client RTT per N tokens;
+          per-step device round trips are local and cheap.
+        - CHAINED (route non-empty): this span embeds + computes block 0's
+          prefix and pushes hidden downstream; the LAST span applies
+          norm+head+select and pushes the next id back here; this
+          coordinator replies [B, n] ids after n rounds. The client RTT —
+          the expensive tunnel/DCN hop — is paid once per N tokens; the
+          per-token hops ride server-to-server links. This beats the
+          reference's per-token client loop for the multi-server topology
+          (remote_generation.py:286-386).
+
+        An ineligible server replies decode_n_unsupported so the client
+        falls back to per-step decoding without banning the peer."""
         n = int(meta["decode_n"])
-        decline = self._decode_n_ineligible(session)
-        if decline is None and not (1 <= n <= self.decode_n_max):
+        route = meta.get("route") or []
+        decline = None
+        if not (1 <= n <= self.decode_n_max):
             # unvalidated n would let one RPC eagerly commit n write_slots
             # per row (trivial OutOfPages) — clamp before any allocation
             decline = (
                 f"decode_n={n} outside the server's accepted range "
                 f"[1, {self.decode_n_max}]"
             )
+        if decline is None:
+            # every flavor embeds ids at this span, so the session must
+            # enter the model at block 0 and the embed table must exist
+            rel = session.layers or (0, self.end_block - self.start_block)
+            if self.start_block + rel[0] != 0:
+                decline = "session does not enter the model at block 0"
+            elif (
+                not route
+                and self.start_block + rel[1] != self.spec.num_hidden_layers
+            ):
+                decline = (
+                    "single-span decode_n needs the whole model on this "
+                    "server (send a route for chained decode)"
+                )
         if decline is None:
             await self._ensure_client_params()
             if self._client_params is None:
@@ -876,6 +1149,17 @@ class BlockServer:
                 }
             )
             return
+        if route or self._decode_n_ineligible(session) is not None:
+            await self._run_decode_n_stepped(
+                session, stream, meta, tensors, route
+            )
+            return
+        await self._run_decode_n_fused(session, stream, meta, tensors)
+
+    async def _run_decode_n_fused(
+        self, session: _Session, stream: Stream, meta: dict, tensors: list
+    ) -> None:
+        n = int(meta["decode_n"])
         ids = np.asarray(tensors[0]).reshape(-1)
         if ids.shape[0] != session.handle.batch_size:
             raise ValueError(
@@ -933,11 +1217,342 @@ class BlockServer:
             [toks],
         )
 
+    async def _run_decode_n_stepped(
+        self, session: _Session, stream: Stream, meta: dict, tensors: list,
+        route: list,
+    ) -> None:
+        """Host-driven decode_n loop (the LOCAL STEPPED and CHAINED flavors
+        of _run_decode_n). Each round: embed the current ids, run this
+        span's ordinary per-step executor path, then either apply the head
+        locally (empty route) or push hidden downstream and await the tail
+        span's selected ids. EOS masking happens HERE, identically to the
+        client's per-step loop (_greedy_next), so outputs are token-exact
+        vs per-step decoding on the same backend.
+
+        Failure contract: once any KV was committed this RPC, spans hold
+        ragged extra tokens — the decline carries dirty=True so the client
+        rebuilds-and-replays before falling back (clean by construction)."""
+        import time as _time
+
+        n = int(meta["decode_n"])
+        ids = np.asarray(tensors[0]).reshape(-1).astype(np.int64)
+        if ids.shape[0] != session.handle.batch_size:
+            raise ValueError(
+                f"decode_n ids carry batch {ids.shape[0]} != "
+                f"{session.handle.batch_size} cache rows"
+            )
+        b = int(ids.shape[0])
+        eos = meta.get("eos_token_id")
+        finished = (
+            np.asarray(meta["finished"], dtype=bool)
+            if meta.get("finished") is not None
+            else np.zeros((b,), dtype=bool)
+        )
+        cid = uuid.uuid4().hex[:12]
+        # drop stale control messages from an earlier timed-out chain
+        while not session.chain_inbox.empty():
+            session.chain_inbox.get_nowait()
+        toks = np.zeros((b, n), dtype=np.int32)
+        committed = 0
+        t_start = _time.perf_counter()
+        t_dispatch_sum = 0.0
+        try:
+            for i in range(n):
+                def _dispatch(ids_now=ids):
+                    if not self.manager.epoch_valid(session.handle):
+                        raise SessionKVLost(
+                            "server KV arena was rebuilt; session cache "
+                            "lost — replay"
+                        )
+                    session.last_step_at = _time.monotonic()
+                    t0 = _time.perf_counter()
+                    h = self._embed_ids(ids_now)
+                    out = self.executor.decode(
+                        session.handle,
+                        h.astype(self.executor.transfer_dtype),
+                        commit=True, layers=session.layers, fetch=False,
+                        adapter=session.adapter,
+                    )
+                    return out, (_time.perf_counter() - t0) * 1000.0
+                out_dev, dt_ms = await self.compute.submit(
+                    PRIORITY_INFERENCE, _dispatch
+                )
+                committed += 1
+                t_dispatch_sum += dt_ms
+                if route:
+                    out = await asyncio.to_thread(self.executor.fetch, out_dev)
+                    chain = {
+                        "origin": {
+                            "host": self.public_host,
+                            "port": self.port,
+                            "session_id": session.id,
+                        },
+                        "cid": cid,
+                        "i": i,
+                    }
+                    await self._push_hop(
+                        route, chain, meta.get("step"),
+                        meta.get("head_dtype"), out,
+                    )
+                    nxt = await self._await_chain_ids(session, cid, i)
+                else:
+                    nxt = await self.compute.submit(
+                        PRIORITY_INFERENCE, self._select_head, out_dev
+                    )
+                # EOS masking: one definition with the client's per-step
+                # loop semantics (client/model.py _mask_finished)
+                if eos is not None:
+                    nxt = np.where(finished, eos, nxt)
+                    finished = finished | (nxt == eos)
+                toks[:, i] = nxt
+                ids = nxt.astype(np.int64)
+        except Exception as e:
+            if await self._maybe_reply_session_lost(
+                session, stream, meta, e
+            ):
+                return
+            logger.warning(
+                "chained decode_n failed after %d/%d committed steps: %s",
+                committed, n, e,
+            )
+            await stream.send(
+                {
+                    "step": meta.get("step"),
+                    "decode_n_unsupported": True,
+                    "reason": f"{type(e).__name__}: {e}",
+                    # committed KV ran ahead of the client's history: the
+                    # client must rebuild-and-replay before continuing
+                    "dirty": committed > 0,
+                    # transient route failures (a span died) are worth a
+                    # rebuild-and-RETRY of chained decode; capability
+                    # declines are not
+                    "transient": not getattr(e, "permanent", False),
+                }
+            )
+            return
+        total_ms = (_time.perf_counter() - t_start) * 1000.0
+        session.n_steps += n
+        session.sum_tokens += b * n
+        session.sum_dispatch_ms += t_dispatch_sum
+        session.sum_fetch_ms += max(total_ms - t_dispatch_sum, 0.0)
+        await stream.send(
+            {
+                "step": meta.get("step"),
+                "t_compute_ms": total_ms,
+                "t_dispatch_ms": t_dispatch_sum,
+                "t_fetch_ms": max(total_ms - t_dispatch_sum, 0.0),
+            },
+            [toks],
+        )
+
+    async def _push_hop(
+        self, route: list, chain: dict, step, head_dtype, out
+    ) -> None:
+        """Push one chained-decode hidden state to the next hop (shared by
+        the coordinator and middle spans — the hop wire format lives in
+        exactly one place)."""
+        nxt_hop = route[0]
+        push_meta = {
+            "session_id": nxt_hop["session_id"],
+            "step": step,
+            "commit": True,
+            "chain": chain,
+            "route": route[1:],
+        }
+        if head_dtype is not None:
+            push_meta["head_dtype"] = head_dtype
+        conn = await self.peers.get(nxt_hop["host"], nxt_hop["port"])
+        async with self.peers.limiter(
+            nxt_hop["host"], nxt_hop["port"]
+        ).slot():
+            await conn.push("rpc_push", push_meta, [out])
+
+    async def _await_chain_ids(
+        self, session: _Session, cid: str, i: int
+    ) -> np.ndarray:
+        """Wait for the tail span's selected ids for chain step (cid, i);
+        stale messages from earlier chains are dropped, errors raise."""
+        deadline = self.chain_step_timeout
+        while True:
+            msg_meta, msg_tensors = await asyncio.wait_for(
+                session.chain_inbox.get(), deadline
+            )
+            if msg_meta.get("cid") != cid:
+                continue  # stale chain
+            if msg_meta.get("chain_error"):
+                raise _ChainError(
+                    msg_meta["chain_error"],
+                    permanent=bool(msg_meta.get("permanent")),
+                )
+            if int(msg_meta.get("i", -1)) != i:
+                raise _ChainError(
+                    f"chain step mismatch: got {msg_meta.get('i')}, "
+                    f"expected {i}"
+                )
+            return np.asarray(msg_tensors[0]).reshape(-1)
+
+    async def _run_chain_step(
+        self, session: _Session, meta: dict, tensors: list
+    ) -> None:
+        """One pushed hop of a chained decode_n on a MIDDLE or TAIL span:
+        run the span step; middles push hidden onward, the tail applies
+        norm+head+select and pushes the ids back to the coordinator. All
+        failures travel to the coordinator as chain_error pushes — never
+        onto this span's own client stream (the client is not reading it
+        mid-decode_n)."""
+        import time as _time
+
+        chain = meta["chain"]
+        origin = chain["origin"]
+        try:
+            hidden = np.asarray(tensors[0])
+
+            def _dispatch():
+                if not self.manager.epoch_valid(session.handle):
+                    raise SessionKVLost(
+                        "server KV arena was rebuilt; session cache lost "
+                        "— replay"
+                    )
+                session.last_step_at = _time.monotonic()
+                return self.executor.decode(
+                    session.handle, hidden, commit=True,
+                    layers=session.layers, fetch=False,
+                    adapter=session.adapter,
+                )
+
+            route = meta.get("route") or []
+            if not route:
+                # tail role: eligibility must be checked before committing
+                # anything downstream of a doomed chain is pointless — but
+                # the coordinator already committed this round regardless,
+                # so dirty replay handles either ordering; check first to
+                # fail the cheapest way
+                err = await self._chain_tail_ineligible(meta)
+                if err is not None:
+                    raise _ChainError(err, permanent=True)
+            out_dev = await self.compute.submit(
+                PRIORITY_INFERENCE, _dispatch
+            )
+            session.n_steps += 1
+            session.sum_tokens += int(hidden.shape[0])
+            if route:
+                out = await asyncio.to_thread(self.executor.fetch, out_dev)
+                await self._push_hop(
+                    route, chain, meta.get("step"), meta.get("head_dtype"),
+                    out,
+                )
+            else:
+                nxt = await self.compute.submit(
+                    PRIORITY_INFERENCE, self._select_head, out_dev
+                )
+                conn = await self.peers.get(origin["host"], origin["port"])
+                async with self.peers.limiter(
+                    origin["host"], origin["port"]
+                ).slot():
+                    await conn.push(
+                        "rpc_push",
+                        {
+                            "session_id": origin["session_id"],
+                            "chain_ids": True,
+                            "cid": chain.get("cid"),
+                            "i": chain.get("i"),
+                        },
+                        [nxt.astype(np.int32)],
+                    )
+        except Exception as e:
+            logger.warning("chain step failed: %s", e)
+            try:
+                conn = await self.peers.get(origin["host"], origin["port"])
+                await conn.push(
+                    "rpc_push",
+                    {
+                        "session_id": origin["session_id"],
+                        "chain_error": f"{type(e).__name__}: {e}",
+                        "permanent": bool(getattr(e, "permanent", False)),
+                        "cid": chain.get("cid"),
+                    },
+                    [],
+                )
+            except Exception:
+                pass  # coordinator's timeout covers a dead push path
+
+    async def _chain_tail_ineligible(self, meta: dict) -> str | None:
+        """Why this span cannot play the TAIL role (apply norm+head) of a
+        chained decode_n; None when it can."""
+        if self.end_block != self.spec.num_hidden_layers:
+            return (
+                f"span ends at block {self.end_block}, not the model's "
+                f"last block {self.spec.num_hidden_layers}"
+            )
+        await self._ensure_client_params()
+        if self._client_params is None:
+            return "tail has no norm/lm_head params"
+        want_dt = meta.get("head_dtype")
+        have_dt = str(self._client_params["lm_head"].dtype)
+        if want_dt is not None and want_dt != have_dt:
+            return (
+                f"head dtype mismatch: client {want_dt} vs tail {have_dt}"
+            )
+        return None
+
+    def _embed_ids(self, ids: np.ndarray) -> np.ndarray:
+        """ids [B] -> hidden [B, 1, D] fp32, numerically identical to the
+        client's embed (client/model.py embed: same impl, same params
+        loaded the same way, fp32 host result)."""
+        from bloombee_tpu.models.head import embed_impl
+
+        if not hasattr(self, "_embed_jit"):
+            import functools
+
+            import jax
+
+            self._embed_jit = functools.partial(
+                jax.jit,
+                static_argnames=(
+                    "embedding_multiplier", "has_embed_norm", "eps"
+                ),
+            )(embed_impl)
+        h = self._embed_jit(
+            self._client_params,
+            jnp.asarray(np.asarray(ids, np.int64)[:, None]),
+            self.spec.embedding_multiplier,
+            "embed_norm" in self._client_params,
+            self.spec.rms_norm_eps,
+        )
+        return np.asarray(h, dtype=np.float32)
+
+    def _select_head(self, out_dev) -> np.ndarray:
+        """Span output [B, 1, D] -> greedy next ids [B], via the same
+        norm+head math and the same wire-dtype->fp32 cast as the client's
+        per-step path (fetch as transfer dtype, cast fp32, norm+head,
+        first-index argmax) so chained decode stays token-exact."""
+        from bloombee_tpu.models.head import norm_head_impl
+
+        if not hasattr(self, "_head_jit"):
+            import functools
+
+            import jax
+
+            self._head_jit = functools.partial(
+                jax.jit,
+                static_argnames=("eps", "soft_cap", "norm_type"),
+            )(norm_head_impl)
+        out = np.asarray(out_dev).astype(self.executor.transfer_dtype)
+        logits = self._head_jit(
+            self._client_params,
+            jnp.asarray(out[:, -1].astype(np.float32)),
+            self.spec.rms_norm_eps,
+            self.spec.logits_soft_cap,
+            self.spec.norm_type,
+        )
+        return np.argmax(np.asarray(logits), axis=-1).astype(np.int64)
+
     def _decode_n_ineligible(self, session: _Session | None = None):
         """The session-independent (and, given a session, session-specific)
-        reasons this server cannot run server-side multi-step decode.
-        Returns None when eligible, else a human-readable reason (surfaced
-        in the decline reply and in rpc_info/health)."""
+        reasons this server cannot run the FUSED decode_n scan (the
+        host-driven stepped loop has weaker requirements — see
+        _run_decode_n). Returns None when eligible, else a human-readable
+        reason (surfaced in rpc_info/health as decode_n_decline)."""
         if session is not None and session.layers is not None:
             return "session routes a sub-span, not the whole model"
         # the loop applies the LM head after THIS span, so the span must
@@ -1330,6 +1945,18 @@ class BlockServer:
 
     async def _rpc_push(self, meta: dict, tensors) -> None:
         session = self._sessions.get(meta["session_id"])
+        if meta.get("chain_ids") or meta.get("chain_error"):
+            # chained-decode control message for a waiting coordinator:
+            # bypass push_inbox (its consumer — the session loop — is
+            # blocked inside the coordinator awaiting exactly this)
+            if session is None:
+                logger.warning(
+                    "chain message for unknown session %s dropped",
+                    meta["session_id"],
+                )
+                return
+            session.chain_inbox.put_nowait((meta, tensors))
+            return
         if session is None:
             # A push can race ahead of the session's stream-open (allocation
             # may be waiting on cache budget); buffer it briefly — the

@@ -98,9 +98,10 @@ def test_server_decode_matches_per_step_and_hf(tiny_model_dir):
     asyncio.run(run())
 
 
-def test_server_decode_falls_back_on_multi_span(tiny_model_dir):
-    """A 2-server chain cannot run decode_n; generate must fall back to the
-    per-step path and still match HF."""
+def test_server_decode_chained_two_spans(tiny_model_dir):
+    """A 2-server chain runs CHAINED decode_n: span 0 embeds + coordinates,
+    the tail selects and pushes ids back — one client RTT per chunk. Must
+    be token-exact vs HF greedy AND actually use the decode_n path."""
     model_dir, hf_model, config = tiny_model_dir
 
     async def run():
@@ -120,12 +121,228 @@ def test_server_decode_falls_back_on_multi_span(tiny_model_dir):
         )
         rng = np.random.default_rng(3)
         input_ids = rng.integers(0, config.vocab_size, size=(1, 4))
+        sess = model.inference_session(16, 1)
+        await sess.__aenter__()
+        assert len(sess._spans) == 2, "route must span both servers"
+        ids = await model.generate(input_ids, max_new_tokens=6, session=sess)
+        dn_steps = [t for t in sess.timings if t.get("decode_n")]
+        await sess.__aexit__(None, None, None)
+        assert dn_steps, "chained decode_n was not used (fell back?)"
+        ref = _hf_greedy(hf_model, input_ids, 6)
+        np.testing.assert_array_equal(ids, ref)
+
+        await s1.stop()
+        await s2.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_chained_decode_three_spans_batched_eos(tiny_model_dir):
+    """3-server chain (exercises a MIDDLE hop), batch of 2, session-level:
+    chunked decode_n == manual per-step reference; EOS-finished rows clamp
+    to eos exactly like the per-step loop."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        servers = [
+            _server(model_dir, RegistryClient("127.0.0.1", reg.port), a, b)
+            for a, b in ((0, 1), (1, 2), (2, 3))
+        ]
+        for s in servers:
+            await s.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, RegistryClient("127.0.0.1", reg.port), model_uid="tiny"
+        )
+        rng = np.random.default_rng(17)
+        input_ids = rng.integers(0, config.vocab_size, size=(2, 4))
+
+        # per-step reference tokens
+        async with model.inference_session(16, 2) as sess:
+            out = await sess.step(model.embed(input_ids), ids=input_ids)
+            cur = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+            ref_toks = []
+            for _ in range(5):
+                out = await sess.step(
+                    model.embed(cur[:, None]), ids=cur[:, None]
+                )
+                cur = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+                ref_toks.append(cur)
+        ref_toks = np.stack(ref_toks, axis=1)  # [B, 5]
+
+        async with model.inference_session(16, 2) as sess:
+            assert len(sess._spans) == 3
+            out = await sess.step(model.embed(input_ids), ids=input_ids)
+            first = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+            t1 = await sess.decode_n(first, 3)
+            t2 = await sess.decode_n(t1[:, -1], 2)
+            assert sess.position == input_ids.shape[1] + 5
+        np.testing.assert_array_equal(
+            np.concatenate([t1, t2], axis=1), ref_toks
+        )
+
+        # finished rows emit only eos through the chain
+        async with model.inference_session(16, 2) as sess:
+            await sess.step(model.embed(input_ids), ids=input_ids)
+            toks = await sess.decode_n(
+                np.array([1, 2]), 4, eos_token_id=5,
+                finished=np.array([True, True]),
+            )
+        np.testing.assert_array_equal(toks, np.full((2, 4), 5))
+
+        for s in servers:
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_chained_decode_dirty_fallback_on_tail_without_params(
+    tiny_model_dir,
+):
+    """Tail server has no norm/head params: the chain declines with
+    dirty=True after span 0 already committed a token; the client must
+    rebuild-and-replay, continue per-step, and still match HF."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        from bloombee_tpu.models.checkpoint import load_span_params
+
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s1 = _server(model_dir, RegistryClient("127.0.0.1", reg.port), 0, 2)
+        params, spec = load_span_params(model_dir, 2, 3, dtype=jnp.float32)
+        s2 = BlockServer(  # raw params: no model_dir => no head for tail
+            model_uid="tiny", start=2, end=3, params=params, spec=spec,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=64, page_size=4,
+        )
+        await s1.start()
+        await s2.start()
+
+        from bloombee_tpu.client.config import ClientConfig
+
+        cfg = ClientConfig(server_decode=True, server_decode_chunk=4)
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, RegistryClient("127.0.0.1", reg.port),
+            model_uid="tiny", config=cfg,
+        )
+        rng = np.random.default_rng(23)
+        input_ids = rng.integers(0, config.vocab_size, size=(1, 4))
         ids = await model.generate(input_ids, max_new_tokens=6)
         ref = _hf_greedy(hf_model, input_ids, 6)
         np.testing.assert_array_equal(ids, ref)
 
         await s1.stop()
         await s2.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_chained_decode_mid_span_death_recovers(tiny_model_dir):
+    """A middle server dies between decode_n chunks: the transient dirty
+    decline must trigger rebuild-and-replay onto a replacement server and
+    RETRY chained decode (not drop the fast path), staying token-exact."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s1 = _server(model_dir, rc(), 0, 1)
+        s2 = _server(model_dir, rc(), 1, 2)
+        s3 = _server(model_dir, rc(), 2, 3)
+        for s in (s1, s2, s3):
+            await s.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny"
+        )
+        rng = np.random.default_rng(31)
+        input_ids = rng.integers(0, config.vocab_size, size=(2, 4))
+
+        # per-step reference
+        async with model.inference_session(40, 2) as sref:
+            out = await sref.step(model.embed(input_ids), ids=input_ids)
+            cur = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+            ref_toks = []
+            for _ in range(8):
+                out = await sref.step(
+                    model.embed(cur[:, None]), ids=cur[:, None]
+                )
+                cur = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+                ref_toks.append(cur)
+        ref_toks = np.stack(ref_toks, axis=1)
+
+        sess = model.inference_session(40, 2)
+        await sess.__aenter__()
+        out = await sess.step(model.embed(input_ids), ids=input_ids)
+        first = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+        t1 = await sess.decode_n(first, 4)
+        await s2.stop()  # kill the middle hop between chunks
+        s2b = _server(model_dir, rc(), 1, 2)
+        await s2b.start()
+        t2 = await sess.decode_n(t1[:, -1], 4)  # must replay + retry chain
+        dn = [t for t in sess.timings if t.get("decode_n")]
+        await sess.__aexit__(None, None, None)
+        assert len(dn) >= 2, "retry did not go back through decode_n"
+        np.testing.assert_array_equal(
+            np.concatenate([t1, t2], axis=1), ref_toks
+        )
+
+        for s in (s1, s2b, s3):
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_local_stepped_decode_n_with_int4_kv(tiny_model_dir):
+    """Single server with an int4 KV arena: the fused scan is ineligible
+    but the host-driven stepped loop must serve decode_n anyway,
+    token-exact vs the per-step path on the same (quantized) server."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s1 = _server(
+            model_dir, RegistryClient("127.0.0.1", reg.port), 0, 3,
+            kv_quant="int4",
+        )
+        await s1.start()
+        assert s1._decode_n_ineligible() is not None  # fused declined
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, RegistryClient("127.0.0.1", reg.port), model_uid="tiny"
+        )
+        rng = np.random.default_rng(29)
+        input_ids = rng.integers(0, config.vocab_size, size=(2, 4))
+
+        async with model.inference_session(16, 2) as sess:
+            out = await sess.step(model.embed(input_ids), ids=input_ids)
+            cur = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+            ref_toks = []
+            for _ in range(4):
+                out = await sess.step(
+                    model.embed(cur[:, None]), ids=cur[:, None]
+                )
+                cur = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+                ref_toks.append(cur)
+        ref_toks = np.stack(ref_toks, axis=1)
+
+        async with model.inference_session(16, 2) as sess:
+            out = await sess.step(model.embed(input_ids), ids=input_ids)
+            first = np.argmax(model.logits(out[:, -1:])[:, 0], axis=-1)
+            toks = await sess.decode_n(first, 4)
+        np.testing.assert_array_equal(toks, ref_toks)
+
+        await s1.stop()
         await reg.stop()
 
     asyncio.run(run())

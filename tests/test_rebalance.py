@@ -1,0 +1,161 @@
+"""Automatic swarm rebalancing + background-task supervision.
+
+Reference: /root/reference/src/bloombee/server/server.py:479-542 (the
+module-container restart loop driven by should_choose_other_blocks) and
+block_selection.py:40-95 (move simulation with hysteresis). Here the move
+happens in-process: drain, reload the new span, swap the serving stack,
+re-announce — no container restart.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.server.block_selection import rebalance_target
+from bloombee_tpu.server.block_server import BlockServer
+from bloombee_tpu.swarm.data import ModuleInfo, ServerInfo
+from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+from bloombee_tpu.swarm.spans import compute_spans
+
+
+def _infos(spans, n_blocks):  # spans: {sid: (start, end, throughput)}
+    infos = [ModuleInfo(uid=f"b{i}", servers={}) for i in range(n_blocks)]
+    for sid, (s, e, tput) in spans.items():
+        si = ServerInfo(throughput=tput, start_block=s, end_block=e)
+        for i in range(s, e):
+            infos[i].servers[sid] = si
+    return infos
+
+
+def test_rebalance_target_moves_off_overlap():
+    """Two servers stacked on [0,2) of a 3-block model leave block 2
+    unserved; one of them must move to [1,3)."""
+    infos = _infos({"a": (0, 2, 1.0), "b": (0, 2, 1.0)}, 3)
+    target = rebalance_target("b", infos, compute_spans(infos))
+    assert target == (1, 3)
+
+
+def test_rebalance_target_hysteresis_keeps_balanced_swarm():
+    """A balanced split must NOT move (the hysteresis margin prevents
+    thrash)."""
+    infos = _infos({"a": (0, 2, 1.0), "b": (2, 4, 1.0)}, 4)
+    assert rebalance_target("a", infos, compute_spans(infos)) is None
+    assert rebalance_target("b", infos, compute_spans(infos)) is None
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=3,
+        vocab_size=128,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(7)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = tmp_path_factory.mktemp("tiny_llama_rebal")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model, config
+
+
+def test_e2e_pathological_split_converges(tiny_model_dir):
+    """Two servers both serving [0,2) of a 3-layer model (block 2 dark):
+    the rebalancing supervisor must move one to [1,3) WITHOUT operator
+    action, after which a client can run the full model and match HF."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        def server(start, end, **kw):
+            return BlockServer(
+                model_uid="tiny", start=start, end=end, model_dir=model_dir,
+                registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+                page_size=4, announce_period=0.5, **kw,
+            )
+
+        s_a = server(0, 2)  # static
+        s_b = server(0, 2, rebalance_period=1.0, drain_timeout=2.0)
+        await s_a.start()
+        await s_b.start()
+        # supervisor tick = announce_period (0.5s); rebalance after 1s
+        deadline = asyncio.get_event_loop().time() + 30.0
+        while (s_b.start_block, s_b.end_block) == (0, 2):
+            if asyncio.get_event_loop().time() > deadline:
+                raise AssertionError("rebalance never happened")
+            await asyncio.sleep(0.25)
+        assert (s_b.start_block, s_b.end_block) == (1, 3)
+
+        # swarm must now serve the whole model, correct vs HF
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny"
+        )
+        rng = np.random.default_rng(4)
+        input_ids = rng.integers(0, config.vocab_size, size=(1, 4))
+        ids = await model.generate(
+            input_ids, max_new_tokens=5, server_decode=False
+        )
+        with torch.no_grad():
+            ref = hf_model.generate(
+                torch.tensor(input_ids), max_new_tokens=5, do_sample=False,
+                use_cache=True,
+            ).numpy()
+        np.testing.assert_array_equal(ids, ref)
+
+        # stability: no further move (hysteresis)
+        await asyncio.sleep(2.5)
+        assert (s_b.start_block, s_b.end_block) == (1, 3)
+        assert (s_a.start_block, s_a.end_block) == (0, 2)
+
+        await s_a.stop()
+        await s_b.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_supervisor_restarts_dead_announce_loop(tiny_model_dir):
+    """Kill the announce task; the supervisor must restart it and the
+    server must stay visible in the registry past the expiry window."""
+    model_dir, _, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s = BlockServer(
+            model_uid="tiny", start=0, end=3, model_dir=model_dir,
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, announce_period=0.5,
+        )
+        await s.start()
+        s._announce_task.cancel()
+        # expiry = announce_period * 2.5 = 1.25s; wait well past it and
+        # confirm the record is still alive (supervisor restarted the loop)
+        await asyncio.sleep(3.0)
+        infos = await rc().get_module_infos("tiny", range(3))
+        assert any(s.server_id in i.servers for i in infos), (
+            "server expired from the registry after its announce loop died"
+        )
+        await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
