@@ -89,12 +89,52 @@ def check_tp_divides(spec: ModelSpec, tp: int) -> None:
         )
 
 
+def _quant_leaf_spec(base, shape, tp):
+    """Sharding spec for one leaf of a quantized weight: keep the base
+    placement wherever the leaf's dim divides tp, replicate the rest.
+    Handles every layout by shape alone: int8 scales [L, 1, out] drop an
+    input-dim "tp" (size 1), int4 group scales [L, in/GROUP, out] keep it,
+    packed int4 codes [L, in/2, out] keep it, expert leaves [L, E, ...]
+    keep the expert-dim shard."""
+    spec = tuple(
+        None if (s == "tp" and shape[i] % tp != 0) else s
+        for i, s in enumerate(base)
+    )
+    return P(*spec)
+
+
 def place_span_params(params: dict, mesh: Mesh) -> dict:
-    """Commit stacked span params to the serving mesh (tp-sharded)."""
-    return {
-        k: jax.device_put(v, NamedSharding(mesh, SERVING_PARAM_SPECS[k]))
-        for k, v in params.items()
-    }
+    """Commit stacked span params to the serving mesh (tp-sharded).
+
+    Quantized projections (models/wquant.py QuantWeight) shard like their
+    dense counterparts: codes follow the weight's row/col placement, and
+    each scale/zero leaf keeps the shards' scales local (the dequantize is
+    an elementwise producer, so GSPMD keeps it fused shard-local and the
+    Megatron psums are unchanged — the composition the reference builds by
+    hand from compression.py + flexgen_tensor_parallel.py)."""
+    from bloombee_tpu.models.wquant import QuantWeight
+
+    tp = mesh.devices.size
+    out = {}
+    for k, v in params.items():
+        base = SERVING_PARAM_SPECS[k]
+        if isinstance(v, QuantWeight):
+            def put(leaf):
+                if leaf is None:
+                    return None
+                return jax.device_put(
+                    leaf,
+                    NamedSharding(
+                        mesh, _quant_leaf_spec(base, leaf.shape, tp)
+                    ),
+                )
+
+            out[k] = QuantWeight(
+                codes=put(v.codes), scale=put(v.scale), zero=put(v.zero)
+            )
+        else:
+            out[k] = jax.device_put(v, NamedSharding(mesh, base))
+    return out
 
 
 def place_arena(arena: dict, mesh: Mesh) -> dict:

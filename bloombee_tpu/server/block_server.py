@@ -73,6 +73,15 @@ env.declare(
     "reference simple_probability_pruner) or 'neural' (learned MLP over "
     "probability features, reference adaptive_neural_pruner)",
 )
+env.declare(
+    "BBTPU_WEIGHT_QUANT", str, "none",
+    "weight-only quantization for served spans: none | int8 (per-column "
+    "symmetric, ~2x decode-bandwidth headroom) | int4 (group-wise "
+    "asymmetric, ~4x); compute stays bf16 (reference compression.py "
+    "weight compression)",
+)
+
+
 class _ChainError(RuntimeError):
     """A downstream span of a chained decode_n reported failure (pushed
     back as `chain_error`). `permanent` distinguishes capability declines
@@ -84,15 +93,6 @@ class _ChainError(RuntimeError):
     def __init__(self, msg: str, permanent: bool = False):
         super().__init__(msg)
         self.permanent = permanent
-
-
-env.declare(
-    "BBTPU_WEIGHT_QUANT", str, "none",
-    "weight-only quantization for served spans: none | int8 (per-column "
-    "symmetric, ~2x decode-bandwidth headroom) | int4 (group-wise "
-    "asymmetric, ~4x); compute stays bf16 (reference compression.py "
-    "weight compression)",
-)
 
 
 class _Session:
@@ -239,11 +239,9 @@ class BlockServer:
         if weight_quant and weight_quant != "none":
             # weight-only quantization (reference compression.py's weight
             # half): decode reads every projection once per token, so int8
-            # (int4) storage halves (quarters) HBM bytes per step
-            if tp > 1:
-                raise ValueError(
-                    "weight quantization + TP serving not supported together"
-                )
+            # (int4) storage halves (quarters) HBM bytes per step. Composes
+            # with TP: quantized leaves shard like their dense weights
+            # (parallel/serving.py place_span_params)
             if spec.heterogeneous:
                 # hetero spans carry per-layer param dicts (a tuple), and
                 # their unrolled step has no quant handling yet
@@ -402,6 +400,15 @@ class BlockServer:
             # also drives periodic rebalancing when enabled
             self._supervisor_task = asyncio.create_task(
                 self._supervisor_loop()
+            )
+        if self.rebalance_period > 0 and self.rebalance_unsupported():
+            # fail-loud: the operator asked for auto-balancing but this
+            # configuration can never move — silence would hide the loss
+            # of the whole feature
+            logger.warning(
+                "rebalance_period=%.0fs requested but rebalancing is "
+                "disabled for this server: %s",
+                self.rebalance_period, self.rebalance_unsupported(),
             )
         logger.info(
             "server %s serving %s[%d:%d] on port %d",
@@ -1256,8 +1263,20 @@ class BlockServer:
         committed = 0
         t_start = _time.perf_counter()
         t_dispatch_sum = 0.0
+        # total budget for the WHOLE chain RPC: one cold-compile allowance
+        # plus 1s/token. Deliberately under the client's recv budget
+        # (2*step_timeout + n): the server must always answer — a typed
+        # transient decline beats the client timing out and BANNING a
+        # coordinator that was making slow-but-legal progress. A retry
+        # after replay hits warm compile caches and converges.
+        t_deadline = _time.monotonic() + self.chain_step_timeout + float(n)
         try:
             for i in range(n):
+                if _time.monotonic() > t_deadline:
+                    raise _ChainError(
+                        f"chain exceeded its {self.chain_step_timeout:.0f}s"
+                        f"+{n}s budget after {i}/{n} tokens"
+                    )
                 def _dispatch(ids_now=ids):
                     if not self.manager.epoch_valid(session.handle):
                         raise SessionKVLost(
@@ -1294,7 +1313,9 @@ class BlockServer:
                         route, chain, meta.get("step"),
                         meta.get("head_dtype"), out,
                     )
-                    nxt = await self._await_chain_ids(session, cid, i)
+                    nxt = await self._await_chain_ids(
+                        session, cid, i, t_deadline
+                    )
                 else:
                     nxt = await self.compute.submit(
                         PRIORITY_INFERENCE, self._select_head, out_dev
@@ -1368,14 +1389,20 @@ class BlockServer:
             await conn.push("rpc_push", push_meta, [out])
 
     async def _await_chain_ids(
-        self, session: _Session, cid: str, i: int
+        self, session: _Session, cid: str, i: int, t_deadline: float
     ) -> np.ndarray:
         """Wait for the tail span's selected ids for chain step (cid, i);
-        stale messages from earlier chains are dropped, errors raise."""
-        deadline = self.chain_step_timeout
+        stale messages from earlier chains are dropped, errors raise.
+        Bounded by the chain's overall deadline so the RPC always answers
+        inside the client's recv budget."""
+        import time as _time
+
         while True:
+            remaining = t_deadline - _time.monotonic()
+            if remaining <= 0:
+                raise asyncio.TimeoutError("chain deadline exhausted")
             msg_meta, msg_tensors = await asyncio.wait_for(
-                session.chain_inbox.get(), deadline
+                session.chain_inbox.get(), remaining
             )
             if msg_meta.get("cid") != cid:
                 continue  # stale chain

@@ -162,3 +162,81 @@ def test_tp2_block_server_e2e(tmp_path):
         await reg.stop()
 
     asyncio.run(run())
+
+
+@pytest.mark.parametrize("bits", [8, 4], ids=["int8", "int4"])
+def test_tp2_quantized_matches_tp1_quantized(bits):
+    """weight-quant x TP composition: the SAME quantized weights served
+    tp=2 must match tp=1 to tight tolerance (codes shard like their dense
+    counterparts, scales stay shard-local — the composition the reference
+    builds from compression.py + flexgen_tensor_parallel.py)."""
+    from bloombee_tpu.models import wquant
+
+    qparams = wquant.quantize_span_params(_params_for(LLAMA_SPEC), bits)
+    ref = _serve_steps(LLAMA_SPEC, qparams, mesh=None)
+    tp2 = _serve_steps(LLAMA_SPEC, qparams, mesh=make_serving_mesh(2))
+    for a, b in zip(ref, tp2):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_tp2_quantized_moe_expert_parallel():
+    """Quantized expert stacks shard over the expert dim (codes AND
+    scales), composing int8 weights with expert parallelism."""
+    from bloombee_tpu.models import wquant
+
+    qparams = wquant.quantize_span_params(_params_for(MOE_SPEC), 8)
+    ref = _serve_steps(MOE_SPEC, qparams, mesh=None)
+    tp2 = _serve_steps(MOE_SPEC, qparams, mesh=make_serving_mesh(2))
+    for a, b in zip(ref, tp2):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_tp2_int8_block_server_e2e(tmp_path):
+    """Full swarm path with a tp=2 int8-quantized server: greedy tokens
+    must match a tp=1 server with the same quantized weights."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(2)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    async def run_swarm(tp):
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        server = BlockServer(
+            model_uid="t", start=0, end=3, model_dir=str(tmp_path),
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, tp=tp, weight_quant="int8",
+        )
+        await server.start()
+        dm = DistributedModelForCausalLM.from_pretrained(
+            str(tmp_path), rc(), model_uid="t"
+        )
+        ids_in = np.arange(6)[None, :] % config.vocab_size
+        ids = await dm.generate(
+            ids_in, max_new_tokens=6, server_decode=False
+        )
+        await server.stop()
+        await reg.stop()
+        return ids
+
+    async def run():
+        ids_tp1 = await run_swarm(1)
+        ids_tp2 = await run_swarm(2)
+        np.testing.assert_array_equal(ids_tp1, ids_tp2)
+
+    asyncio.run(run())
