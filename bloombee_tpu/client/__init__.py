@@ -9,9 +9,13 @@ CPU or any accelerator — the reference's `device='xla'` goal).
 from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
 from bloombee_tpu.client.session import InferenceSession
 from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.client.classification import (
+    DistributedModelForSequenceClassification,
+)
 
 __all__ = [
     "RemoteSequenceManager",
     "InferenceSession",
     "DistributedModelForCausalLM",
+    "DistributedModelForSequenceClassification",
 ]

@@ -1,0 +1,200 @@
+"""Sequence classification over the frozen remote chain.
+
+Port of the reference's DistributedLlamaForSequenceClassification
+(/root/reference/src/bloombee/models/llama/model.py:263 +
+utils/auto_config.py:98): the remote blocks stay frozen, a LOCAL trainable
+score head maps the last non-pad token's hidden state to class logits (HF
+LlamaForSequenceClassification semantics), and training reuses the
+sequential-autograd machinery — optionally with trainable prompt
+embeddings (the PTune composition the reference gets from PTuneMixin).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+from bloombee_tpu.client.trainer import (
+    RemoteSpanChain,
+    init_prompts,
+    prepend_prompts,
+    prompt_grad,
+)
+from bloombee_tpu.models.spec import ModelSpec
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "norm_type"))
+def _score_logits(
+    norm_w, norm_b, score_w, chain_out, last_idx, eps: float, norm_type: str
+):
+    from bloombee_tpu.ops import rms_norm
+    from bloombee_tpu.ops.norms import layer_norm
+
+    if norm_type == "ln":
+        hn = layer_norm(chain_out, norm_w, norm_b, eps)
+    else:
+        hn = rms_norm(chain_out, norm_w, eps)
+    b = chain_out.shape[0]
+    h_last = hn[jnp.arange(b), last_idx]  # [B, D]
+    return (h_last @ score_w).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "norm_type"))
+def _score_loss_and_grads(
+    norm_w, norm_b, score_w, chain_out, last_idx, labels,
+    eps: float, norm_type: str,
+):
+    """Cross-entropy on the last-token class logits; grads w.r.t. the
+    score head and the chain output (the latter feeds prompt tuning)."""
+
+    def loss_fn(w, h):
+        logits = _score_logits(
+            norm_w, norm_b, w, h, last_idx, eps, norm_type
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        b = logits.shape[0]
+        return -logp[jnp.arange(b), labels].mean()
+
+    loss, (g_score, g_out) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+        score_w, chain_out
+    )
+    return loss, g_score, g_out
+
+
+class DistributedModelForSequenceClassification:
+    """Client-side classifier: local embed -> remote frozen blocks ->
+    local norm + trainable score head on the last non-pad token."""
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        client_params: dict,
+        manager: RemoteSequenceManager,
+        num_labels: int,
+        n_prompt: int = 0,  # >0: prepend trainable prompts (PTune shallow
+        # mode) trained jointly with the score head through rpc_backward
+        lr: float = 0.05,
+        seed: int = 0,
+        config=None,
+    ):
+        self.model = DistributedModelForCausalLM(
+            spec, client_params, manager, config=config
+        )
+        self.spec = spec
+        self.manager = manager
+        self.num_labels = int(num_labels)
+        self.n_prompt = int(n_prompt)
+        self.lr = lr
+        self.chain = RemoteSpanChain(
+            manager,
+            adapter=getattr(self.model.config, "active_adapter", None),
+        )
+        rng = np.random.default_rng(seed)
+        d = spec.hidden_size
+        self.score_w = jnp.asarray(
+            rng.normal(size=(d, self.num_labels)).astype(np.float32) * 0.02
+        )
+        self.prompts = (
+            init_prompts(seed + 1, self.n_prompt, d)
+            if self.n_prompt else None
+        )
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        model_dir: str,
+        registry,
+        num_labels: int,
+        model_uid: str | None = None,
+        dtype=None,
+        n_prompt: int = 0,
+        lr: float = 0.05,
+        seed: int = 0,
+        config=None,
+    ) -> "DistributedModelForSequenceClassification":
+        base = DistributedModelForCausalLM.from_pretrained(
+            model_dir, registry, model_uid=model_uid, dtype=dtype,
+            config=config,
+        )
+        return cls(
+            base.spec, base.params, base.manager, num_labels,
+            n_prompt=n_prompt, lr=lr, seed=seed, config=base.config,
+        )
+
+    def _chain_input(self, input_ids: np.ndarray) -> np.ndarray:
+        h_tok = self.model.embed(input_ids)
+        if self.prompts is None:
+            return h_tok.astype(np.float32)
+        return prepend_prompts(self.prompts, h_tok)
+
+    def _last_idx(self, input_ids, attention_mask) -> np.ndarray:
+        """Index of the last non-pad token per row (HF semantics: the
+        sequence's final real token is the classification summary), offset
+        past any prepended prompts."""
+        if attention_mask is None:
+            last = np.full(
+                (input_ids.shape[0],), input_ids.shape[1] - 1, np.int32
+            )
+        else:
+            last = (
+                np.asarray(attention_mask).astype(np.int32).sum(axis=1) - 1
+            )
+        return last + self.n_prompt
+
+    async def scores(
+        self, input_ids: np.ndarray, attention_mask: np.ndarray | None = None
+    ) -> np.ndarray:
+        """Class logits [B, num_labels]."""
+        h_in = self._chain_input(np.asarray(input_ids))
+        chain_out, _ = await self.chain.forward(h_in)
+        logits = _score_logits(
+            self.model.params["norm"],
+            self.model.params.get("norm_bias"),
+            self.score_w,
+            jnp.asarray(chain_out),
+            jnp.asarray(self._last_idx(input_ids, attention_mask)),
+            self.spec.rms_norm_eps,
+            self.spec.norm_type,
+        )
+        return np.asarray(logits)
+
+    async def predict(
+        self, input_ids: np.ndarray, attention_mask: np.ndarray | None = None
+    ) -> np.ndarray:
+        return np.argmax(await self.scores(input_ids, attention_mask), -1)
+
+    async def train_step(
+        self,
+        input_ids: np.ndarray,
+        labels: np.ndarray,  # [B] int class ids
+        attention_mask: np.ndarray | None = None,
+    ) -> float:
+        """One SGD step on the score head (and prompts when n_prompt > 0;
+        the prompt gradient flows back through the chain via
+        rpc_backward — blocks themselves stay frozen)."""
+        input_ids = np.asarray(input_ids)
+        h_in = self._chain_input(input_ids)
+        chain_out, ctx = await self.chain.forward(h_in)
+        loss, g_score, g_out = _score_loss_and_grads(
+            self.model.params["norm"],
+            self.model.params.get("norm_bias"),
+            self.score_w,
+            jnp.asarray(chain_out),
+            jnp.asarray(self._last_idx(input_ids, attention_mask)),
+            jnp.asarray(np.asarray(labels, np.int32)),
+            self.spec.rms_norm_eps,
+            self.spec.norm_type,
+        )
+        self.score_w = self.score_w - self.lr * g_score
+        if self.prompts is not None:
+            g_in = await self.chain.backward(ctx, np.asarray(g_out))
+            self.prompts = self.prompts - self.lr * prompt_grad(
+                g_in, self.n_prompt
+            )
+        return float(loss)

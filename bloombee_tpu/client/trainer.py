@@ -132,6 +132,35 @@ class RemoteSpanChain:
         return g
 
 
+def init_prompts(seed: int, n_prompt: int, d: int) -> jnp.ndarray:
+    """Trainable prompt-embedding init shared by PTune and classification
+    (reference ptune.py prompt init)."""
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(n_prompt, d)).astype(np.float32) * 0.02)
+
+
+def prepend_prompts(prompts, h_tok: np.ndarray) -> np.ndarray:
+    """[B, S, D] token hidden -> [B, P+S, D] with the trainable prompts
+    broadcast onto every row (the shallow-PTune composition)."""
+    b = h_tok.shape[0]
+    n_prompt = prompts.shape[0]
+    return np.concatenate(
+        [
+            np.broadcast_to(
+                np.asarray(prompts)[None], (b, n_prompt, h_tok.shape[-1])
+            ),
+            h_tok,
+        ],
+        axis=1,
+    ).astype(np.float32)
+
+
+def prompt_grad(g_in: np.ndarray, n_prompt: int) -> jnp.ndarray:
+    """Prompt gradient from the chain-input gradient: the prompt rows'
+    grads summed over the batch (prompts are shared across rows)."""
+    return jnp.asarray(g_in[:, :n_prompt]).sum(axis=0)
+
+
 @functools.partial(jax.jit, static_argnames=("eps", "norm_type"))
 def _head_loss_and_grads(
     norm_w, norm_b, head_w_in, chain_out, target_ids, mask,
@@ -179,10 +208,7 @@ class PTuneTrainer:
         self.n_prompt = n_prompt
         self.lr = lr
         d = model.spec.hidden_size
-        rng = np.random.default_rng(seed)
-        self.prompts = jnp.asarray(
-            rng.normal(size=(n_prompt, d)).astype(np.float32) * 0.02
-        )
+        self.prompts = init_prompts(seed, n_prompt, d)
         self.deep_prompts = (
             np.zeros(
                 (model.spec.num_hidden_layers, n_prompt, d), np.float32
@@ -198,15 +224,7 @@ class PTuneTrainer:
         """One SGD step on (prompts, lm_head); targets -100 = ignored."""
         b, s = input_ids.shape
         h_tok = self.model.embed(input_ids)
-        h_in = np.concatenate(
-            [
-                np.broadcast_to(
-                    np.asarray(self.prompts)[None], (b, self.n_prompt, h_tok.shape[-1])
-                ),
-                h_tok,
-            ],
-            axis=1,
-        ).astype(np.float32)
+        h_in = prepend_prompts(self.prompts, h_tok)
 
         chain_out, ctx = await self.chain.forward(
             h_in, deep_prompts=self.deep_prompts
@@ -233,8 +251,8 @@ class PTuneTrainer:
             self.deep_prompts = self.deep_prompts - self.lr * g_deep
         else:
             g_in = await self.chain.backward(ctx, np.asarray(g_out))
-        g_prompts = jnp.asarray(g_in[:, : self.n_prompt]).sum(axis=0)
-
-        self.prompts = self.prompts - self.lr * g_prompts
+        self.prompts = self.prompts - self.lr * prompt_grad(
+            g_in, self.n_prompt
+        )
         self.lm_head = self.lm_head - self.lr * g_head
         return float(loss)

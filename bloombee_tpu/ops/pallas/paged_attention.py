@@ -314,6 +314,226 @@ def paged_decode_attention_int4(
     return out[..., jnp.asarray(inv)]
 
 
+def _chunk_kernel(
+    pt_ref,  # [B, NP] i32 scalar prefetch
+    lens_ref,  # [B] i32 scalar prefetch (lens INCLUDE the T new tokens)
+    meta_ref,  # [2] i32 scalar prefetch: [window (0 = full), t_real].
+    # t_real = real query tokens: the step's tokens occupy positions
+    # [length - t_real, length); bucket-padding rows (qt >= t_real) wrote
+    # to dropped slots and their outputs are sliced away by the caller.
+    # TRACED (not static) so varying real token counts inside one pow2
+    # bucket share a compile.
+    *refs,
+    scale: float,
+    page_size: int,
+    n_pages: int,
+    hkv: int,
+    g: int,
+    t_q: int,  # query-token BUCKET (may be padded past the real count)
+    has_tree: bool,
+):
+    """T>1 variant of _kernel: each grid step attends ALL T query tokens'
+    heads (a [T*H, hd] block) against one K/V page. Covers the two T>1 hot
+    paths the dense gather served before (round-4 verdict #5):
+
+    - plain causal chunks: query token t sits at position start+t
+      (start = length - T); key visible iff pos <= start+t (and inside the
+      per-query sliding window when one is set)
+    - tree-verify steps (has_tree): the T new tokens' mutual visibility
+      comes from the [T, T] tree mask; the committed prefix (pos < start)
+      is fully visible to every tree token (reference backend.py:596-652
+      tree masks — here streamed per page instead of materializing
+      [B, H, T, S] logits over a gathered context)
+
+    The tree lookup tm[t, pos-start] is expressed as two small one-hot
+    matmuls (tm @ sel, then query-row expansion) because Mosaic has no
+    arbitrary 2D gather; both contract tiny [T, .] operands on the MXU.
+    """
+    if has_tree:
+        tm_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        tm_ref = None
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    h = hkv * g
+    rows = page_size * hkv  # key rows per page
+    rq = t_q * h  # query rows
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = lens_ref[b]
+    win = meta_ref[0]
+    t_real = meta_ref[1]
+    start = length - t_real
+    rk = jax.lax.broadcasted_iota(jnp.int32, (rq, rows), 1)
+    rqi = jax.lax.broadcasted_iota(jnp.int32, (rq, rows), 0)
+    pos = j * page_size + rk // hkv  # key position
+    qh = rqi % h
+    qt = rqi // h  # query token index
+    own = (rk % hkv) == (qh // g)
+    # earliest position ANY query can see (window applies per query; the
+    # page-skip bound uses the earliest query t=0)
+    low0 = jnp.where(win > 0, jnp.maximum(start + 1 - win, 0), 0)
+    page_live = (j * page_size < length) & ((j + 1) * page_size > low0)
+
+    @pl.when(page_live)
+    def _update():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [rq, rows]
+        valid = pos < length
+        if tm_ref is None:
+            mask = own & valid & (pos <= start + qt) & (qt < t_real)
+            mask &= (win <= 0) | (pos > start + qt - win)
+        else:
+            tm = tm_ref[...].astype(jnp.float32)  # [t_q, t_q]
+            ti = jax.lax.broadcasted_iota(jnp.int32, (t_q, rows), 0)
+            posk = (
+                j * page_size
+                + jax.lax.broadcasted_iota(jnp.int32, (t_q, rows), 1) // hkv
+            )
+            sel = (posk == start + ti).astype(jnp.float32)  # [t_q, rows]
+            tree_vis = jax.lax.dot_general(
+                tm, sel, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [t_q, rows]
+            oh = (
+                jax.lax.broadcasted_iota(jnp.int32, (rq, t_q), 0) // h
+                == jax.lax.broadcasted_iota(jnp.int32, (rq, t_q), 1)
+            ).astype(jnp.float32)
+            tree_rows = jax.lax.dot_general(
+                oh, tree_vis, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [rq, rows]
+            mask = own & valid & ((pos < start) | (tree_rows > 0.5))
+        logits = jnp.where(mask, logits, NEG)
+        m = m_scr[...]
+        m_new = jnp.maximum(m, logits.max(axis=1, keepdims=True))
+        p = jnp.exp(logits - m_new) * mask.astype(jnp.float32)
+        corr = jnp.exp(m - m_new)
+        l_scr[...] = l_scr[...] * corr + p.sum(axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        o_ref[...] = (
+            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "scale", "interpret", "has_tree"),
+)
+def paged_chunk_attention(
+    q: jax.Array,  # [B, T, H, hd] — T new tokens per sequence (T may be a
+    # padded bucket; t_real marks the real count)
+    k_slab: jax.Array,  # [S_tot, Hkv, hd] — the paged arena, one layer
+    v_slab: jax.Array,
+    page_table: jax.Array,  # [B, NP] i32
+    lens: jax.Array,  # [B] i32 (INCLUDING the t_real new tokens)
+    page_size: int,
+    tree_mask: jax.Array | None = None,  # [B, T, T] bool (has_tree)
+    scale: float | None = None,
+    interpret: bool = False,
+    window=0,  # traced i32 scalar; 0 = full (tree steps gate window off
+    # host-side: depth-positioned tree tokens + window stay on the dense
+    # path)
+    has_tree: bool = False,
+    t_real=None,  # real (unpadded) query tokens; None = T. TRACED so real
+    # counts inside one pow2 bucket share a compile.
+) -> jax.Array:  # [B, T, H, hd]
+    """Paged attention for T>1 steps (tree verify, short multi-token
+    chunks): one HBM pass over the context pages instead of the dense
+    path's gather-then-attend two passes. VMEM budget: caller gates on
+    T*H rows (executor allows <= 2048)."""
+    b, t_q, h, hd = q.shape
+    s_tot, hkv = k_slab.shape[0], k_slab.shape[1]
+    if h % hkv:
+        raise ValueError(f"H={h} must be a multiple of Hkv={hkv}")
+    if s_tot % page_size:
+        raise ValueError(f"arena slots {s_tot} % page_size {page_size}")
+    g = h // hkv
+    n_pages = page_table.shape[1]
+    if scale is None:
+        scale = hd**-0.5
+    if t_real is None:
+        t_real = t_q
+    rows = page_size * hkv
+    rq = t_q * h
+
+    kp = k_slab.reshape(-1, rows, hd)
+    vp = v_slab.reshape(-1, rows, hd)
+    q2 = q.reshape(b, rq, hd)
+
+    def kv_index(bi, j, pt, ln, mt):
+        # page-skip clamp for the windowed-chunk case: the earliest page
+        # any query needs starts at max(start + 1 - win, 0)
+        first = jnp.where(
+            mt[0] > 0,
+            jnp.maximum(ln[bi] - mt[1] + 1 - mt[0], 0) // page_size,
+            0,
+        )
+        return (pt[bi, jnp.maximum(j, first)], 0, 0)
+
+    def q_index(bi, j, pt, ln, mt):
+        return (bi, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((None, rq, hd), q_index),
+        pl.BlockSpec((None, rows, hd), kv_index),
+        pl.BlockSpec((None, rows, hd), kv_index),
+    ]
+    args = [q2, kp, vp]
+    if has_tree:
+        assert tree_mask is not None
+        in_specs.insert(0, pl.BlockSpec((None, t_q, t_q), q_index))
+        args.insert(0, tree_mask.astype(jnp.float32))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, n_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, rq, hd), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((rq, 1), jnp.float32),
+            pltpu.VMEM((rq, 1), jnp.float32),
+            pltpu.VMEM((rq, hd), jnp.float32),
+        ],
+    )
+    meta_arr = jnp.stack(
+        [
+            jnp.asarray(window, jnp.int32).reshape(()),
+            jnp.asarray(t_real, jnp.int32).reshape(()),
+        ]
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _chunk_kernel, scale=scale, page_size=page_size,
+            n_pages=n_pages, hkv=hkv, g=g, t_q=t_q, has_tree=has_tree,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, rq, hd), q.dtype),
+        interpret=interpret,
+    )(
+        page_table.astype(jnp.int32), lens.astype(jnp.int32), meta_arr,
+        *args,
+    )
+    return out.reshape(b, t_q, h, hd)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("page_size", "scale", "interpret"),

@@ -179,6 +179,8 @@ def layer_body(
     lora: dict | None = None,  # this layer's per-request LoRA factors
     attn_topk: int = 0,  # sparse attention (executor disables the Pallas
     # kernels when this is on)
+    t_real: int | None = None,  # real (unpadded) step tokens when T is a
+    # padded bucket (the chunk kernel needs it to place query positions)
 ):
     b, t, d = hidden.shape
     h_heads, kv_heads, hd = (
@@ -206,32 +208,44 @@ def layer_body(
         k.reshape(b * t, kv_heads, hd), v.reshape(b * t, kv_heads, hd),
     )
     if use_paged:
-        # single-token decode: the Pallas kernel streams K/V pages straight
-        # from the arena (page table as scalar prefetch) — no gathered
-        # [B, S, Hkv, hd] context buffer in HBM at all. Eligibility (T==1,
-        # no tree/alibi/softcap) was checked host-side; sliding windows are
-        # handled in-kernel (per-layer traced scalar). int4-quantized
-        # arenas dequantize inside the kernel (one pass over ~1/3 the
-        # bytes).
+        # the Pallas kernels stream K/V pages straight from the arena
+        # (page table as scalar prefetch) — no gathered [B, S, Hkv, hd]
+        # context buffer in HBM at all. T==1: decode kernel (int4 arenas
+        # dequantize in-kernel); T>1: chunk kernel covering tree-verify
+        # steps (tree mask applied in-kernel) and short multi-token
+        # chunks. Eligibility (no alibi/softcap, T*H VMEM budget,
+        # tree+window excluded) was checked host-side; sliding windows
+        # ride in as a per-layer traced scalar.
         from bloombee_tpu.kv.quant import QuantSlab
         from bloombee_tpu.ops.pallas.paged_attention import (
+            paged_chunk_attention,
             paged_decode_attention,
             paged_decode_attention_int4,
         )
 
-        kernel = (
-            paged_decode_attention_int4
-            if isinstance(k_slab, QuantSlab)
-            else paged_decode_attention
-        )
-        attn = kernel(
-            q[:, 0], k_slab, v_slab, page_table, total_lens,
-            page_size=page_size, scale=attn_scale(spec),
-            # Mosaic only exists on TPU; any other backend that reaches
-            # here (executor: BBTPU_PAGED_INTERPRET) runs the interpreter
-            interpret=jax.default_backend() != "tpu",
-            window=window,  # per-layer traced scalar (0 = full)
-        )[:, None]  # [B, 1, H, hd]
+        interpret = jax.default_backend() != "tpu"
+        if t == 1:
+            kernel = (
+                paged_decode_attention_int4
+                if isinstance(k_slab, QuantSlab)
+                else paged_decode_attention
+            )
+            attn = kernel(
+                q[:, 0], k_slab, v_slab, page_table, total_lens,
+                page_size=page_size, scale=attn_scale(spec),
+                # Mosaic only exists on TPU; any other backend that
+                # reaches here (BBTPU_PAGED_INTERPRET) interprets
+                interpret=interpret,
+                window=window,  # per-layer traced scalar (0 = full)
+            )[:, None]  # [B, 1, H, hd]
+        else:
+            attn = paged_chunk_attention(
+                q, k_slab, v_slab, page_table, total_lens,
+                page_size=page_size, tree_mask=tree_mask,
+                scale=attn_scale(spec), interpret=interpret,
+                window=window, has_tree=tree_mask is not None,
+                t_real=t_real,
+            )
         attn_out = _proj(
             attn.reshape(b, t, h_heads * hd), params, "o_proj", lora
         )

@@ -114,6 +114,7 @@ def span_step_packed_impl(
     use_paged: bool = False,
     resident: int | None = None,
     attn_topk: int = 0,
+    t_real: int | None = None,
 ):
     """span_step over a pack_step_payload buffer (one h2d per step).
 
@@ -129,14 +130,14 @@ def span_step_packed_impl(
             lora=lora,
             spec=spec, page_size=page_size, max_pages=max_pages,
             use_tree_mask=use_tree_mask, windows=windows, use_flash=use_flash,
-            use_paged=use_paged, attn_topk=attn_topk,
+            use_paged=use_paged, attn_topk=attn_topk, t_real=t_real,
         )
     hidden, ak, av = span_step_impl(
         stacked_params, arena_k[:resident], arena_v[:resident], hidden, plan,
         tree_mask, lora=lora,
         spec=spec, page_size=page_size, max_pages=max_pages,
         use_tree_mask=use_tree_mask, windows=windows, use_flash=use_flash,
-        use_paged=use_paged, attn_topk=attn_topk,
+        use_paged=use_paged, attn_topk=attn_topk, t_real=t_real,
     )
     arena_k = jax.lax.dynamic_update_slice_in_dim(arena_k, ak, 0, 0)
     arena_v = jax.lax.dynamic_update_slice_in_dim(arena_v, av, 0, 0)
@@ -171,6 +172,7 @@ def span_step_impl(
     use_flash: bool = False,
     use_paged: bool = False,
     attn_topk: int = 0,
+    t_real: int | None = None,
 ):
     """Run all local blocks over one step; returns (hidden, arena_k, arena_v).
 
@@ -227,7 +229,7 @@ def span_step_impl(
                 spec, page_size, h, params_l, k_l, v_l, cos_l, sin_l, slots,
                 page_table, q_positions, total_lens, tm, window_l,
                 use_flash=use_flash, use_paged=use_paged, lora=lora_l,
-                attn_topk=attn_topk,
+                attn_topk=attn_topk, t_real=t_real,
             )
 
         def skip(h, k_l, v_l):
@@ -268,6 +270,7 @@ def layer_step_impl(
     use_flash: bool = False,
     use_paged: bool = False,
     attn_topk: int = 0,
+    t_real: int | None = None,
 ):
     """One layer of the span as its own compiled step — the unit of the
     weight-offload path (reference FlexGen Policy weight percentages /
@@ -297,7 +300,7 @@ def layer_step_impl(
         tree_mask if use_tree_mask else None,
         jnp.int32(window),
         use_flash=use_flash, use_paged=use_paged, lora=lora_l,
-        attn_topk=attn_topk,
+        attn_topk=attn_topk, t_real=t_real,
     )
     arena_k = jax.lax.dynamic_update_index_in_dim(arena_k, k_l, layer_idx, 0)
     arena_v = jax.lax.dynamic_update_index_in_dim(arena_v, v_l, layer_idx, 0)
