@@ -36,12 +36,15 @@ def _score_logits(
     from bloombee_tpu.ops import rms_norm
     from bloombee_tpu.ops.norms import layer_norm
 
-    if norm_type == "ln":
-        hn = layer_norm(chain_out, norm_w, norm_b, eps)
-    else:
-        hn = rms_norm(chain_out, norm_w, eps)
+    # gather FIRST: both norm types are position-wise, so norming only the
+    # selected token does O(B*D) instead of O(B*S*D) work (autodiff through
+    # the gather still yields the full-shaped chain gradient)
     b = chain_out.shape[0]
-    h_last = hn[jnp.arange(b), last_idx]  # [B, D]
+    h_last = chain_out[jnp.arange(b), last_idx]  # [B, D]
+    if norm_type == "ln":
+        h_last = layer_norm(h_last, norm_w, norm_b, eps)
+    else:
+        h_last = rms_norm(h_last, norm_w, eps)
     return (h_last @ score_w).astype(jnp.float32)
 
 
@@ -136,15 +139,25 @@ class DistributedModelForSequenceClassification:
     def _last_idx(self, input_ids, attention_mask) -> np.ndarray:
         """Index of the last non-pad token per row (HF semantics: the
         sequence's final real token is the classification summary), offset
-        past any prepended prompts."""
+        past any prepended prompts.
+
+        RIGHT padding only: with a causal chain, trailing pads cannot
+        influence the last real token, so the mask never needs to reach
+        the remote servers. Left padding would both pick a pad position
+        here and contaminate every later token through causal attention —
+        reject it loudly instead of returning plausible garbage."""
         if attention_mask is None:
             last = np.full(
                 (input_ids.shape[0],), input_ids.shape[1] - 1, np.int32
             )
         else:
-            last = (
-                np.asarray(attention_mask).astype(np.int32).sum(axis=1) - 1
-            )
+            mask = np.asarray(attention_mask).astype(np.int32)
+            if np.any(np.diff(mask, axis=1) > 0):
+                raise ValueError(
+                    "attention_mask must be right-padded (ones then "
+                    "zeros); re-tokenize with padding_side='right'"
+                )
+            last = mask.sum(axis=1) - 1
         return last + self.n_prompt
 
     async def scores(

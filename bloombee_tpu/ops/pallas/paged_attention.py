@@ -21,14 +21,15 @@ matmul; rows belonging to a different KV-head group are masked off in the
 logits. Decode attention is HBM-bandwidth-bound — the x Hkv extra FLOPs are
 noise, and the bytes read are exactly one pass over the context.
 
-Scope: single-token decode (T=1) with standard causal semantics —
-per-sequence lengths may differ (masked per page), and sliding windows are
-supported (the per-layer window arrives as a traced scalar; pages wholly
-below the window are skipped, DMA included, via an index-map clamp).
-int4-quantized arenas run the `paged_decode_attention_int4` variant, which
-dequantizes pages in VMEM. Tree masks, ALiBi, and logit soft-caps take the
-dense path (the executor checks eligibility host-side, like the flash
-prefill kernel).
+Scope: three kernels share the online-softmax page-streaming machinery.
+`paged_decode_attention` covers single-token decode (T=1; per-sequence
+lengths masked per page, sliding windows in-kernel with whole-page skips);
+`paged_decode_attention_int4` is its in-VMEM-dequant variant for
+int4-quantized arenas; `paged_chunk_attention` covers T>1 steps —
+tree-verify steps (the [T, T] tree mask applied in-kernel) and short
+multi-token chunks below flash's T>=128 domain. ALiBi, logit soft-caps,
+and tree+window combinations take the dense path (the executor checks
+eligibility host-side, like the flash prefill kernel).
 """
 
 from __future__ import annotations

@@ -28,11 +28,22 @@ from bloombee_tpu.parallel.ring_attention import ring_attention
 # PartitionSpecs for stacked span params [L, ...]; layer dim shards over pp
 PARAM_SPECS = {
     "input_layernorm": P("pp", None),
+    "input_layernorm_bias": P("pp", None),
     "post_attention_layernorm": P("pp", None),
+    "post_attention_layernorm_bias": P("pp", None),
+    "mlp_layernorm": P("pp", None),  # falcon new-arch dual-LN
+    "mlp_layernorm_bias": P("pp", None),
+    "pre_feedforward_layernorm": P("pp", None),  # gemma2 sandwich
+    "post_feedforward_layernorm": P("pp", None),
     "q_proj": P("pp", None, "tp"),
     "k_proj": P("pp", None, "tp"),
     "v_proj": P("pp", None, "tp"),
     "o_proj": P("pp", "tp", None),
+    # qkv biases shard with their projection's OUTPUT dim, so they add
+    # shard-locally before any psum (qwen2-style biased attention)
+    "q_bias": P("pp", "tp"),
+    "k_bias": P("pp", "tp"),
+    "v_bias": P("pp", "tp"),
     "gate_proj": P("pp", None, "tp"),
     "up_proj": P("pp", None, "tp"),
     "down_proj": P("pp", "tp", None),
@@ -47,17 +58,60 @@ PARAM_SPECS = {
 }
 
 
+def _check_known_keys(params: dict) -> None:
+    unknown = sorted(set(params) - set(PARAM_SPECS))
+    if unknown:
+        # loud, named failure instead of a raw KeyError: these are the
+        # same exclusions _spmd_unsupported documents (row-parallel
+        # biases / exotic families)
+        raise NotImplementedError(
+            f"SPMD path has no sharding specs for params {unknown} "
+            "(row-parallel biases and this family's extras aren't "
+            "supported here yet)"
+        )
+
+
 def param_specs(params: dict) -> dict:
+    _check_known_keys(params)
     return {k: PARAM_SPECS[k] for k in params}
 
 
 def shard_span_params(params: dict, mesh: Mesh) -> dict:
     """Place stacked span params on the mesh (pp over layers, tp over
     heads/ffn)."""
+    _check_known_keys(params)
     return {
         k: jax.device_put(v, NamedSharding(mesh, PARAM_SPECS[k]))
         for k, v in params.items()
     }
+
+
+def _spmd_unsupported(spec: ModelSpec, params_l: dict) -> str | None:
+    """Why this family cannot run the SPMD training body; None when it
+    can. The remaining exclusions are RING-ATTENTION limits (no sliding
+    window, no ALiBi positional bias, no logit soft-cap) plus row-parallel
+    output biases — everything else routes through the same spec switches
+    as the serving layer_body."""
+    if spec.layer_types and "sliding" in spec.layer_types:
+        return (
+            "ring attention is full-causal; sliding-window families "
+            "(mistral/gemma) aren't supported here yet"
+        )
+    if spec.alibi:
+        return "ring attention has no positional-bias (ALiBi) path yet"
+    if spec.attn_logit_softcap:
+        return "ring attention has no logit soft-cap path yet"
+    if spec.heterogeneous:
+        return "heterogeneous head_dim spans don't stack into one scan"
+    if any(
+        k in params_l
+        for k in ("o_bias", "down_bias", "gate_bias", "up_bias")
+    ):
+        # row-parallel biases would be added once per shard before the
+        # psum; no in-scope family carries them (bloom does, but ALiBi
+        # already excludes it)
+        return "row-parallel projection biases aren't supported here yet"
+    return None
 
 
 def spmd_block_forward(
@@ -68,30 +122,18 @@ def spmd_block_forward(
     sp_axis: str = "sp",
     tp_axis: str = "tp",
 ) -> jax.Array:
+    """Family-generic SPMD layer: the same ModelSpec switches as the
+    serving layer_body (norm type + biases, parallel-attn residual,
+    sandwich norms, gelu/silu/MoE MLPs, qk-norm, qkv biases) over ring
+    attention + Megatron psums. Covers llama/qwen2/qwen3/mixtral/falcon;
+    `_spmd_unsupported` lists what still fails loudly."""
+    from bloombee_tpu.runtime.layer_body import _norm, attn_scale
+
     b, c, d = hidden.shape
-    if spec.layer_types and "sliding" in spec.layer_types:
+    reason = _spmd_unsupported(spec, params_l)
+    if reason is not None:
         raise NotImplementedError(
-            "ring attention in the spmd path is full-causal; sliding-window "
-            "families (mistral/gemma) aren't supported here yet"
-        )
-    if (
-        spec.norm_type != "rms"
-        or spec.alibi
-        or spec.parallel_attn
-        or spec.sandwich_norms
-        or spec.mlp_type != "silu"
-    ):
-        # this body implements the llama/qwen3/mixtral shape only; biased
-        # or structurally different families must fail loudly, not run with
-        # silently dropped terms
-        raise NotImplementedError(
-            f"spmd block body doesn't cover family {spec.family!r} "
-            "(ln/alibi/parallel-attn/sandwich/gelu variants)"
-        )
-    if any(k.endswith("_bias") for k in params_l):
-        raise NotImplementedError(
-            "spmd block body is bias-free; biased families (qwen2/bloom) "
-            "aren't supported here yet"
+            f"spmd block body doesn't cover family {spec.family!r}: {reason}"
         )
     tp = lax.axis_size(tp_axis)
     if spec.num_attention_heads % tp or spec.num_key_value_heads % tp:
@@ -112,45 +154,88 @@ def spmd_block_forward(
     cos = cos.astype(hidden.dtype)
     sin = sin.astype(hidden.dtype)
 
-    x = rms_norm(hidden, params_l["input_layernorm"], spec.rms_norm_eps)
-    q = (x @ params_l["q_proj"]).reshape(b, c, h_local, hd)
-    k = (x @ params_l["k_proj"]).reshape(b, c, kv_local, hd)
-    v = (x @ params_l["v_proj"]).reshape(b, c, kv_local, hd)
+    def col(x, key):
+        # column-parallel projection: output dim sharded, so the bias
+        # shard adds locally (before any reduction)
+        y = x @ params_l[key]
+        bias = params_l.get(f"{key.removesuffix('_proj')}_bias")
+        if bias is not None:
+            y = y + bias
+        return y
+
+    x = _norm(hidden, params_l, "input_layernorm", spec)
+    q = col(x, "q_proj").reshape(b, c, h_local, hd)
+    k = col(x, "k_proj").reshape(b, c, kv_local, hd)
+    v = col(x, "v_proj").reshape(b, c, kv_local, hd)
     if spec.qk_norm:
         q = rms_norm(q, params_l["q_norm"], spec.rms_norm_eps)
         k = rms_norm(k, params_l["k_norm"], spec.rms_norm_eps)
     q, k = apply_rotary(q, k, cos, sin)
 
-    attn = ring_attention(q, k, v, axis_name=sp_axis, causal=True)
+    attn = ring_attention(
+        q, k, v, axis_name=sp_axis, causal=True, scale=attn_scale(spec)
+    )
     partial = attn.reshape(b, c, h_local * hd) @ params_l["o_proj"]
-    hidden = hidden + lax.psum(partial, tp_axis)
+    attn_out = lax.psum(partial, tp_axis)
 
-    x = rms_norm(hidden, params_l["post_attention_layernorm"], spec.rms_norm_eps)
-    if spec.num_experts:
-        # expert parallelism: full router everywhere, local expert shard
-        # computes its weighted contribution, psum combines
-        from bloombee_tpu.ops.moe import moe_mlp, router_topk_weights
+    def mlp_partial(x):
+        """LOCAL MLP contribution (intermediate dim sharded); the caller
+        psums. Same spec switches as layer_body._mlp, bias-free (checked
+        in _spmd_unsupported)."""
+        if spec.num_experts:
+            # expert parallelism: full router everywhere, local expert
+            # shard computes its weighted contribution, psum combines
+            from bloombee_tpu.ops.moe import moe_mlp, router_topk_weights
 
-        weights = router_topk_weights(
-            x @ params_l["router"], spec.num_experts_per_tok,
-            pre_softmax=spec.moe_pre_softmax, norm_topk=spec.moe_norm_topk,
-        )  # [b, c, E] full
-        e_local = params_l["experts_gate"].shape[0]
-        rank = lax.axis_index(tp_axis)
-        local_w = lax.dynamic_slice_in_dim(
-            weights, rank * e_local, e_local, axis=-1
+            weights = router_topk_weights(
+                x @ params_l["router"], spec.num_experts_per_tok,
+                pre_softmax=spec.moe_pre_softmax,
+                norm_topk=spec.moe_norm_topk,
+            )  # [b, c, E] full
+            e_local = params_l["experts_gate"].shape[0]
+            rank = lax.axis_index(tp_axis)
+            local_w = lax.dynamic_slice_in_dim(
+                weights, rank * e_local, e_local, axis=-1
+            )
+            return moe_mlp(
+                x, None, params_l["experts_gate"], params_l["experts_up"],
+                params_l["experts_down"], spec.num_experts_per_tok,
+                router_weights=local_w,
+            )
+        if spec.mlp_type == "silu":
+            return silu_mlp(
+                x, params_l["gate_proj"], params_l["up_proj"],
+                params_l["down_proj"],
+            )
+        if spec.mlp_type == "gelu_tanh_gated":
+            g = jax.nn.gelu(x @ params_l["gate_proj"], approximate=True)
+            return (g * (x @ params_l["up_proj"])) @ params_l["down_proj"]
+        # plain 4h GELU ("gelu" = exact/erf for falcon)
+        h = jax.nn.gelu(
+            x @ params_l["up_proj"], approximate=spec.mlp_type != "gelu"
         )
-        partial = moe_mlp(
-            x, None, params_l["experts_gate"], params_l["experts_up"],
-            params_l["experts_down"], spec.num_experts_per_tok,
-            router_weights=local_w,
-        )
-    else:
-        partial = silu_mlp(
-            x, params_l["gate_proj"], params_l["up_proj"], params_l["down_proj"]
-        )
-    hidden = hidden + lax.psum(partial, tp_axis)
-    return hidden
+        return h @ params_l["down_proj"]
+
+    if spec.parallel_attn:
+        # falcon: parallel attention+MLP residual; new-arch uses a second
+        # LN for the MLP branch, 7b shares the input norm
+        if spec.num_ln_in_parallel_attn == 2:
+            x_mlp = _norm(hidden, params_l, "mlp_layernorm", spec)
+        else:
+            x_mlp = x
+        return hidden + attn_out + lax.psum(mlp_partial(x_mlp), tp_axis)
+
+    if spec.sandwich_norms:
+        attn_out = _norm(attn_out, params_l, "post_attention_layernorm", spec)
+        hidden = hidden + attn_out
+        x2 = _norm(hidden, params_l, "pre_feedforward_layernorm", spec)
+        mlp_out = lax.psum(mlp_partial(x2), tp_axis)
+        mlp_out = _norm(mlp_out, params_l, "post_feedforward_layernorm", spec)
+        return hidden + mlp_out
+
+    hidden = hidden + attn_out
+    x2 = _norm(hidden, params_l, "post_attention_layernorm", spec)
+    return hidden + lax.psum(mlp_partial(x2), tp_axis)
 
 
 def spmd_span_forward(

@@ -279,6 +279,65 @@ def layer_body(
     )
 
 
+def dense_unsupported(spec: ModelSpec) -> str | None:
+    """Why a family can't run the cache-returning DENSE block forward
+    (drafter path); None when it can. These are attend-injection limits:
+    the caller supplies the attention fn, so position-bias (ALiBi),
+    sliding windows, and logit soft-caps would silently drop."""
+    if spec.alibi:
+        return "ALiBi bias lives inside attention"
+    if spec.layer_types and "sliding" in spec.layer_types:
+        return "sliding-window masks live inside attention"
+    if spec.attn_logit_softcap:
+        return "attention logit soft-cap lives inside attention"
+    if spec.heterogeneous:
+        return "heterogeneous head_dim layers"
+    return None
+
+
+def dense_block_forward(
+    params: dict,
+    spec: ModelSpec,
+    hidden: jax.Array,  # [B, T, D]
+    cos: jax.Array,
+    sin: jax.Array,
+    attend,  # (q, k, v) -> (attn_out [B, T, H, hd], aux)
+):
+    """Family-generic DENSE block forward with caller-supplied attention —
+    the client-side analog of layer_body for code that manages its own KV
+    (the speculative drafter; reference spec_decoding_drafter.py:67-110
+    drives HF models the same way). Same spec switches as layer_body:
+    norm types + biases, qk-norm, parallel-attn residual, sandwich norms,
+    silu/gelu/MoE MLPs. Returns (hidden, (k, v))."""
+    reason = dense_unsupported(spec)
+    if reason is not None:
+        raise NotImplementedError(
+            f"dense block forward doesn't cover family {spec.family!r}: "
+            f"{reason}"
+        )
+    b, t, d = hidden.shape
+    h_heads, kv_heads, hd = (
+        spec.num_attention_heads,
+        spec.num_key_value_heads,
+        spec.head_dim,
+    )
+    x = _norm(hidden, params, "input_layernorm", spec)
+    q = _proj(x, params, "q_proj").reshape(b, t, h_heads, hd)
+    k = _proj(x, params, "k_proj").reshape(b, t, kv_heads, hd)
+    v = (
+        k if spec.k_eq_v
+        else _proj(x, params, "v_proj").reshape(b, t, kv_heads, hd)
+    )
+    if spec.qk_norm:
+        q = rms_norm(q, params["q_norm"], spec.rms_norm_eps)
+        k = rms_norm(k, params["k_norm"], spec.rms_norm_eps)
+    q, k = apply_rotary(q, k, cos, sin)
+    attn, _aux = attend(q, k, v)
+    attn_out = _proj(attn.reshape(b, t, h_heads * hd), params, "o_proj")
+    hidden, k, v = _finish_layer(spec, params, hidden, x, attn_out, k, v)
+    return hidden, (k, v)
+
+
 def _finish_layer(spec, params, hidden, x, attn_out, k_slab, v_slab,
                   lora=None):
     """Residual + MLP tail shared by the dense/flash/paged attention paths."""

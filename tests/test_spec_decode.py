@@ -624,3 +624,74 @@ def test_accept_sampling_preserves_target_distribution():
         tgt = _softmax(target_logits[None] / temperature)[0]
         tv = 0.5 * np.abs(emp - tgt).sum()
         assert tv < 0.02, (temperature, tv, emp.round(3), tgt.round(3))
+
+
+def test_e2e_speculative_qwen2_family(tmp_path):
+    """Non-llama family drafting + tree-verifying through the swarm: the
+    drafter registry is family-generic (round-4 verdict: it hardwired
+    llama's block_forward). Qwen2 brings biased qkv projections."""
+    import transformers as tf
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.client.speculative import generate_speculative
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = tf.Qwen2Config(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(4)
+    hf = tf.Qwen2ForCausalLM(config).eval().to(torch.float32)
+    d = str(tmp_path / "qwen2")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        server = BlockServer(
+            model_uid="q", start=0, end=2, model_dir=d, registry=rc(),
+            compute_dtype=jnp.float32, num_pages=64, page_size=4,
+        )
+        await server.start()
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            d, rc(), model_uid="q", use_push=False
+        )
+        drafter = GreedyTreeDrafter(
+            LocalJaxDraftModel.from_dir(d), branching=(2, 1)
+        )
+        input_ids = np.arange(5)[None, :]
+        spec_ids = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=8
+        )
+        assert spec_ids.shape[1] >= input_ids.shape[1] + 8
+        plain_ids = await model.generate(
+            input_ids, max_new_tokens=spec_ids.shape[1] - input_ids.shape[1]
+        )
+        np.testing.assert_array_equal(spec_ids, plain_ids)
+
+        await server.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_drafter_rejects_unsupported_family():
+    from bloombee_tpu.models.spec import ModelSpec
+    from bloombee_tpu.spec.drafter import LocalJaxDraftModel
+
+    spec = ModelSpec(
+        family="bloom", hidden_size=32, intermediate_size=128,
+        num_attention_heads=4, num_key_value_heads=4, head_dim=8,
+        num_hidden_layers=2, vocab_size=64, alibi=True, norm_type="ln",
+        mlp_type="gelu_tanh",
+    )
+    with pytest.raises(NotImplementedError, match="ALiBi"):
+        LocalJaxDraftModel(spec, [], {})
