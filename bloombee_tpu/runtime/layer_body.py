@@ -258,14 +258,15 @@ def layer_body(
     if use_flash:
         # long-context prefill: the Pallas kernel streams K/V tiles through
         # VMEM instead of materializing [B,H,T,S] logits in HBM. Eligibility
-        # (uniform starts/lens, no tree/window/alibi/softcap, T>=128) was
-        # checked host-side by the executor; the causal mask with the
-        # uniform start offset also masks the page-padded tail of k_ctx.
+        # (no tree/window/alibi/softcap, T>=128) was checked host-side by
+        # the executor; per-row starts/lens ride in as traced vectors, so
+        # MIXED-length batches (multi-turn session prefill) engage flash
+        # too, with the lens mask hiding each row's page-padded tail.
         from bloombee_tpu.ops.pallas.flash_attention import flash_attention
 
         attn = flash_attention(
             q, k_ctx, v_ctx, causal=True, scale=attn_scale(spec),
-            offset=q_positions[0, 0],
+            starts=q_positions[:, 0], lens=total_lens,
             interpret=jax.default_backend() != "tpu",
         )
     else:
