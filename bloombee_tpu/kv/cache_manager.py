@@ -597,6 +597,27 @@ class CacheManager:
                 self._seq_epoch[sid] = self.arena_epoch
 
     @_locked
+    def memory_stats(self) -> dict:
+        """KV-side byte/token accounting for the memory-observability
+        surface (utils/memory.py) — kept here so it reads this manager's
+        state through one accessor instead of private attributes."""
+        from bloombee_tpu.utils.memory import tree_nbytes
+
+        parked_resolved = 0
+        parked_total = 0
+        for entry in self._parked.values():
+            parked_total += 1
+            if entry.host is not None:
+                parked_resolved += tree_nbytes(entry.host)
+        return {
+            "kv_arena_bytes": tree_nbytes(self.arena),
+            "parked_kv_host_bytes": parked_resolved,
+            "parked_seqs": parked_total,
+            "kv_tokens_reserved": int(self._reserved_tokens),
+            "kv_tokens_capacity": int(self.capacity_tokens),
+        }
+
+    @_locked
     def epoch_valid(self, handle: "CacheHandle") -> bool:
         """True iff every sequence in `handle` still has servable KV: its
         validity epoch matches the current arena epoch (either no rebuild

@@ -99,6 +99,6 @@ def dequantize(slab: QuantSlab, dtype=jnp.bfloat16) -> jax.Array:
 
 def slab_nbytes(slab) -> int:
     """Total bytes of a slab (dense array or QuantSlab)."""
-    return sum(
-        leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(slab)
-    )
+    from bloombee_tpu.utils.memory import tree_nbytes
+
+    return tree_nbytes(slab)

@@ -121,7 +121,6 @@ def quantize_span_params(stacked: dict, bits: int) -> dict:
 
 
 def params_nbytes(stacked: dict) -> int:
-    return sum(
-        leaf.size * leaf.dtype.itemsize
-        for leaf in jax.tree.leaves(stacked)
-    )
+    from bloombee_tpu.utils.memory import tree_nbytes
+
+    return tree_nbytes(stacked)

@@ -674,6 +674,16 @@ class BlockServer:
                     from bloombee_tpu.wire.tensor_codec import transport_stats
 
                     logger.info("[transport] %s", transport_stats())
+                if env.log_channel_enabled("memory"):
+                    from bloombee_tpu.utils.memory import (
+                        format_report,
+                        server_memory_report,
+                    )
+
+                    logger.info(
+                        "[memory] %s",
+                        format_report(server_memory_report(self)),
+                    )
                 await asyncio.wait_for(
                     self._measure_next_pings(), self.announce_period
                 )
@@ -734,6 +744,11 @@ class BlockServer:
         }
         if fused_decline is not None:
             info["decode_n_decline"] = fused_decline
+        from bloombee_tpu.utils.memory import server_memory_report
+
+        # operator-pollable memory accounting (reference memory_usage.py's
+        # logging surface, as a remote field instead of a local probe)
+        info["memory"] = server_memory_report(self)
         if self._client_params is not None:
             info["head_dtype"] = str(self._client_params["lm_head"].dtype)
         return info, []

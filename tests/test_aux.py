@@ -92,3 +92,60 @@ def test_chunked_head_matches_full():
         np.testing.assert_allclose(
             np.asarray(chunked), np.asarray(full), rtol=1e-6, atol=1e-6
         )
+
+
+def test_memory_report_accounts_server_arrays(tmp_path):
+    """The memory surface (reference utils/memory_usage.py role) must
+    report exact framework-side byte counts and ride rpc_info."""
+    import asyncio
+
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+    from bloombee_tpu.utils.memory import (
+        format_report,
+        server_memory_report,
+        tree_nbytes,
+    )
+    from bloombee_tpu.wire.rpc import connect
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=2, vocab_size=64,
+        max_position_embeddings=128, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(config).eval().to(torch.float32).save_pretrained(
+        tmp_path, safe_serialization=True
+    )
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        s = BlockServer(
+            model_uid="t", start=0, end=2, model_dir=str(tmp_path),
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=16, page_size=4,
+        )
+        await s.start()
+        report = server_memory_report(s)
+        # exact accounting: arena = L * (pages*page_size) * Hkv * hd
+        # * 2 slabs * 4 bytes (fp32); hd = 64 hidden / 4 heads = 16
+        assert report["kv_arena_bytes"] == 2 * (16 * 4) * 2 * 16 * 2 * 4
+        assert report["span_params_bytes"] == tree_nbytes(s.executor.params)
+        assert report["kv_tokens_capacity"] == 64
+        assert "params=" in format_report(report)
+
+        conn = await connect("127.0.0.1", s.port)
+        info, _ = await conn.call("rpc_info", {}, [])
+        await conn.close()
+        assert info["memory"]["kv_arena_bytes"] == report["kv_arena_bytes"]
+
+        await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())

@@ -323,3 +323,42 @@ def test_e2e_neural_pruner_online_training(tmp_path, monkeypatch):
         ).numpy()
     np.testing.assert_array_equal(out, ref)
     assert trained, "neural pruner saw no online training steps"
+
+
+def test_cap_kept_by_score_matches_rescan_reference():
+    """The heap-driven cap must pick exactly the set the O(k^2) full
+    leaf-rescan reference picks (including score ties), on random trees."""
+    import numpy as np
+
+    from bloombee_tpu.spec.pruner import _cap_kept_by_score
+    from bloombee_tpu.spec.tree import DraftTree
+
+    def rescan_reference(tree, keep, scores, cap):
+        keep = keep.copy()
+        t = tree.size
+        while int(keep.sum()) > cap:
+            kept_now = np.nonzero(keep)[0]
+            has_kept_child = np.zeros(t, dtype=bool)
+            for c in kept_now:
+                parent = int(tree.parents[c])
+                if parent >= 0:
+                    has_kept_child[parent] = True
+            leaves = kept_now[~has_kept_child[kept_now]]
+            keep[int(leaves[int(np.argmin(scores[leaves]))])] = False
+        return keep
+
+    rng = np.random.default_rng(0)
+    for trial in range(50):
+        t = int(rng.integers(2, 40))
+        parents = np.array(
+            [-1] + [int(rng.integers(0, i)) for i in range(1, t)], np.int32
+        )
+        tree = DraftTree(tokens=np.arange(t), parents=parents)
+        keep = rng.random(t) < 0.8
+        keep[0] = True
+        # quantized scores force plenty of exact ties
+        scores = np.round(rng.random(t) * 4) / 4
+        cap = int(rng.integers(1, t + 1))
+        got = _cap_kept_by_score(tree, keep.copy(), scores, cap)
+        want = rescan_reference(tree, keep, scores, cap)
+        np.testing.assert_array_equal(got, want, err_msg=f"trial {trial}")
