@@ -99,6 +99,11 @@ def main(argv=None):
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel degree over local chips "
                         "(reference --tensor_parallel_devices)")
+    parser.add_argument("--sp", type=int, default=1,
+                        help="sequence-parallel degree: prefills of >= "
+                        "BBTPU_SP_MIN_TOKENS spread over this many local "
+                        "chips via ring attention; decode stays "
+                        "single-chip paged")
     parser.add_argument("--warmup-batches", default="1",
                         help="comma-separated batch buckets to pre-compile "
                         "at startup ('' = skip)")
@@ -156,6 +161,7 @@ def main(argv=None):
             adapter_dirs=args.adapter_dirs,
             adapters=parse_adapters(args.adapters),
             tp=args.tp,
+            sp=args.sp,
             kv_quant=args.kv_quant,
             weight_quant=args.weight_quant,
             oversubscribe=args.oversubscribe,

@@ -597,6 +597,21 @@ class CacheManager:
                 self._seq_epoch[sid] = self.arena_epoch
 
     @_locked
+    def is_fresh(self, handle: "CacheHandle") -> bool:
+        """True iff every sequence in `handle` has NO server-side state at
+        all: zero committed/speculative length AND nothing parked to host.
+        (A parked sequence's table length reads 0 — its KV lives in
+        `_parked` — so a bare length check would misclassify it as fresh;
+        the sp-prefill eligibility gate needs the distinction.)"""
+        for sid in handle.seq_ids:
+            if sid in self._parked:
+                return False
+            state = self.table.seq(sid)
+            if state.l_seq or state.l_acc:
+                return False
+        return True
+
+    @_locked
     def memory_stats(self) -> dict:
         """KV-side byte/token accounting for the memory-observability
         surface (utils/memory.py) — kept here so it reads this manager's

@@ -6,6 +6,14 @@ attention with a numerically-stable online softmax (flash-attention style
 streaming stats). After sp steps every Q block has seen every KV block and
 no device ever materializes full-sequence attention logits.
 
+Within one ring step the local chunk is processed in (q block, k block)
+tiles with the SAME online update, so peak logits memory is
+[B, H, block, block] regardless of chunk length — without tiling, a 64k
+prompt over sp=4 would need ~34 GB of fp32 logits per step and the long
+prompts the sp path exists for would OOM instead of speeding up. Chunks
+that don't divide the block size are padded; padded keys get a sentinel
+position no causal mask admits, padded query rows are sliced off.
+
 This fills the reference's explicit long-context gap (SURVEY.md section 5:
 "no ring attention / Ulysses / context parallelism" — it only chunks prefill
 and offloads the KV slab to host). Compute stays in the input dtype for the
@@ -20,6 +28,8 @@ from jax import lax
 
 from bloombee_tpu.ops.attention import NEG_INF as NEG, repeat_kv
 
+_PAD_POS = 1 << 30  # sentinel: padded keys are in everyone's causal future
+
 
 def ring_attention(
     q: jax.Array,  # [B, C, H, hd] local query chunk
@@ -28,6 +38,7 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     scale: float | None = None,
+    block: int = 512,  # in-step tile size: peak logits = [B, H, blk, blk]
 ) -> jax.Array:
     """Must be called inside shard_map with `axis_name` mapped; returns the
     local output chunk [B, C, H, hd]."""
@@ -38,36 +49,81 @@ def ring_attention(
     if scale is None:
         scale = hd**-0.5
 
-    q_pos = rank * c + jnp.arange(c)  # global positions of local queries
-    qf = q  # [B, C, H, hd]
+    blk = min(block, c)
+    c_pad = -(-c // blk) * blk
+    if c_pad != c:
+        pad = ((0, 0), (0, c_pad - c), (0, 0), (0, 0))
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    n_blk = c_pad // blk
+    valid = jnp.arange(c_pad) < c
+    q_pos = rank * c + jnp.arange(c_pad)  # padded q rows: garbage, sliced
+    qf = q  # [B, Cp, H, hd]
+    qp_bs = q_pos.reshape(n_blk, blk)
+    q_bs = qf.transpose(1, 0, 2, 3).reshape(n_blk, blk, b, h, hd)
 
     def step(carry, i):
         m, l, acc, k_cur, v_cur = carry
         src = (rank - i) % n  # who produced the block currently held
-        kv_pos = src * c + jnp.arange(c)
+        # padded keys sit past every real position: no causal mask admits
+        # the sentinel, so they contribute nothing on any rank
+        kv_pos = jnp.where(valid, src * c + jnp.arange(c_pad), _PAD_POS)
 
         def attend(m, l, acc):
-            k_r = repeat_kv(k_cur, n_rep)
+            k_r = repeat_kv(k_cur, n_rep)  # [B, Cp, H, hd]
             v_r = repeat_kv(v_cur, n_rep)
-            logits = (
-                jnp.einsum("bqhd,bkhd->bhqk", qf, k_r).astype(jnp.float32)
-                * scale
+            k_bs = k_r.transpose(1, 0, 2, 3).reshape(n_blk, blk, b, h, hd)
+            v_bs = v_r.transpose(1, 0, 2, 3).reshape(n_blk, blk, b, h, hd)
+            kvp_bs = kv_pos.reshape(n_blk, blk)
+            m_bs = m.reshape(b, h, n_blk, blk).transpose(2, 0, 1, 3)
+            l_bs = l.reshape(b, h, n_blk, blk).transpose(2, 0, 1, 3)
+            acc_bs = acc.reshape(b, h, n_blk, blk, hd).transpose(
+                2, 0, 1, 3, 4
             )
-            if causal:
-                mask = kv_pos[None, :] <= q_pos[:, None]  # [Cq, Ck]
-                logits = jnp.where(mask[None, None], logits, NEG)
-                pmask = mask[None, None].astype(jnp.float32)
-            else:
-                pmask = jnp.ones((1, 1, c, c), jnp.float32)
 
-            m_new = jnp.maximum(m, logits.max(axis=-1))
-            p = jnp.exp(logits - m_new[..., None]) * pmask
-            corr = jnp.exp(m - m_new)
-            l_new = l * corr + p.sum(axis=-1)
-            acc_new = acc * corr[..., None] + jnp.einsum(
-                "bhqk,bkhd->bhqd", p.astype(q.dtype), v_r
-            ).astype(jnp.float32)
-            return m_new, l_new, acc_new
+            def one_q(xs):
+                q_blk, qp, m_b, l_b, acc_b = xs
+
+                def k_step(carry, ks):
+                    m_b, l_b, acc_b = carry
+                    k_blk, v_blk, kvp = ks
+                    logits = (
+                        jnp.einsum(
+                            "qbhd,kbhd->bhqk", q_blk, k_blk
+                        ).astype(jnp.float32)
+                        * scale
+                    )  # [b, h, blk, blk]
+                    if causal:
+                        mask = kvp[None, :] <= qp[:, None]
+                    else:
+                        mask = (kvp < _PAD_POS)[None, :] & jnp.ones(
+                            (blk, 1), bool
+                        )
+                    logits = jnp.where(mask[None, None], logits, NEG)
+                    pmask = mask[None, None].astype(jnp.float32)
+                    m_new = jnp.maximum(m_b, logits.max(axis=-1))
+                    p = jnp.exp(logits - m_new[..., None]) * pmask
+                    corr = jnp.exp(m_b - m_new)
+                    l_new = l_b * corr + p.sum(axis=-1)
+                    acc_new = acc_b * corr[..., None] + jnp.einsum(
+                        "bhqk,kbhd->bhqd", p.astype(q.dtype), v_blk
+                    ).astype(jnp.float32)
+                    return (m_new, l_new, acc_new), None
+
+                (m_b, l_b, acc_b), _ = lax.scan(
+                    k_step, (m_b, l_b, acc_b), (k_bs, v_bs, kvp_bs)
+                )
+                return m_b, l_b, acc_b
+
+            # lax.map serializes q tiles, so peak logits stay one tile
+            m2, l2, acc2 = lax.map(
+                one_q, (q_bs, qp_bs, m_bs, l_bs, acc_bs)
+            )
+            m2 = m2.transpose(1, 2, 0, 3).reshape(b, h, c_pad)
+            l2 = l2.transpose(1, 2, 0, 3).reshape(b, h, c_pad)
+            acc2 = acc2.transpose(1, 2, 0, 3, 4).reshape(b, h, c_pad, hd)
+            return m2, l2, acc2
 
         if causal:
             # skip blocks entirely in this rank's causal future (half of all
@@ -89,13 +145,14 @@ def ring_attention(
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (m, l, acc, k_nxt, v_nxt), None
 
-    m0 = jnp.full((b, h, c), NEG, jnp.float32)
-    l0 = jnp.zeros((b, h, c), jnp.float32)
-    acc0 = jnp.zeros((b, h, c, hd), jnp.float32)
+    m0 = jnp.full((b, h, c_pad), NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, c_pad), jnp.float32)
+    acc0 = jnp.zeros((b, h, c_pad, hd), jnp.float32)
     # scan (not fori_loop) so the ring is reverse-differentiable for training
     (m, l, acc, _, _), _ = lax.scan(
         step, (m0, l0, acc0, k, v), jnp.arange(n)
     )
 
     out = acc / jnp.maximum(l, 1e-20)[..., None]  # fully-masked rows -> 0
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, C, H, hd]
+    out = out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, Cp, H, hd]
+    return out[:, :c]

@@ -1,0 +1,122 @@
+"""Sequence-parallel SERVING prefill: long-context prefill over an sp mesh.
+
+The long-context serving story (SURVEY §5): a single chip's prefill
+latency grows linearly with prompt length, so a server with idle local
+chips can spread ONE session's prefill over them — each chip computes a
+contiguous sequence chunk with ring attention streaming K/V blocks around
+the `sp` axis (parallel/ring_attention.py), and every layer's K/V chunks
+are collected into the ordinary paged arena afterwards. DECODE then
+continues on the unmodified single-chip paged path: sequence parallelism
+is a PREFILL accelerator here, not a resident sharding, which is exactly
+the shape of the problem (prefill is compute-bound and parallel over
+tokens; decode is latency-bound and serial).
+
+The reference has no sequence/context parallelism at all (SURVEY §2.8);
+this composes the training-side ring attention with the serving arena.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.parallel.spmd import (
+    PARAM_SPECS,
+    _check_known_keys,
+    _spmd_unsupported,
+    spmd_span_forward_kv,
+)
+
+
+def make_sp_mesh(sp: int, devices=None) -> Mesh:
+    """(tp=1, sp) mesh over the local chips: the SPMD body wants both
+    axes; serving sp keeps tp degenerate (compose later if needed)."""
+    devices = devices if devices is not None else jax.devices()
+    if sp > len(devices):
+        raise ValueError(f"sp={sp} needs {sp} devices, have {len(devices)}")
+    return Mesh(
+        np.asarray(devices[:sp]).reshape(1, sp), ("tp", "sp")
+    )
+
+
+def sp_unsupported(spec: ModelSpec, params: dict) -> str | None:
+    """Why this span cannot run sp prefill; None when it can. Inherits the
+    SPMD body's family limits (ring attention: no windows/ALiBi/soft-cap)
+    plus serving-side ones (fresh full-context prefill only)."""
+    reason = _spmd_unsupported(spec, params)
+    if reason is not None:
+        return reason
+    unknown = set(params) - set(PARAM_SPECS)
+    if unknown:
+        return f"no sharding specs for params {sorted(unknown)}"
+    return None
+
+
+def _sp_spec(key: str) -> P:
+    """PARAM_SPECS with the training mesh's 'pp' layer axis dropped (the
+    sp serving mesh has no pipeline axis; whole span on every chip)."""
+    return P(*(None if a == "pp" else a for a in PARAM_SPECS[key]))
+
+
+def place_sp_params(params: dict, mesh: Mesh) -> dict:
+    """Replicate span params over the sp mesh (tp is degenerate, and the
+    sequence axis never shards weights)."""
+    _check_known_keys(params)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, _sp_spec(k)))
+        for k, v in params.items()
+    }
+
+
+@functools.lru_cache(maxsize=None)
+def _sp_prefill_fn(mesh: Mesh, spec: ModelSpec, param_keys: tuple):
+    fwd = jax.shard_map(
+        functools.partial(
+            spmd_span_forward_kv, spec=spec, sp_axis="sp", tp_axis="tp"
+        ),
+        mesh=mesh,
+        in_specs=(
+            {k: _sp_spec(k) for k in param_keys},
+            P(None, "sp", None),
+        ),
+        out_specs=(
+            P(None, "sp", None),
+            P(None, None, "sp", None, None),
+            P(None, None, "sp", None, None),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(fwd)
+
+
+def sp_prefill(
+    params: dict,  # stacked span params, already placed via place_sp_params
+    hidden,  # [B, T, D] (np or jax), T % sp == 0 (caller pads)
+    mesh: Mesh,
+    *,
+    spec: ModelSpec,
+):
+    """Run the whole span's prefill over the sp mesh from position 0.
+
+    Returns (hidden_out [B, T, D], k [L, B, T, Hkv, hd], v [...]): k is
+    post-rotary exactly like the serving layer body writes it, so the
+    caller scatters k/v straight into the paged arena and decode picks up
+    where prefill left off."""
+    reason = sp_unsupported(spec, params)
+    if reason is not None:
+        raise NotImplementedError(f"sp prefill unavailable: {reason}")
+    t = np.shape(hidden)[1]
+    sp = mesh.devices.shape[1]
+    if t % sp:
+        raise ValueError(f"sp prefill needs T % sp == 0 (T={t}, sp={sp})")
+    hidden = jax.device_put(
+        jnp.asarray(hidden), NamedSharding(mesh, P(None, "sp", None))
+    )
+    fn = _sp_prefill_fn(mesh, spec, tuple(sorted(params)))
+    return fn(params, hidden)

@@ -121,7 +121,10 @@ def spmd_block_forward(
     spec: ModelSpec,
     sp_axis: str = "sp",
     tp_axis: str = "tp",
-) -> jax.Array:
+    return_kv: bool = False,  # also return this layer's LOCAL (k, v)
+    # chunk shards [b, C, kv_local, hd] — the sp-serving prefill collects
+    # them into the paged arena so decode can continue single-chip
+):
     """Family-generic SPMD layer: the same ModelSpec switches as the
     serving layer_body (norm type + biases, parallel-attn residual,
     sandwich norms, gelu/silu/MoE MLPs, qk-norm, qkv biases) over ring
@@ -223,19 +226,21 @@ def spmd_block_forward(
             x_mlp = _norm(hidden, params_l, "mlp_layernorm", spec)
         else:
             x_mlp = x
-        return hidden + attn_out + lax.psum(mlp_partial(x_mlp), tp_axis)
-
-    if spec.sandwich_norms:
+        out = hidden + attn_out + lax.psum(mlp_partial(x_mlp), tp_axis)
+    elif spec.sandwich_norms:
         attn_out = _norm(attn_out, params_l, "post_attention_layernorm", spec)
         hidden = hidden + attn_out
         x2 = _norm(hidden, params_l, "pre_feedforward_layernorm", spec)
         mlp_out = lax.psum(mlp_partial(x2), tp_axis)
         mlp_out = _norm(mlp_out, params_l, "post_feedforward_layernorm", spec)
-        return hidden + mlp_out
-
-    hidden = hidden + attn_out
-    x2 = _norm(hidden, params_l, "post_attention_layernorm", spec)
-    return hidden + lax.psum(mlp_partial(x2), tp_axis)
+        out = hidden + mlp_out
+    else:
+        hidden = hidden + attn_out
+        x2 = _norm(hidden, params_l, "post_attention_layernorm", spec)
+        out = hidden + lax.psum(mlp_partial(x2), tp_axis)
+    if return_kv:
+        return out, (k, v)
+    return out
 
 
 def spmd_span_forward(
@@ -256,3 +261,27 @@ def spmd_span_forward(
 
     hidden, _ = lax.scan(body, hidden, stacked_local)
     return hidden
+
+
+def spmd_span_forward_kv(
+    stacked_local: dict,
+    hidden: jax.Array,
+    *,
+    spec: ModelSpec,
+    sp_axis: str = "sp",
+    tp_axis: str = "tp",
+):
+    """spmd_span_forward that also stacks every layer's local (k, v)
+    chunk shards [L, b, C, kv_local, hd] — the sp-serving prefill writes
+    them into the paged arena so DECODE continues on the ordinary
+    single-chip paged path."""
+
+    def body(h, params_l):
+        h, (k, v) = spmd_block_forward(
+            params_l, h, spec=spec, sp_axis=sp_axis, tp_axis=tp_axis,
+            return_kv=True,
+        )
+        return h, (k, v)
+
+    hidden, (ks, vs) = lax.scan(body, hidden, stacked_local)
+    return hidden, ks, vs

@@ -10,6 +10,8 @@ executable (the CUDA-graph role of the reference's cuda_graphs.py).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -53,6 +55,12 @@ env.declare(
     "run the flash prefill kernel in interpreter mode on non-TPU backends "
     "(CPU parity tests; far too slow for production)",
 )
+env.declare(
+    "BBTPU_SP_MIN_TOKENS", int, 1024,
+    "spread a session's prefill over the --sp mesh (ring attention) only "
+    "when the prompt has at least this many tokens; short prefills stay "
+    "single-chip (chunk overhead + collectives would dominate)",
+)
 
 
 def next_pow2(n: int, floor: int = 1) -> int:
@@ -60,6 +68,24 @@ def next_pow2(n: int, floor: int = 1) -> int:
     while v < n:
         v *= 2
     return v
+
+
+@functools.partial(jax.jit, donate_argnames=("arena_k", "arena_v"))
+def _arena_write_all(arena_k, arena_v, slots, k_new, v_new):
+    """Scatter every layer's new KV rows into the donated arena (the
+    sp-prefill landing step; quantized slabs quantize inside arena_write)."""
+    from jax import lax
+
+    from bloombee_tpu.kv.arena import arena_write
+
+    def body(_, xs):
+        k_l, v_l, kn, vn = xs
+        return None, arena_write(k_l, v_l, slots, kn, vn)
+
+    _, (new_k, new_v) = lax.scan(
+        body, None, (arena_k, arena_v, k_new, v_new)
+    )
+    return new_k, new_v
 
 
 class SpanExecutor:
@@ -82,11 +108,52 @@ class SpanExecutor:
         # attn_sparsity*(S-1) past keys per query plus the newest token
         # (reference FlexGen Policy.attn_sparsity,
         # pytorch_backend.py:564-638); approximate — dense path only
+        sp_mesh=None,  # (tp=1, sp) mesh: long prefills (>= SP_MIN_TOKENS)
+        # spread over the sp chips via ring attention, K/V landing in the
+        # paged arena; decode stays single-chip (parallel/sp_serving.py)
     ):
         if not 0.0 < attn_sparsity <= 1.0:
             raise ValueError(f"attn_sparsity in (0, 1], got {attn_sparsity}")
         self.attn_sparsity = float(attn_sparsity)
         self.mesh = mesh
+        self.sp_mesh = sp_mesh
+        self._sp_params = None
+        if sp_mesh is not None:
+            if mesh is not None:
+                raise ValueError(
+                    "sp prefill + TP serving not supported together yet"
+                )
+            if host_layers:
+                raise ValueError(
+                    "sp prefill + weight offload not supported together"
+                )
+            if spec.heterogeneous:
+                raise ValueError(
+                    "sp prefill + heterogeneous head_dim spans not "
+                    "supported together"
+                )
+            if manager.quant is not None:
+                # _sp_eligible would silently never fire (quantized arenas
+                # attend quantized KV during single-chip prefill; ring
+                # attends full precision) while the replicated param copy
+                # still costs every sp chip — fail at startup instead
+                raise ValueError(
+                    "sp prefill + quantized KV arena not supported "
+                    "together (single-chip prefill attends quantized KV; "
+                    "ring attention would change the numerics)"
+                )
+            from bloombee_tpu.parallel.sp_serving import (
+                place_sp_params,
+                sp_unsupported,
+            )
+
+            reason = sp_unsupported(spec, stacked_params)
+            if reason is not None:
+                raise ValueError(f"sp prefill unavailable: {reason}")
+            # a replicated copy over the sp chips (the single-chip decode
+            # path keeps its own placement; span params are a small price
+            # next to the long-context KV this feature exists to serve)
+            self._sp_params = place_sp_params(stacked_params, sp_mesh)
         self.host_layers = list(host_layers or [])
         self.resident = manager.num_layers - len(self.host_layers)
         if self.host_layers:
@@ -185,6 +252,8 @@ class SpanExecutor:
         """
         outs = []
         t = hidden.shape[1]
+        if self._sp_eligible(handle, t, commit, layers, adapter):
+            return self._sp_prefill(handle, hidden, fetch)
         for start in range(0, t, self.max_chunk_tokens):
             chunk = hidden[:, start : start + self.max_chunk_tokens]
             outs.append(
@@ -197,6 +266,81 @@ class SpanExecutor:
             return outs[0]
         cat = np.concatenate if fetch else jnp.concatenate
         return cat(outs, axis=1)
+
+    def _sp_eligible(self, handle, t, commit, layers, adapter) -> bool:
+        """Sequence-parallel prefill fires for a FRESH full-span committed
+        prefill of a long prompt (starts all zero); everything else takes
+        the single-chip chunked path."""
+        return bool(
+            self.sp_mesh is not None
+            and commit
+            and layers is None
+            and adapter is None
+            # quantized arenas attend QUANTIZED KV during single-chip
+            # prefill (each chunk reads back what it just wrote); ring
+            # attention attends full precision — a numeric contract
+            # change, so int4 arenas keep the single-chip path
+            and self.manager.quant is None
+            and t >= env.get("BBTPU_SP_MIN_TOKENS")
+            # is_fresh, NOT a bare length check: a host-parked session's
+            # table length reads 0 while its real KV sits in the park —
+            # sp-prefilling it from position 0 would orphan that KV and
+            # blow the unpark invariant on the next decode
+            and self.manager.is_fresh(handle)
+        )
+
+    def _sp_prefill(self, handle, hidden: np.ndarray, fetch: bool):
+        """Whole-prompt prefill over the sp mesh (ring attention), K/V
+        scattered into the paged arena so decode continues single-chip
+        (parallel/sp_serving.py)."""
+        from bloombee_tpu.parallel.sp_serving import sp_prefill
+
+        b, t, d = hidden.shape
+        sp = self.sp_mesh.devices.shape[1]
+        # pow2 bucket FIRST (compile count stays O(log T), same contract
+        # as the single-chip path), then round up to a multiple of sp for
+        # the ring chunks
+        t_pad = next_pow2(t)
+        t_pad = -(-t_pad // sp) * sp
+        h_pad = np.zeros((b, t_pad, d), dtype=self.transfer_dtype)
+        h_pad[:, :t] = hidden.astype(self.transfer_dtype)
+        slots = self.manager.write_slots(handle, t, commit=True)  # [b*t]
+        out, ks, vs = sp_prefill(
+            self._sp_params, h_pad, self.sp_mesh, spec=self.spec
+        )
+        # pad tokens write to the drop slot; real tokens land in their
+        # assigned pages
+        oob = self.manager.capacity_tokens
+        slots_pad = np.full((b, t_pad), oob, np.int32)
+        slots_pad[:, :t] = slots.reshape(b, t)
+        dev0 = jax.devices()[0]
+        l = self.manager.num_layers
+        hkv = ks.shape[3]
+        hd = ks.shape[4]
+        k_new = jax.device_put(
+            ks.reshape(l, b * t_pad, hkv, hd), dev0
+        )
+        v_new = jax.device_put(
+            vs.reshape(l, b * t_pad, hkv, hd), dev0
+        )
+        arena = self.manager.arena
+        try:
+            new_k, new_v = _arena_write_all(
+                arena["k"], arena["v"],
+                jnp.asarray(slots_pad.reshape(-1)), k_new, v_new,
+            )
+        except Exception:
+            # same contract as every other donated-arena step: a runtime
+            # failure after donation leaves deleted buffers — rebuild so
+            # the server survives (sessions replay), then re-raise
+            if self._arena_consumed(arena):
+                self._rebuild_after_failure("sp prefill")
+            raise
+        self.manager.arena = {"k": new_k, "v": new_v}
+        out = out[:, :t]
+        if not fetch:
+            return out
+        return np.asarray(out).astype(self.transfer_dtype)
 
     def decode(
         self,

@@ -184,6 +184,9 @@ class BlockServer:
         adapter_dirs: list[str] | None = None,  # merged into base at load
         adapters: dict[str, str] | None = None,  # name -> dir, per-request
         tp: int = 1,
+        sp: int = 1,  # >1: long prefills spread over this many local
+        # chips via ring attention (parallel/sp_serving.py); decode stays
+        # single-chip paged
         kv_quant: str | None = None,  # "int4" -> quantized KV arena
         weight_quant: str | None = None,  # "int8"/"int4" -> quantized weights
         oversubscribe: float = 1.0,  # admit > capacity; park idle sessions
@@ -312,6 +315,12 @@ class BlockServer:
 
             mesh = make_serving_mesh(tp)
         self.tp = tp
+        sp_mesh = None
+        if sp > 1:
+            from bloombee_tpu.parallel.sp_serving import make_sp_mesh
+
+            sp_mesh = make_sp_mesh(sp)
+        self.sp = sp
         self.executor = SpanExecutor(
             params, spec, self.manager,
             max_chunk_tokens=max_chunk_tokens,
@@ -321,6 +330,7 @@ class BlockServer:
             adapters=self.adapter_factors,
             host_layers=host_layers,
             attn_sparsity=attn_sparsity,
+            sp_mesh=sp_mesh,
         )
         self.wire_dtype = name_for_dtype(self.executor.transfer_dtype)
         if spec.heterogeneous or host_layers:
@@ -466,6 +476,25 @@ class BlockServer:
                 logger.info("warmed buckets for batch %d", b)
             except Exception as e:
                 logger.warning("warmup(batch=%d) failed: %s", b, e)
+        if self.executor.sp_mesh is not None:
+            # pre-compile the sp-prefill program at its smallest bucket:
+            # the whole-span shard_map compile is exactly what would
+            # otherwise land on the first long prompt's latency path
+            try:
+                sp_tokens = int(env.get("BBTPU_SP_MIN_TOKENS"))
+                async with self.manager.allocate(
+                    1, sp_tokens + 1, timeout=5.0
+                ) as handle:
+                    hidden = np.zeros(
+                        (1, sp_tokens, self.spec.hidden_size), np.float32
+                    )
+                    await self.compute.submit(
+                        PRIORITY_TRAINING, self.executor.prefill,
+                        handle, hidden, True, None, False,
+                    )
+                logger.info("warmed sp prefill (%d tokens)", sp_tokens)
+            except Exception as e:
+                logger.warning("sp warmup failed: %s", e)
 
     async def _supervisor_loop(self) -> None:
         """Keep the server's background tasks alive and the span balanced.

@@ -57,6 +57,13 @@ def server_memory_report(server) -> dict:
         **server.manager.memory_stats(),
         "device": device_memory_stats(),
     }
+    sp_params = getattr(server.executor, "_sp_params", None)
+    if sp_params is not None:
+        # the sp-prefill mesh holds a REPLICATED second copy of the span
+        # params (one buffer per sp chip) — capacity planning must see it
+        report["sp_params_bytes"] = tree_nbytes(sp_params) * int(
+            server.executor.sp_mesh.devices.size
+        )
     if server.adapter_factors:
         report["adapter_bytes"] = tree_nbytes(server.adapter_factors)
     return report

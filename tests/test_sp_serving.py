@@ -1,0 +1,222 @@
+"""Sequence-parallel SERVING prefill: long prompts spread over an sp mesh
+(ring attention), K/V landing in the paged arena, decode continuing on the
+ordinary single-chip path. Closes the SURVEY §5 long-context-serving gap
+(the reference has no sequence parallelism at all)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from bloombee_tpu.kv.cache_manager import CacheManager
+from bloombee_tpu.models.llama.block import init_block_params
+from bloombee_tpu.models.spec import ModelSpec
+from bloombee_tpu.parallel.sp_serving import make_sp_mesh
+from bloombee_tpu.runtime.executor import SpanExecutor
+from bloombee_tpu.utils.tree import stack_params
+
+SPEC = ModelSpec(
+    family="llama", hidden_size=32, intermediate_size=64,
+    num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+    num_hidden_layers=3, vocab_size=64,
+)
+
+
+def _params():
+    return stack_params(
+        [init_block_params(jr.PRNGKey(i), SPEC) for i in range(3)]
+    )
+
+
+def _run(params, sp, t, monkeypatch, kv_quant=None, decode_steps=3, b=2):
+    """Prefill t tokens (+ per-row trailing decode steps); returns
+    (prefill_out, decode_outs)."""
+    monkeypatch.setenv("BBTPU_SP_MIN_TOKENS", "32")
+    monkeypatch.setenv("BBTPU_PAGED_ATTENTION", "0")
+    monkeypatch.setenv("BBTPU_FLASH_ATTENTION", "0")
+
+    async def go():
+        manager = CacheManager(
+            num_layers=3, num_pages=64, page_size=8,
+            n_kv_heads=2, head_dim=8, dtype=jnp.float32, quant=kv_quant,
+        )
+        ex = SpanExecutor(
+            params, SPEC, manager, compute_dtype=jnp.float32,
+            max_chunk_tokens=64,
+            sp_mesh=make_sp_mesh(sp) if sp > 1 else None,
+        )
+        rng = np.random.default_rng(0)
+        hidden = rng.standard_normal((b, t, 32)).astype(np.float32) * 0.1
+        steps = [
+            rng.standard_normal((b, 1, 32)).astype(np.float32) * 0.1
+            for _ in range(decode_steps)
+        ]
+        async with manager.allocate(b, t + decode_steps + 1) as handle:
+            pre = ex.prefill(handle, hidden)
+            assert list(manager.context_lens(handle)) == [t] * b
+            outs = [ex.decode(handle, s) for s in steps]
+        return pre, outs
+
+    return asyncio.run(go())
+
+
+@pytest.mark.parametrize("t", [64, 72], ids=["aligned", "needs_pad"])
+def test_sp_prefill_matches_single_chip(monkeypatch, t):
+    """sp=4 prefill output AND the arena it leaves behind must match the
+    single-chip path: decode steps after it are the proof the KV landed
+    correctly (t=72 exercises the pad-to-multiple-of-sp path)."""
+    params = _params()
+    ref_pre, ref_outs = _run(params, 1, t, monkeypatch)
+    sp_pre, sp_outs = _run(params, 4, t, monkeypatch)
+    np.testing.assert_allclose(
+        np.asarray(sp_pre, np.float32), np.asarray(ref_pre, np.float32),
+        atol=3e-5, rtol=3e-5,
+    )
+    for a, b_ in zip(sp_outs, ref_outs):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_, np.float32),
+            atol=3e-5, rtol=3e-5,
+        )
+
+
+def test_sp_rejects_quantized_arena():
+    """int4 arenas attend QUANTIZED KV during single-chip prefill (each
+    chunk reads back what it just wrote); ring attention attends full
+    precision — a numeric contract change. The combination must fail at
+    STARTUP (a silent fallback would still pin the replicated sp param
+    copies while never parallelizing anything)."""
+    params = _params()
+    manager = CacheManager(
+        num_layers=3, num_pages=64, page_size=8,
+        n_kv_heads=2, head_dim=8, dtype=jnp.float32, quant="int4",
+    )
+    with pytest.raises(ValueError, match="quantized KV arena"):
+        SpanExecutor(
+            params, SPEC, manager, compute_dtype=jnp.float32,
+            sp_mesh=make_sp_mesh(2),
+        )
+
+
+def test_sp_short_prefill_stays_single_chip(monkeypatch):
+    """Below BBTPU_SP_MIN_TOKENS the chunked single-chip path runs (the
+    collectives would dominate tiny prompts)."""
+    params = _params()
+    monkeypatch.setenv("BBTPU_SP_MIN_TOKENS", "4096")
+
+    async def go():
+        manager = CacheManager(
+            num_layers=3, num_pages=64, page_size=8,
+            n_kv_heads=2, head_dim=8, dtype=jnp.float32,
+        )
+        ex = SpanExecutor(
+            params, SPEC, manager, compute_dtype=jnp.float32,
+            sp_mesh=make_sp_mesh(2),
+        )
+        called = {"sp": False}
+        orig = ex._sp_prefill
+        ex._sp_prefill = lambda *a, **k: called.__setitem__("sp", True) or orig(*a, **k)
+        rng = np.random.default_rng(1)
+        async with manager.allocate(1, 64) as handle:
+            ex.prefill(
+                handle,
+                rng.standard_normal((1, 32, 32)).astype(np.float32),
+            )
+        assert not called["sp"]
+
+    asyncio.run(go())
+
+
+def test_sp_block_server_e2e(tmp_path):
+    """Full swarm path with an sp=2 server: a long-prompt greedy generate
+    must match HF (the prefill runs over the sp mesh, decode single-chip)."""
+    import os
+
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=2, vocab_size=128,
+        max_position_embeddings=512, tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    async def run():
+        os.environ["BBTPU_SP_MIN_TOKENS"] = "64"
+        try:
+            reg = RegistryServer(host="127.0.0.1")
+            await reg.start()
+
+            def rc():
+                return RegistryClient("127.0.0.1", reg.port)
+
+            server = BlockServer(
+                model_uid="t", start=0, end=2, model_dir=str(tmp_path),
+                registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+                page_size=4, sp=2,
+            )
+            await server.start()
+            dm = DistributedModelForCausalLM.from_pretrained(
+                str(tmp_path), rc(), model_uid="t"
+            )
+            rng = np.random.default_rng(9)
+            ids_in = rng.integers(0, config.vocab_size, size=(1, 100))
+            ids = await dm.generate(
+                ids_in, max_new_tokens=5, server_decode=False
+            )
+            with torch.no_grad():
+                ref = model.generate(
+                    torch.tensor(ids_in), max_new_tokens=5, do_sample=False,
+                    use_cache=True,
+                ).numpy()
+            np.testing.assert_array_equal(ids, ref)
+            await server.stop()
+            await reg.stop()
+        finally:
+            del os.environ["BBTPU_SP_MIN_TOKENS"]
+
+    asyncio.run(run())
+
+
+def test_sp_not_eligible_for_parked_session(monkeypatch):
+    """A host-parked session's table length reads 0 but its KV lives in
+    the park — sp prefill must NOT treat it as fresh (it would write from
+    position 0 and orphan the parked KV; confirmed-by-repro review
+    finding)."""
+    params = _params()
+    monkeypatch.setenv("BBTPU_SP_MIN_TOKENS", "8")
+
+    async def go():
+        manager = CacheManager(
+            num_layers=3, num_pages=64, page_size=8,
+            n_kv_heads=2, head_dim=8, dtype=jnp.float32,
+        )
+        ex = SpanExecutor(
+            params, SPEC, manager, compute_dtype=jnp.float32,
+            sp_mesh=make_sp_mesh(2),
+        )
+        rng = np.random.default_rng(0)
+        async with manager.allocate(1, 64) as handle:
+            assert ex._sp_eligible(handle, 16, True, None, None)
+            ex._step(
+                handle,
+                rng.standard_normal((1, 16, 32)).astype(np.float32),
+                commit=True,
+            )
+            assert not ex._sp_eligible(handle, 16, True, None, None)
+            manager.park_sequence(handle.seq_ids[0])
+            # table length now reads 0, KV is parked: still NOT fresh
+            assert not np.any(manager.context_lens(handle))
+            assert not ex._sp_eligible(handle, 16, True, None, None)
+
+    asyncio.run(go())
