@@ -276,11 +276,8 @@ class SpanExecutor:
             and commit
             and layers is None
             and adapter is None
-            # quantized arenas attend QUANTIZED KV during single-chip
-            # prefill (each chunk reads back what it just wrote); ring
-            # attention attends full precision — a numeric contract
-            # change, so int4 arenas keep the single-chip path
-            and self.manager.quant is None
+            # (quantized arenas are rejected at __init__ — sp_mesh and
+            # manager.quant can never coexist here)
             and t >= env.get("BBTPU_SP_MIN_TOKENS")
             # is_fresh, NOT a bare length check: a host-parked session's
             # table length reads 0 while its real KV sits in the park —

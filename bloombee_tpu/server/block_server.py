@@ -234,10 +234,50 @@ class BlockServer:
                 adapter_dirs=adapter_dirs,
             )
         elif offload_layers > 0:
-            raise ValueError(
-                "offload_layers needs model_dir loading (pre-built params "
-                "are already fully device-resident)"
+            # pre-built params + offload: split the stacked span, move the
+            # tail layers to host numpy (the executor streams them back per
+            # step with one-ahead prefetch) and free their device copies
+            import jax as _jax
+
+            assert spec is not None, "pre-built params need a spec"
+            n_span = end - start
+            if not 0 < offload_layers <= n_span:
+                raise ValueError(
+                    f"offload_layers={offload_layers} outside span of "
+                    f"{n_span} layers"
+                )
+            resident = n_span - offload_layers
+            host_layers = [
+                _jax.tree.map(lambda x, i=i: np.asarray(x[i]), params)
+                for i in range(resident, n_span)
+            ]
+            params = (
+                _jax.tree.map(lambda x: x[:resident], params)
+                if resident else None
             )
+            if weight_quant and weight_quant != "none":
+                # quantize BOTH halves here (the later quant block only
+                # sees the resident stack — dense host layers would
+                # silently keep the full streamed bytes, defeating the
+                # point of combining offload with --weight-quant)
+                from bloombee_tpu.models import wquant
+                from bloombee_tpu.utils.tree import stack_params
+
+                bits = {"int8": 8, "int4": 4}[weight_quant]
+                if params is not None:
+                    params = wquant.quantize_span_params(params, bits)
+                host_layers = [
+                    _jax.device_get(
+                        _jax.tree.map(
+                            lambda x: x[0],
+                            wquant.quantize_span_params(
+                                stack_params([h]), bits
+                            ),
+                        )
+                    )
+                    for h in host_layers
+                ]
+                weight_quant = "none"  # already applied
         assert spec is not None
         if weight_quant and weight_quant != "none":
             # weight-only quantization (reference compression.py's weight

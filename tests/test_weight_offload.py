@@ -219,3 +219,119 @@ def test_offload_e2e_matches_hf(tmp_path):
             do_sample=False,
         ).numpy()
     np.testing.assert_array_equal(out, ref)
+
+
+def test_offload_with_prebuilt_params(tmp_path):
+    """BlockServer accepts pre-built params + offload_layers (previously
+    an exclusion): the stacked span splits in-process, tail layers move to
+    host, and served tokens match a fully-resident server."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.models.checkpoint import load_span_params
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(6)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    async def run_swarm(offload):
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        params, spec = load_span_params(
+            str(tmp_path), 0, 3, dtype=jnp.float32
+        )
+        server = BlockServer(
+            model_uid="t", start=0, end=3, params=params, spec=spec,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=64, page_size=4,
+            offload_layers=offload,
+        )
+        await server.start()
+        if offload:
+            assert len(server.executor.host_layers) == offload
+        dm = DistributedModelForCausalLM.from_pretrained(
+            str(tmp_path), RegistryClient("127.0.0.1", reg.port),
+            model_uid="t",
+        )
+        ids_in = np.arange(5)[None, :]
+        ids = await dm.generate(ids_in, max_new_tokens=6,
+                                server_decode=False)
+        await server.stop()
+        await reg.stop()
+        return ids
+
+    async def run():
+        full = await run_swarm(0)
+        off = await run_swarm(2)
+        np.testing.assert_array_equal(full, off)
+
+    asyncio.run(run())
+
+
+def test_offload_prebuilt_quantized_host_layers(tmp_path):
+    """Pre-built params + offload + --weight-quant must quantize the HOST
+    layers too (dense streamed tails would defeat the combination's
+    point); tokens match a fully-resident int8 server (per-layer scales
+    are identical either way)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.models.checkpoint import load_span_params
+    from bloombee_tpu.models.wquant import QuantWeight
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(8)
+    LlamaForCausalLM(config).eval().to(torch.float32).save_pretrained(
+        tmp_path, safe_serialization=True
+    )
+
+    async def run_swarm(offload):
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        params, spec = load_span_params(
+            str(tmp_path), 0, 3, dtype=jnp.float32
+        )
+        server = BlockServer(
+            model_uid="t", start=0, end=3, params=params, spec=spec,
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=64, page_size=4,
+            offload_layers=offload, weight_quant="int8",
+        )
+        await server.start()
+        if offload:
+            assert any(
+                isinstance(leaf, QuantWeight)
+                for leaf in server.executor.host_layers[0].values()
+            ), "host layers were not quantized"
+        dm = DistributedModelForCausalLM.from_pretrained(
+            str(tmp_path), RegistryClient("127.0.0.1", reg.port),
+            model_uid="t",
+        )
+        ids_in = np.arange(5)[None, :]
+        ids = await dm.generate(ids_in, max_new_tokens=6,
+                                server_decode=False)
+        await server.stop()
+        await reg.stop()
+        return ids
+
+    async def run():
+        full = await run_swarm(0)
+        off = await run_swarm(2)
+        np.testing.assert_array_equal(full, off)
+
+    asyncio.run(run())
