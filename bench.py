@@ -119,6 +119,23 @@ def _emit_json_locked():
         out["server_decode_chain_chunk"] = chain.get("chunk", 0)
     if RESULTS.get("phases"):
         out["phases"] = RESULTS["phases"]
+    if RESULTS.get("cpu_fallback"):
+        # scrub EVERY rate/latency key, not just the headline: a consumer
+        # plotting any per-second number must not ingest CPU-smoke rates
+        # as measurements. The raw smoke values move to cpu_smoke_rates as
+        # the code-readiness record.
+        keep = {"server_decode_chunk", "server_decode_chain_chunk"}
+        smoke = {}
+        for key, val in list(out.items()):
+            if (
+                isinstance(val, (int, float))
+                and not isinstance(val, bool)
+                and key not in keep
+            ):
+                smoke[key] = val
+                out[key] = 0.0
+        out["cpu_smoke_rates"] = smoke
+        out["cpu_fallback"] = True
     if RESULTS.get("degraded"):
         out["degraded"] = RESULTS["degraded"]
     print(json.dumps(out), flush=True)
@@ -153,27 +170,51 @@ def _require_backend():
     forever with no way to interrupt it, and a wedged init would poison this
     process's global backend state even after the tunnel recovers. Only
     after a probe subprocess succeeds do we init the backend in-process.
-    Budget: half the watchdog deadline, leaving the other half for the
-    measurement phases."""
+
+    If the tunnel never comes up within the probe budget, fall back to a
+    CPU SMOKE run: the numbers are meaningless (flagged degraded +
+    cpu_fallback) but the phase ledger then records which phases are
+    CODE-READY — a bare rc=3 is indistinguishable from missing phases
+    (round-4 verdict #1)."""
     import subprocess
 
     deadline_s = float(os.environ.get("BBTPU_BENCH_DEADLINE_S", "1500"))
-    budget = max(120.0, deadline_s / 2)
+    # probe for up to half the deadline (an explicit long deadline means
+    # "ride out the outage" — honor it), but always leave ~700s so the
+    # CPU-smoke fallback can complete its phase ledger
+    budget = max(120.0, min(deadline_s / 2, deadline_s - 700.0))
     t_start = time.time()
     attempt = 0
     while True:
         attempt += 1
         left = budget - (time.time() - t_start)
         if left <= 0:
-            RESULTS.setdefault(
-                "degraded",
-                f"no usable jax backend within {budget:.0f}s "
-                f"({attempt - 1} probes); no phases ran",
+            if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+                # already an explicit CPU run that somehow failed probing
+                RESULTS.setdefault(
+                    "degraded",
+                    f"no usable jax backend within {budget:.0f}s; "
+                    "no phases ran",
+                )
+                emit_json()
+                os._exit(3)
+            log(
+                f"no TPU backend within {budget:.0f}s ({attempt - 1} "
+                "probes); falling back to CPU SMOKE for a code-readiness "
+                "phase ledger"
             )
-            log(f"FATAL: no usable jax backend within {budget:.0f}s — "
-                "emitting empty headline")
-            emit_json()
-            os._exit(3)
+            RESULTS["degraded"] = (
+                f"tpu tunnel unreachable for {budget:.0f}s; phases ran "
+                "as CPU smoke — values are NOT performance numbers, the "
+                "phase ledger records code readiness only"
+            )
+            RESULTS["cpu_fallback"] = True
+            os.environ["BBTPU_BENCH_SMOKE"] = "1"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            phase("backend", "cpu_fallback")
+            return
         # the image's sitecustomize force-registers the TPU platform and
         # ignores the JAX_PLATFORMS env var; honor an explicit cpu request
         # inside the probe the same way main() does
@@ -242,7 +283,8 @@ def main():
     if smoke:
         log("SMOKE MODE: tiny dims; numbers are meaningless")
 
-    phase("backend", "ok")
+    if RESULTS.get("phases", {}).get("backend") != "cpu_fallback":
+        phase("backend", "ok")
     log(f"devices: {jax.devices()}")
     phase("fused_proxy", "started")
     params = stack_params(

@@ -188,16 +188,6 @@ class SpanExecutor:
             raise ValueError(
                 "TP serving + heterogeneous head_dim not supported together"
             )
-        if spec.heterogeneous and adapters:
-            raise ValueError(
-                "per-request adapters + heterogeneous head_dim spans not "
-                "supported together"
-            )
-        if spec.heterogeneous and attn_sparsity < 1.0:
-            raise ValueError(
-                "attn_sparsity + heterogeneous head_dim spans not "
-                "supported together"
-            )
         if mesh is not None:
             from bloombee_tpu.parallel import serving as tp_serving
 
@@ -775,6 +765,7 @@ class SpanExecutor:
                 arena["v"],
                 jnp.asarray(payload),
                 jnp.asarray(tm_pad) if tm_pad is not None else None,
+                lora,
                 spec=spec,
                 b=bb,
                 t=tb,
@@ -783,6 +774,7 @@ class SpanExecutor:
                 use_tree_mask=tree_mask is not None,
                 start_block=self.start_block,
                 layer_active=tuple(int(x) for x in layer_active),
+                attn_topk=attn_topk,
             )
         else:
             payload = pack_step_payload(h_pad, plan)

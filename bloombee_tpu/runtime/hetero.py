@@ -56,6 +56,9 @@ def span_step_hetero_impl(
     arena_v: tuple,
     payload: jax.Array,  # pack_step_payload buffer
     tree_mask: jax.Array | None = None,
+    lora: dict | None = None,  # STACKED [L, ...] LoRA factors (the same
+    # pytree the scanned path consumes); sliced per layer at TRACE time —
+    # eager per-step slicing would add host dispatch to the decode path
     *,
     spec: ModelSpec,
     b: int,
@@ -65,6 +68,8 @@ def span_step_hetero_impl(
     use_tree_mask: bool = False,
     start_block: int = 0,
     layer_active: tuple | None = None,  # static 0/1 per layer (sub-spans)
+    attn_topk: int = 0,  # sparse decode attention (FlexGen
+    # Policy.attn_sparsity), same semantics as the scanned path
 ):
     """Unrolled heterogeneous span step; returns (hidden, arena_k, arena_v).
 
@@ -102,6 +107,11 @@ def span_step_hetero_impl(
             new_k[i][0], new_v[i][0], cos, sin, slots, page_table,
             q_positions, total_lens, tm,
             jnp.int32(spec.window_for_layer(abs_idx)),
+            lora=(
+                jax.tree.map(lambda x, i=i: x[i], lora)
+                if lora is not None else None
+            ),
+            attn_topk=attn_topk,
         )
         new_k[i] = k_l[None]
         new_v[i] = v_l[None]
@@ -112,7 +122,7 @@ span_step_hetero = functools.partial(
     jax.jit,
     static_argnames=(
         "spec", "b", "t", "page_size", "max_pages", "use_tree_mask",
-        "start_block", "layer_active",
+        "start_block", "layer_active", "attn_topk",
     ),
     donate_argnames=("arena_k", "arena_v"),
 )(span_step_hetero_impl)
