@@ -190,15 +190,11 @@ class CacheManager:
 
         self.table = make_table(num_pages, page_size)
         if hetero_spec is not None and hetero_spec.heterogeneous:
-            if self.quant:
-                raise ValueError(
-                    "int4 KV + heterogeneous head_dim not supported together"
-                )
             from bloombee_tpu.runtime.hetero import make_hetero_arena
 
             self._make_arena = lambda: make_hetero_arena(
                 hetero_spec, num_layers, start_block, num_pages, page_size,
-                dtype,
+                dtype, quant=self.quant,
             )
         else:
             self._make_arena = lambda: arena_ops.make_arena(

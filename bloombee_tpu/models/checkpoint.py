@@ -161,10 +161,7 @@ def load_span_params_split(
         for adapter in adapters:
             params = adapter.merge_into(params, i)
         if bits:
-            # per-layer dict leaves are [in, out]; quantize via a 1-stack so
-            # the eligibility check (stacked ndim>=3) applies unchanged
-            one = wquant.quantize_span_params(stack_params([params]), bits)
-            params = jax.tree.map(lambda x: x[0], one)
+            params = wquant.quantize_layer_params(params, bits)
         if i - start < resident:
             prefix.append(params)
         else:

@@ -120,6 +120,19 @@ def quantize_span_params(stacked: dict, bits: int) -> dict:
     return out
 
 
+def quantize_layer_params(params: dict, bits: int) -> dict:
+    """Per-layer (unstacked) variant of quantize_span_params: quantize via
+    a transient 1-stack so the stacked-ndim eligibility gate applies
+    unchanged — the shared idiom for hetero spans, offloaded host tails,
+    and per-layer checkpoint loading."""
+    import jax
+
+    from bloombee_tpu.utils.tree import stack_params
+
+    one = quantize_span_params(stack_params([params]), bits)
+    return jax.tree.map(lambda x: x[0], one)
+
+
 def params_nbytes(stacked: dict) -> int:
     from bloombee_tpu.utils.memory import tree_nbytes
 

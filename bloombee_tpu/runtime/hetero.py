@@ -35,6 +35,8 @@ def make_hetero_arena(
     num_pages: int,
     page_size: int,
     dtype=jnp.bfloat16,
+    quant: str | None = None,  # "int4": per-layer QuantSlabs (each layer's
+    # head_dim groups independently, so 16- and 32-wide heads coexist)
 ) -> dict:
     """Per-layer slabs [1, S_tot, Hkv_l, hd_l] as tuples (a jax pytree);
     layer geometry indexed by ABSOLUTE block id (span offset matters)."""
@@ -45,8 +47,18 @@ def make_hetero_arena(
         shape = (
             1, s_tot, spec.kv_heads_for_layer(a), spec.head_dim_for_layer(a)
         )
-        ks.append(jnp.zeros(shape, dtype))
-        vs.append(jnp.zeros(shape, dtype))
+        if quant == "int4":
+            from bloombee_tpu.kv.quant import make_quant_slab
+
+            ks.append(make_quant_slab(shape))
+            vs.append(make_quant_slab(shape))
+        elif quant in (None, "none"):
+            ks.append(jnp.zeros(shape, dtype))
+            vs.append(jnp.zeros(shape, dtype))
+        else:
+            # same loud contract as the homogeneous make_arena: a typo'd
+            # mode must not silently serve a full-precision arena
+            raise ValueError(f"unknown KV quant mode {quant!r}")
     return {"k": tuple(ks), "v": tuple(vs)}
 
 
@@ -102,9 +114,13 @@ def span_step_hetero_impl(
                 cos.astype(hidden.dtype), sin.astype(hidden.dtype)
             )
         cos, sin = cos_sin[key]
+        # tree-aware leading-dim squeeze/expand: a quantized slab is a
+        # QuantSlab NamedTuple, where plain [0] would be TUPLE indexing
+        # (returning the codes leaf), not a slice
+        sq = jax.tree.map(lambda x: x[0], (new_k[i], new_v[i]))
         hidden, k_l, v_l = layer_body(
             spec_l, page_size, hidden, layer_params[i],
-            new_k[i][0], new_v[i][0], cos, sin, slots, page_table,
+            sq[0], sq[1], cos, sin, slots, page_table,
             q_positions, total_lens, tm,
             jnp.int32(spec.window_for_layer(abs_idx)),
             lora=(
@@ -113,8 +129,8 @@ def span_step_hetero_impl(
             ),
             attn_topk=attn_topk,
         )
-        new_k[i] = k_l[None]
-        new_v[i] = v_l[None]
+        new_k[i] = jax.tree.map(lambda x: x[None], k_l)
+        new_v[i] = jax.tree.map(lambda x: x[None], v_l)
     return hidden, tuple(new_k), tuple(new_v)
 
 

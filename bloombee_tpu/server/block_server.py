@@ -261,20 +261,12 @@ class BlockServer:
                 # silently keep the full streamed bytes, defeating the
                 # point of combining offload with --weight-quant)
                 from bloombee_tpu.models import wquant
-                from bloombee_tpu.utils.tree import stack_params
 
                 bits = {"int8": 8, "int4": 4}[weight_quant]
                 if params is not None:
                     params = wquant.quantize_span_params(params, bits)
                 host_layers = [
-                    _jax.device_get(
-                        _jax.tree.map(
-                            lambda x: x[0],
-                            wquant.quantize_span_params(
-                                stack_params([h]), bits
-                            ),
-                        )
-                    )
+                    _jax.device_get(wquant.quantize_layer_params(h, bits))
                     for h in host_layers
                 ]
                 weight_quant = "none"  # already applied
@@ -283,21 +275,20 @@ class BlockServer:
             # weight-only quantization (reference compression.py's weight
             # half): decode reads every projection once per token, so int8
             # (int4) storage halves (quarters) HBM bytes per step. Composes
-            # with TP: quantized leaves shard like their dense weights
-            # (parallel/serving.py place_span_params)
-            if spec.heterogeneous:
-                # hetero spans carry per-layer param dicts (a tuple), and
-                # their unrolled step has no quant handling yet
-                raise ValueError(
-                    "weight quantization + heterogeneous head_dim spans "
-                    "not supported together"
-                )
+            # with TP (parallel/serving.py place_span_params shards the
+            # quantized leaves) and with heterogeneous spans (per-layer
+            # dicts quantize via a 1-stack each — attention geometry may
+            # vary per layer but each layer quantizes independently anyway)
             from bloombee_tpu.models import wquant
 
+            bits = {"int8": 8, "int4": 4}[weight_quant]
             before = wquant.params_nbytes(params)
-            params = wquant.quantize_span_params(
-                params, {"int8": 8, "int4": 4}[weight_quant]
-            )
+            if spec.heterogeneous:
+                params = tuple(
+                    wquant.quantize_layer_params(p, bits) for p in params
+                )
+            else:
+                params = wquant.quantize_span_params(params, bits)
             logger.info(
                 "quantized span weights to %s: %.1f -> %.1f MiB",
                 weight_quant, before / 2**20,

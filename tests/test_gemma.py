@@ -271,3 +271,89 @@ def test_gemma4_hetero_sparsity_and_adapters(gemma4_dir):
     assert not np.allclose(sparse_out, dense_out), (
         "top-k sparsity had no effect"
     )
+
+
+def test_gemma4_hetero_int4_kv(gemma4_dir):
+    """int4 KV x heterogeneous spans (previously excluded): per-layer
+    QuantSlabs quantize each geometry's head_dim independently. Stepwise
+    decode must equal the full forward under the SAME quantized arena
+    (per-row group quantization is order-independent), stay close to the
+    dense arena, and survive a park/unpark round trip."""
+    from bloombee_tpu.kv.cache_manager import CacheManager
+    from bloombee_tpu.kv.quant import QuantSlab
+    from bloombee_tpu.models.checkpoint import load_span_params
+    from bloombee_tpu.runtime.executor import SpanExecutor
+
+    params, spec = load_span_params(gemma4_dir, 0, 4, dtype=jnp.float32)
+    rng = np.random.default_rng(2)
+    hidden = rng.standard_normal((2, 10, spec.hidden_size)).astype(np.float32)
+
+    def run(split, quant):
+        async def go():
+            manager = CacheManager(
+                num_layers=4, num_pages=32, page_size=4,
+                n_kv_heads=spec.num_key_value_heads, head_dim=spec.head_dim,
+                dtype=jnp.float32, hetero_spec=spec, quant=quant,
+            )
+            if quant:
+                assert isinstance(manager.arena["k"][0], QuantSlab)
+            ex = SpanExecutor(params, spec, manager, compute_dtype=jnp.float32)
+            outs = []
+            async with manager.allocate(2, 16) as handle:
+                if split == 0:
+                    outs.append(ex.prefill(handle, hidden))
+                else:
+                    outs.append(ex.prefill(handle, hidden[:, :split]))
+                    if quant:  # park/unpark round trip mid-generation
+                        manager.park_sequence(handle.seq_ids[0])
+                    for i in range(split, hidden.shape[1]):
+                        outs.append(ex.decode(handle, hidden[:, i:i + 1]))
+            return np.concatenate(outs, axis=1)
+
+        return asyncio.run(go())
+
+    full_q = run(0, "int4")
+    stepped_q = run(6, "int4")
+    np.testing.assert_allclose(stepped_q, full_q, atol=1e-4, rtol=1e-4)
+    dense = run(0, None)
+    # quantization error is bounded (relative Frobenius), not zero
+    assert not np.allclose(full_q, dense, atol=1e-6)
+    rel = np.linalg.norm(full_q - dense) / np.linalg.norm(dense)
+    assert rel < 0.2, rel
+
+
+def test_gemma4_e2e_quantized_weights_and_kv(gemma4_dir):
+    """Hetero span with BOTH int8 weights and an int4 KV arena (both
+    previously excluded): serves deterministic finite generations, with
+    the per-layer weight dicts actually quantized."""
+    from bloombee_tpu.models.wquant import QuantWeight
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s = BlockServer(
+            model_uid="g4q", start=0, end=4, model_dir=gemma4_dir,
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, weight_quant="int8", kv_quant="int4",
+        )
+        await s.start()
+        assert any(
+            isinstance(leaf, QuantWeight)
+            for leaf in s.executor.params[0].values()
+        ), "per-layer weights were not quantized"
+        model = DistributedModelForCausalLM.from_pretrained(
+            gemma4_dir, rc(), model_uid="g4q"
+        )
+        input_ids = np.arange(6)[None, :] % model.spec.vocab_size
+        a = await model.generate(input_ids, max_new_tokens=6)
+        b = await model.generate(input_ids, max_new_tokens=6)
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (1, 12) and np.all(a < model.spec.vocab_size)
+        await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
