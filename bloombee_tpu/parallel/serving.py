@@ -76,12 +76,20 @@ def make_serving_mesh(tp: int, devices=None) -> Mesh:
     return Mesh(np.asarray(devices[:tp]), ("tp",))
 
 
-def check_tp_divides(spec: ModelSpec, tp: int) -> None:
-    if spec.num_attention_heads % tp or spec.num_key_value_heads % tp:
+def check_tp_divides(spec: ModelSpec, tp: int, hetero: bool = False) -> None:
+    """hetero=True skips the kv-head check only: per-layer KV geometry is
+    handled by the per-layer placement (layers whose kv heads don't divide
+    replicate their K/V); q heads and experts are uniform either way."""
+    if spec.num_attention_heads % tp:
         raise ValueError(
             f"tp={tp} must divide num_attention_heads="
-            f"{spec.num_attention_heads} and num_key_value_heads="
-            f"{spec.num_key_value_heads}"
+            f"{spec.num_attention_heads}"
+        )
+    if not hetero and spec.num_key_value_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_key_value_heads="
+            f"{spec.num_key_value_heads} (KV-head replication only exists "
+            "on the heterogeneous path)"
         )
     if spec.num_experts and spec.num_experts % tp:
         raise ValueError(
@@ -148,3 +156,77 @@ def place_arena(arena: dict, mesh: Mesh) -> dict:
 def replicated(x, mesh: Mesh):
     """Commit a host array replicated over the mesh (step payloads/masks)."""
     return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def _layer_spec(base, shape, tp, kv_replicate: bool):
+    """Per-layer (no leading L dim) spec from the stacked base: delegate
+    to the shared drop-tp-where-indivisible rule; `kv_replicate` forces
+    replication regardless of the flattened dim (a single KV head whose
+    head_dim happens to divide tp must NOT be split WITHIN the head — the
+    arena keys the same decision on the layer's KV-head count)."""
+    if kv_replicate:
+        return P(*(None for _ in base[1:]))
+    return _quant_leaf_spec(base[1:], shape, tp)
+
+
+def place_hetero_span_params(
+    layer_params: tuple, mesh: Mesh, spec: ModelSpec, start_block: int = 0
+) -> tuple:
+    """Commit per-layer param dicts (heterogeneous spans) to the tp mesh:
+    each layer shards like its stacked counterpart where its dims divide.
+    K/V projections follow the LAYER'S KV-HEAD count (the same rule the
+    arena placement uses): layers whose kv heads don't divide tp
+    replicate their k/v leaves, so K/V writes stay collective-free."""
+    from bloombee_tpu.models.wquant import QuantWeight
+
+    tp = mesh.devices.size
+    placed = []
+    for i, params in enumerate(layer_params):
+        kv_heads = spec.kv_heads_for_layer(start_block + i)
+        out = {}
+        for key, leaf in params.items():
+            base = SERVING_PARAM_SPECS[key]
+            kv_rep = key.startswith(("k_", "v_")) and kv_heads % tp != 0
+
+            def put(x, base=base, kv_rep=kv_rep):
+                if x is None:
+                    return None
+                return jax.device_put(
+                    x,
+                    NamedSharding(
+                        mesh, _layer_spec(base, x.shape, tp, kv_rep)
+                    ),
+                )
+
+            if isinstance(leaf, QuantWeight):
+                out[key] = QuantWeight(
+                    codes=put(leaf.codes), scale=put(leaf.scale),
+                    zero=put(leaf.zero),
+                )
+            else:
+                out[key] = put(leaf)
+        placed.append(out)
+    return tuple(placed)
+
+
+def place_hetero_arena(arena: dict, mesh: Mesh) -> dict:
+    """Commit per-layer KV slabs to the tp mesh: a layer's KV heads shard
+    when they divide tp, else that layer's slab replicates (the scatter of
+    sharded K/V into a replicated slab is an all-gather GSPMD inserts)."""
+    tp = mesh.devices.size
+
+    def put(slab):
+        def leaf_put(x):
+            # slab leaves are [1, S_tot, Hkv_l, ...]; shard the head dim
+            spec = (
+                P(None, None, "tp", None)
+                if x.shape[2] % tp == 0 else P()
+            )
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        return jax.tree.map(leaf_put, slab)
+
+    return {
+        "k": tuple(put(s) for s in arena["k"]),
+        "v": tuple(put(s) for s in arena["v"]),
+    }

@@ -184,16 +184,27 @@ class SpanExecutor:
                     f"resident params stack has {lead} layers, expected "
                     f"{self.resident}"
                 )
-        if spec.heterogeneous and mesh is not None:
-            raise ValueError(
-                "TP serving + heterogeneous head_dim not supported together"
-            )
         if mesh is not None:
             from bloombee_tpu.parallel import serving as tp_serving
 
-            tp_serving.check_tp_divides(spec, mesh.devices.size)
-            stacked_params = tp_serving.place_span_params(stacked_params, mesh)
-            manager.arena = tp_serving.place_arena(manager.arena, mesh)
+            if spec.heterogeneous:
+                # per-layer geometry: q heads/experts must divide; layers
+                # whose KV heads don't divide replicate their K/V
+                tp_serving.check_tp_divides(
+                    spec, mesh.devices.size, hetero=True
+                )
+                stacked_params = tp_serving.place_hetero_span_params(
+                    stacked_params, mesh, spec, start_block
+                )
+                manager.arena = tp_serving.place_hetero_arena(
+                    manager.arena, mesh
+                )
+            else:
+                tp_serving.check_tp_divides(spec, mesh.devices.size)
+                stacked_params = tp_serving.place_span_params(
+                    stacked_params, mesh
+                )
+                manager.arena = tp_serving.place_arena(manager.arena, mesh)
             if adapters:
                 # low-rank factors are small: replicate over the mesh and let
                 # GSPMD partition the delta einsums as it sees fit
@@ -487,6 +498,23 @@ class SpanExecutor:
         self.manager.arena = {"k": new_k, "v": new_v}
         return toks[:b, :n]
 
+    def _place_step_inputs(self, h_pad, plan, tm_pad):
+        """Pack and commit one step's (payload, tree mask) to the device —
+        replicated over the tp mesh when serving sharded."""
+        payload = pack_step_payload(h_pad, plan)
+        if self.mesh is not None:
+            from bloombee_tpu.parallel import serving as tp_serving
+
+            return (
+                tp_serving.replicated(payload, self.mesh),
+                tp_serving.replicated(tm_pad, self.mesh)
+                if tm_pad is not None else None,
+            )
+        return (
+            jnp.asarray(payload),
+            jnp.asarray(tm_pad) if tm_pad is not None else None,
+        )
+
     @staticmethod
     def _arena_consumed(arena) -> bool:
         return any(
@@ -507,6 +535,20 @@ class SpanExecutor:
             "must replay", where,
         )
         self.manager.rebuild_arena()
+        if self.mesh is not None:
+            # the fresh slabs land on the default device; a TP server must
+            # re-place them or every later step runs with an unsharded
+            # arena against sharded params (x tp HBM + a recompile)
+            from bloombee_tpu.parallel import serving as tp_serving
+
+            if self.spec.heterogeneous:
+                self.manager.arena = tp_serving.place_hetero_arena(
+                    self.manager.arena, self.mesh
+                )
+            else:
+                self.manager.arena = tp_serving.place_arena(
+                    self.manager.arena, self.mesh
+                )
 
     def _run_offloaded(
         self, h_pad, slots_pad, pt_pad, positions, lens_pad, layer_active,
@@ -758,40 +800,35 @@ class SpanExecutor:
         elif self.spec.heterogeneous:
             from bloombee_tpu.runtime.hetero import span_step_hetero
 
-            payload = pack_step_payload(h_pad, plan)
-            out, new_k, new_v = span_step_hetero(
-                self.params,
-                arena["k"],
-                arena["v"],
-                jnp.asarray(payload),
-                jnp.asarray(tm_pad) if tm_pad is not None else None,
-                lora,
-                spec=spec,
-                b=bb,
-                t=tb,
-                page_size=self.page_size,
-                max_pages=pb,
-                use_tree_mask=tree_mask is not None,
-                start_block=self.start_block,
-                layer_active=tuple(int(x) for x in layer_active),
-                attn_topk=attn_topk,
-            )
+            payload_dev, tm_dev = self._place_step_inputs(h_pad, plan, tm_pad)
+            try:
+                out, new_k, new_v = span_step_hetero(
+                    self.params,
+                    arena["k"],
+                    arena["v"],
+                    payload_dev,
+                    tm_dev,
+                    lora,
+                    spec=spec,
+                    b=bb,
+                    t=tb,
+                    page_size=self.page_size,
+                    max_pages=pb,
+                    use_tree_mask=tree_mask is not None,
+                    start_block=self.start_block,
+                    layer_active=tuple(int(x) for x in layer_active),
+                    attn_topk=attn_topk,
+                )
+            except Exception:
+                # same donated-arena contract as the dense branch: a
+                # runtime failure after donation must rebuild so the
+                # server survives (sessions replay), then re-raise
+                if self._arena_consumed(arena):
+                    self._rebuild_after_failure("hetero span step")
+                raise
         else:
-            payload = pack_step_payload(h_pad, plan)
-            if self.mesh is not None:
-                from bloombee_tpu.parallel import serving as tp_serving
+            payload_dev, tm_dev = self._place_step_inputs(h_pad, plan, tm_pad)
 
-                payload_dev = tp_serving.replicated(payload, self.mesh)
-                tm_dev = (
-                    tp_serving.replicated(tm_pad, self.mesh)
-                    if tm_pad is not None
-                    else None
-                )
-            else:
-                payload_dev = jnp.asarray(payload)
-                tm_dev = (
-                    jnp.asarray(tm_pad) if tm_pad is not None else None
-                )
             def _run(use_paged_now: bool):
                 return span_step_packed(
                     self.params,

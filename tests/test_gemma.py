@@ -357,3 +357,87 @@ def test_gemma4_e2e_quantized_weights_and_kv(gemma4_dir):
         await reg.stop()
 
     asyncio.run(run())
+
+
+def test_gemma4_tp2_matches_tp1(gemma4_dir):
+    """Heterogeneous span under TP serving (previously excluded): layers
+    whose dims divide tp shard (q/o/MLP everywhere, KV on sliding layers
+    with 2 kv heads); the full layers' single KV head replicates. tp=2
+    output must match tp=1 through the real executor."""
+    from bloombee_tpu.kv.cache_manager import CacheManager
+    from bloombee_tpu.models.checkpoint import load_span_params
+    from bloombee_tpu.parallel.serving import make_serving_mesh
+    from bloombee_tpu.runtime.executor import SpanExecutor
+
+    params, spec = load_span_params(gemma4_dir, 0, 4, dtype=jnp.float32)
+    rng = np.random.default_rng(3)
+    prefill = rng.standard_normal((2, 6, spec.hidden_size)).astype(
+        np.float32
+    )
+    steps = [
+        rng.standard_normal((2, 1, spec.hidden_size)).astype(np.float32)
+        for _ in range(3)
+    ]
+
+    def run(mesh):
+        async def go():
+            manager = CacheManager(
+                num_layers=4, num_pages=32, page_size=4,
+                n_kv_heads=spec.num_key_value_heads, head_dim=spec.head_dim,
+                dtype=jnp.float32, hetero_spec=spec,
+            )
+            ex = SpanExecutor(
+                params, spec, manager, compute_dtype=jnp.float32, mesh=mesh
+            )
+            outs = []
+            async with manager.allocate(2, 16) as handle:
+                outs.append(np.asarray(ex.prefill(handle, prefill)))
+                for s in steps:
+                    outs.append(np.asarray(ex.decode(handle, s)))
+            return outs
+
+        return asyncio.run(go())
+
+    ref = run(None)
+    tp2 = run(make_serving_mesh(2))
+    for a, b in zip(tp2, ref):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=1e-5, rtol=1e-5,
+        )
+
+
+def test_gemma4_tp2_block_server_e2e(gemma4_dir):
+    """Full swarm path with a tp=2 heterogeneous server: greedy generation
+    must match the tp=1 server token-for-token."""
+
+    async def run_swarm(tp):
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s = BlockServer(
+            model_uid="g4tp", start=0, end=4, model_dir=gemma4_dir,
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, tp=tp,
+        )
+        await s.start()
+        model = DistributedModelForCausalLM.from_pretrained(
+            gemma4_dir, rc(), model_uid="g4tp"
+        )
+        input_ids = np.arange(6)[None, :] % model.spec.vocab_size
+        ids = await model.generate(
+            input_ids, max_new_tokens=6, server_decode=False
+        )
+        await s.stop()
+        await reg.stop()
+        return ids
+
+    async def run():
+        tp1 = await run_swarm(1)
+        tp2 = await run_swarm(2)
+        np.testing.assert_array_equal(tp1, tp2)
+
+    asyncio.run(run())
