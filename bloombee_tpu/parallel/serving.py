@@ -20,8 +20,11 @@ Sharding layout (serving mesh has one axis, "tp"):
 - Mixtral experts: the expert dim shards over tp = true expert parallelism
   (the reference runs all experts densely on every device).
 
-Requires num_attention_heads % tp == 0 and num_key_value_heads % tp == 0
-(KV-head replication for tp > Hkv is not implemented).
+Requires num_attention_heads % tp == 0; homogeneous spans also require
+num_key_value_heads % tp == 0, while HETEROGENEOUS spans replicate the K/V
+of layers whose own KV-head count does not divide tp (gemma-4 full layers
+with a single KV head) and shard everything else — see
+place_hetero_span_params / place_hetero_arena.
 """
 
 from __future__ import annotations
@@ -169,6 +172,37 @@ def _layer_spec(base, shape, tp, kv_replicate: bool):
     return _quant_leaf_spec(base[1:], shape, tp)
 
 
+def _place_one_layer(params: dict, mesh: Mesh, kv_replicate: bool) -> dict:
+    """Commit ONE layer's (unstacked) param dict to the tp mesh — the
+    shared leaf-placement body of the hetero and weight-offload paths.
+    `kv_replicate` forces the k/v leaves replicated (a layer whose KV-head
+    count doesn't divide tp)."""
+    from bloombee_tpu.models.wquant import QuantWeight
+
+    tp = mesh.devices.size
+    out = {}
+    for key, leaf in params.items():
+        base = SERVING_PARAM_SPECS[key]
+        kv_rep = kv_replicate and key.startswith(("k_", "v_"))
+
+        def put(x, base=base, kv_rep=kv_rep):
+            if x is None:
+                return None
+            return jax.device_put(
+                x,
+                NamedSharding(mesh, _layer_spec(base, x.shape, tp, kv_rep)),
+            )
+
+        if isinstance(leaf, QuantWeight):
+            out[key] = QuantWeight(
+                codes=put(leaf.codes), scale=put(leaf.scale),
+                zero=put(leaf.zero),
+            )
+        else:
+            out[key] = put(leaf)
+    return out
+
+
 def place_hetero_span_params(
     layer_params: tuple, mesh: Mesh, spec: ModelSpec, start_block: int = 0
 ) -> tuple:
@@ -177,36 +211,30 @@ def place_hetero_span_params(
     K/V projections follow the LAYER'S KV-HEAD count (the same rule the
     arena placement uses): layers whose kv heads don't divide tp
     replicate their k/v leaves, so K/V writes stay collective-free."""
-    from bloombee_tpu.models.wquant import QuantWeight
-
     tp = mesh.devices.size
-    placed = []
-    for i, params in enumerate(layer_params):
-        kv_heads = spec.kv_heads_for_layer(start_block + i)
-        out = {}
-        for key, leaf in params.items():
-            base = SERVING_PARAM_SPECS[key]
-            kv_rep = key.startswith(("k_", "v_")) and kv_heads % tp != 0
+    return tuple(
+        _place_one_layer(
+            params, mesh,
+            kv_replicate=spec.kv_heads_for_layer(start_block + i) % tp != 0,
+        )
+        for i, params in enumerate(layer_params)
+    )
 
-            def put(x, base=base, kv_rep=kv_rep):
-                if x is None:
-                    return None
-                return jax.device_put(
-                    x,
-                    NamedSharding(
-                        mesh, _layer_spec(base, x.shape, tp, kv_rep)
-                    ),
-                )
 
-            if isinstance(leaf, QuantWeight):
-                out[key] = QuantWeight(
-                    codes=put(leaf.codes), scale=put(leaf.scale),
-                    zero=put(leaf.zero),
-                )
-            else:
-                out[key] = put(leaf)
-        placed.append(out)
-    return tuple(placed)
+def place_layer_params(params: dict, mesh: Mesh) -> dict:
+    """Per-step placement of a weight-offloaded host layer: the same
+    row/col sharding as its stacked counterpart, so the streamed H2D
+    bytes split across the tp chips instead of replicating."""
+    return _place_one_layer(params, mesh, kv_replicate=False)
+
+
+def place_arena_for(spec: ModelSpec, arena: dict, mesh: Mesh) -> dict:
+    """Arena placement dispatch shared by executor init and the
+    post-failure rebuild (one site decides hetero vs dense, so a rebuilt
+    arena can never be placed with the wrong helper)."""
+    if spec.heterogeneous:
+        return place_hetero_arena(arena, mesh)
+    return place_arena(arena, mesh)
 
 
 def place_hetero_arena(arena: dict, mesh: Mesh) -> dict:

@@ -162,10 +162,6 @@ class SpanExecutor:
                     "weight offload + heterogeneous head_dim spans not "
                     "supported together"
                 )
-            if mesh is not None:
-                raise ValueError(
-                    "weight offload + TP serving not supported together"
-                )
             if manager.quant is not None:
                 raise ValueError(
                     "weight offload + quantized KV arena not supported "
@@ -196,15 +192,15 @@ class SpanExecutor:
                 stacked_params = tp_serving.place_hetero_span_params(
                     stacked_params, mesh, spec, start_block
                 )
-                manager.arena = tp_serving.place_hetero_arena(
-                    manager.arena, mesh
-                )
             else:
                 tp_serving.check_tp_divides(spec, mesh.devices.size)
-                stacked_params = tp_serving.place_span_params(
-                    stacked_params, mesh
-                )
-                manager.arena = tp_serving.place_arena(manager.arena, mesh)
+                if stacked_params is not None:  # fully-offloaded: no prefix
+                    stacked_params = tp_serving.place_span_params(
+                        stacked_params, mesh
+                    )
+            manager.arena = tp_serving.place_arena_for(
+                spec, manager.arena, mesh
+            )
             if adapters:
                 # low-rank factors are small: replicate over the mesh and let
                 # GSPMD partition the delta einsums as it sees fit
@@ -541,14 +537,9 @@ class SpanExecutor:
             # arena against sharded params (x tp HBM + a recompile)
             from bloombee_tpu.parallel import serving as tp_serving
 
-            if self.spec.heterogeneous:
-                self.manager.arena = tp_serving.place_hetero_arena(
-                    self.manager.arena, self.mesh
-                )
-            else:
-                self.manager.arena = tp_serving.place_arena(
-                    self.manager.arena, self.mesh
-                )
+            self.manager.arena = tp_serving.place_arena_for(
+                self.spec, self.manager.arena, self.mesh
+            )
 
     def _run_offloaded(
         self, h_pad, slots_pad, pt_pad, positions, lens_pad, layer_active,
@@ -566,7 +557,22 @@ class SpanExecutor:
 
         ak, av = self.manager.arena["k"], self.manager.arena["v"]
         resident = self.resident
-        tm_dev = jnp.asarray(tm_pad) if tm_pad is not None else None
+        # under TP, every per-step input commits replicated to the mesh
+        # and each streamed host layer places SHARDED (its H2D bytes split
+        # across the tp chips); single-chip keeps plain transfers
+        if self.mesh is not None:
+            from bloombee_tpu.parallel import serving as tp_serving
+
+            place_rep = functools.partial(
+                tp_serving.replicated, mesh=self.mesh
+            )
+            place_layer = functools.partial(
+                tp_serving.place_layer_params, mesh=self.mesh
+            )
+        else:
+            place_rep = jnp.asarray
+            place_layer = jax.device_put
+        tm_dev = place_rep(tm_pad) if tm_pad is not None else None
         use_tm = tm_pad is not None
 
         la_res = layer_active[:resident].copy()
@@ -580,7 +586,7 @@ class SpanExecutor:
             )
             hidden, ak, av = span_step_packed(
                 self.params, ak, av,
-                jnp.asarray(pack_step_payload(h_pad, plan_res)), tm_dev,
+                place_rep(pack_step_payload(h_pad, plan_res)), tm_dev,
                 lora_res,
                 spec=self.spec, b=bb, t=tb, page_size=self.page_size,
                 max_pages=pb, use_tree_mask=use_tm,
@@ -589,7 +595,7 @@ class SpanExecutor:
                 t_real=t_real,
             )
         else:
-            hidden = jnp.asarray(h_pad)
+            hidden = place_rep(h_pad)
 
         idxs = [
             l for l in range(resident, self.manager.num_layers)
@@ -597,16 +603,16 @@ class SpanExecutor:
         ]
         if not idxs:
             return hidden, ak, av
-        plan1 = jnp.asarray(
+        plan1 = place_rep(
             pack_plan(
                 slots_pad, pt_pad, positions, lens_pad,
                 np.ones((1,), np.int32),
             )
         )
-        nxt = jax.device_put(self.host_layers[idxs[0] - resident])
+        nxt = place_layer(self.host_layers[idxs[0] - resident])
         for i, l in enumerate(idxs):
             cur, nxt = nxt, (
-                jax.device_put(self.host_layers[idxs[i + 1] - resident])
+                place_layer(self.host_layers[idxs[i + 1] - resident])
                 if i + 1 < len(idxs) else None
             )
             lora_l = (

@@ -335,3 +335,81 @@ def test_offload_prebuilt_quantized_host_layers(tmp_path):
         np.testing.assert_array_equal(full, off)
 
     asyncio.run(run())
+
+
+@pytest.mark.parametrize("resident", [0, 2])
+def test_offload_tp2_matches_tp1(resident):
+    """Weight offload under TP serving (previously excluded): streamed
+    host layers place SHARDED onto the tp mesh per step; outputs must
+    match the unsharded offloaded executor."""
+    from bloombee_tpu.parallel.serving import make_serving_mesh
+
+    spec = _spec()
+    stacked = _params(spec, 4)
+    rng = np.random.default_rng(4)
+    prefill = (rng.standard_normal((2, 9, 64)) * 0.1).astype(np.float32)
+    steps = [(rng.standard_normal((2, 1, 64)) * 0.1).astype(np.float32)
+             for _ in range(3)]
+
+    prefix, host = _host_tail(stacked, 4, resident)
+
+    def run(mesh):
+        m = _manager(4)
+        ex = SpanExecutor(prefix, spec, m, compute_dtype=jnp.float32,
+                          host_layers=host, mesh=mesh)
+        return asyncio.run(_drive(ex, m, prefill, steps))
+
+    ref = run(None)
+    tp2 = run(make_serving_mesh(2))
+    for a, b in zip(tp2, ref):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_offload_tp2_block_server_e2e(tmp_path):
+    """Full swarm path: a tp=2 server streaming 2 offloaded layers serves
+    greedy tokens equal to the tp=1 offloaded server."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(9)
+    LlamaForCausalLM(config).eval().to(torch.float32).save_pretrained(
+        tmp_path, safe_serialization=True
+    )
+
+    async def run_swarm(tp):
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+        server = BlockServer(
+            model_uid="t", start=0, end=3, model_dir=str(tmp_path),
+            registry=RegistryClient("127.0.0.1", reg.port),
+            compute_dtype=jnp.float32, num_pages=64, page_size=4,
+            offload_layers=2, tp=tp,
+        )
+        await server.start()
+        assert len(server.executor.host_layers) == 2
+        dm = DistributedModelForCausalLM.from_pretrained(
+            str(tmp_path), RegistryClient("127.0.0.1", reg.port),
+            model_uid="t",
+        )
+        ids_in = np.arange(5)[None, :]
+        ids = await dm.generate(ids_in, max_new_tokens=6,
+                                server_decode=False)
+        await server.stop()
+        await reg.stop()
+        return ids
+
+    async def run():
+        tp1 = await run_swarm(1)
+        tp2 = await run_swarm(2)
+        np.testing.assert_array_equal(tp1, tp2)
+
+    asyncio.run(run())
