@@ -33,7 +33,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from bloombee_tpu.kv import arena as arena_ops
-from bloombee_tpu.kv.paged import PagedKVTable
 from bloombee_tpu.utils import env
 
 env.declare(

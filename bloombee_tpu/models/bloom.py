@@ -10,7 +10,6 @@ from __future__ import annotations
 
 from typing import Any
 
-import jax.numpy as jnp
 
 from bloombee_tpu.models.auto import Family, register_family
 from bloombee_tpu.models.checkpoint import read_tensor as _t

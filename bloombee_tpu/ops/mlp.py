@@ -7,7 +7,6 @@ Replaces /root/reference/src/bloombee/flexgen_utils/pytorch_backend.py:1033
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
 
 def silu_mlp(

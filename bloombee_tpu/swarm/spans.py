@@ -8,7 +8,8 @@ span. A server announcing disjoint ranges yields its longest contiguous run
 
 from __future__ import annotations
 
-from bloombee_tpu.swarm.data import ModuleInfo, RemoteSpanInfo, ServerInfo, ServerState
+from bloombee_tpu.swarm.data import ModuleInfo, RemoteSpanInfo, ServerState
+
 
 
 def compute_spans(

@@ -12,7 +12,7 @@ from bloombee_tpu.server.block_selection import (
     choose_best_blocks,
     should_choose_other_blocks,
 )
-from bloombee_tpu.swarm.data import ModuleInfo, RemoteSpanInfo, ServerInfo
+from bloombee_tpu.swarm.data import ModuleInfo, ServerInfo
 from bloombee_tpu.swarm.spans import compute_spans
 
 

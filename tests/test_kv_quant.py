@@ -10,7 +10,6 @@
 import asyncio
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

@@ -10,7 +10,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from bloombee_tpu.models.llama.block import init_block_params
 from bloombee_tpu.models.spec import ModelSpec

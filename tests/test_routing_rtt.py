@@ -4,7 +4,6 @@ server->server edges from announced next_pings."""
 
 import asyncio
 
-import numpy as np
 
 from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
 from bloombee_tpu.swarm.data import RemoteSpanInfo, ServerInfo

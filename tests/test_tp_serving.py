@@ -14,7 +14,6 @@ import asyncio
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 import jax.random as jr
 

@@ -8,7 +8,6 @@ import asyncio
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from bloombee_tpu.models.wquant import (
