@@ -72,8 +72,9 @@ def main(argv=None):
                              "(0 disables; default 300, or 0 when --blocks "
                              "pins the span; reference server.py:479-542)")
     parser.add_argument("--drain-timeout", type=float, default=30.0,
-                        help="how long a rebalance waits for live sessions "
-                             "before swapping the span under them")
+                        help="how long a drain (SIGTERM/SIGINT shutdown or "
+                             "a rebalance) waits for live sessions before "
+                             "exiting / swapping the span under them")
     parser.add_argument("--weight-quant", default=None,
                         choices=["none", "int8", "int4"],
                         help="weight-only quantization for the served span "
@@ -146,7 +147,10 @@ def main(argv=None):
             n = args.num_blocks or choose_num_blocks(
                 spec, dtype, args.num_pages, args.page_size
             )
-            start, end = choose_best_blocks(infos, compute_spans(infos), n)
+            start, end = choose_best_blocks(
+                # departing (DRAINING) servers are not coverage
+                infos, compute_spans(infos, include_draining=False), n
+            )
             logging.info(
                 "auto-selected blocks [%d:%d) (%d blocks)", start, end, n
             )
@@ -192,7 +196,29 @@ def main(argv=None):
             "server %s serving %s[%d:%d) on port %d",
             server.server_id, model_uid, start, end, server.port,
         )
-        await asyncio.Event().wait()
+        # graceful shutdown: SIGTERM/SIGINT announce DRAINING (routing
+        # stops sending new sessions), in-flight sessions finish up to
+        # --drain-timeout, then the span is revoked and the process exits
+        import signal
+
+        stop_requested = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(
+                    sig,
+                    lambda s=sig: (
+                        logging.info(
+                            "received %s: draining before exit",
+                            signal.Signals(s).name,
+                        ),
+                        stop_requested.set(),
+                    ),
+                )
+            except NotImplementedError:
+                pass  # platform without signal handler support
+        await stop_requested.wait()
+        await server.drain()
 
     asyncio.run(run())
 

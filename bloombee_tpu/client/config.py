@@ -20,10 +20,13 @@ class ClientConfig:
     # depth (reference microbatch_config derives it from the deployment);
     # None -> BBTPU_MICROBATCH env default
     microbatch: int | str | None = None
-    # per-step failure handling (reference retries/backoff + ban_timeout)
+    # per-step failure handling (reference retries/backoff + ban_timeout):
+    # each failure strike doubles the ban from ban_timeout up to ban_max
+    # (with jitter); a success through the peer resets it
     max_retries: int = 3
     step_timeout: float = 120.0
     ban_timeout: float = 15.0
+    ban_max: float = 120.0
     # routing view refresh (reference _SequenceManagerUpdateThread period)
     update_period: float = 5.0
     # server filters (reference allowed_servers / blocked_servers)

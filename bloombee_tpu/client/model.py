@@ -98,6 +98,7 @@ class DistributedModelForCausalLM:
             # knobs (from_pretrained applies them at construction)
             manager.update_period = config.update_period
             manager.ban_timeout = config.ban_timeout
+            manager.ban_max = config.ban_max
             manager.allowed_servers = (
                 set(config.allowed_servers)
                 if config.allowed_servers else None
@@ -135,6 +136,7 @@ class DistributedModelForCausalLM:
             spec.num_hidden_layers,
             update_period=config.update_period,
             ban_timeout=config.ban_timeout,
+            ban_max=config.ban_max,
             allowed_servers=config.allowed_servers,
             blocked_servers=config.blocked_servers,
             active_adapter=config.active_adapter,

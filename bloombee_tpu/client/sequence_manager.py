@@ -10,12 +10,13 @@ reference `_build_inference_graph` :235-296), or length-weighted random choice
 
 from __future__ import annotations
 
+import dataclasses
 import heapq
 import logging
 import random
 import time
 
-from bloombee_tpu.swarm.data import RemoteSpanInfo
+from bloombee_tpu.swarm.data import RemoteSpanInfo, ServerState
 from bloombee_tpu.swarm.ping import DEFAULT_RTT_S, PingAggregator
 from bloombee_tpu.swarm.spans import compute_spans
 
@@ -33,6 +34,22 @@ class MissingBlocksError(RuntimeError):
         self.blocks = blocks
 
 
+@dataclasses.dataclass
+class _BanState:
+    """Per-peer failure bookkeeping: exponential backoff with jitter plus a
+    half-open probe. Each strike doubles the ban (bounded by ban_max);
+    once the ban expires the FIRST route that would use the peer becomes
+    the trial (probing=True) and other routes keep avoiding it until the
+    trial either succeeds (note_peer_ok resets everything) or fails
+    (re-banned with the next doubling)."""
+
+    strikes: int = 0
+    banned_until: float = 0.0
+    probing: bool = False
+    probe_until: float = 0.0  # trial lease; expires so an unused or
+    # wedged probe route cannot exclude the peer forever
+
+
 class RemoteSequenceManager:
     def __init__(
         self,
@@ -41,6 +58,7 @@ class RemoteSequenceManager:
         num_blocks: int,
         update_period: float = 5.0,
         ban_timeout: float = 15.0,
+        ban_max: float = 120.0,
         rng: random.Random | None = None,
         allowed_servers: list[str] | None = None,
         blocked_servers: list[str] | None = None,
@@ -50,14 +68,16 @@ class RemoteSequenceManager:
         self.model_uid = model_uid
         self.num_blocks = num_blocks
         self.update_period = update_period
-        self.ban_timeout = ban_timeout
+        self.ban_timeout = ban_timeout  # base (first-strike) backoff
+        self.ban_max = ban_max
+        self.probe_timeout = 30.0  # half-open trial lease
         self.allowed_servers = (
             set(allowed_servers) if allowed_servers else None
         )
         self.blocked_servers = set(blocked_servers or ())
         self.active_adapter = active_adapter
         self.spans: dict[str, RemoteSpanInfo] = {}
-        self._banned_until: dict[str, float] = {}
+        self._bans: dict[str, _BanState] = {}
         self._last_update = 0.0
         self._rng = rng or random.Random()
         # measured client->server RTTs (reference ping.py PingAggregator);
@@ -74,9 +94,10 @@ class RemoteSequenceManager:
         )
         self.spans = compute_spans(infos)
         self._last_update = now
+        self._prune_bans()
         banned_now = {
-            p for p, until in self._banned_until.items()
-            if until > time.monotonic()
+            p for p, st in self._bans.items()
+            if st.banned_until > time.monotonic()
         }
         to_ping = [
             (s.peer_id, s.server_info.host, s.server_info.port)
@@ -89,17 +110,73 @@ class RemoteSequenceManager:
             # peer (its failed ping would only record FAILED_RTT_S anyway)
             await self.pinger.measure_many(to_ping, overall_timeout=2.0)
 
+    # ---------------------------------------------------------------- banning
     def ban_peer(self, peer_id: str) -> None:
-        """reference: on_request_failure + ban_timeout backoff."""
-        self._banned_until[peer_id] = time.monotonic() + self.ban_timeout
-        logger.info("banned peer %s for %.0fs", peer_id, self.ban_timeout)
+        """Failure strike: exponential backoff with jitter (reference
+        on_request_failure's flat ban_timeout, hardened). Each strike
+        doubles the ban up to ban_max; jitter (0.75-1.25x, seeded rng)
+        de-synchronizes many clients re-probing a recovered server at
+        once. The peer's measured RTT is dropped so a later re-admission
+        re-measures instead of routing on pre-failure latency."""
+        state = self._bans.setdefault(peer_id, _BanState())
+        state.probing = False
+        state.strikes += 1
+        backoff = min(
+            self.ban_timeout * (2.0 ** (state.strikes - 1)), self.ban_max
+        )
+        backoff *= 0.75 + 0.5 * self._rng.random()
+        state.banned_until = time.monotonic() + backoff
+        self.pinger.forget(peer_id)
+        logger.info(
+            "banned peer %s for %.1fs (strike %d)", peer_id, backoff,
+            state.strikes,
+        )
+
+    def note_peer_ok(self, peer_id: str) -> None:
+        """A request through this peer succeeded: the half-open trial (or
+        any lingering strike history) is cleared so the next failure starts
+        from the base backoff again."""
+        if self._bans.pop(peer_id, None) is not None:
+            logger.info("peer %s recovered; ban history reset", peer_id)
+
+    def _ban_excludes(self, peer_id: str, now: float) -> bool:
+        """True when bans keep this peer out of routing right now. An
+        expired ban admits exactly ONE route as the half-open probe; other
+        routes keep avoiding the peer until the probe resolves."""
+        state = self._bans.get(peer_id)
+        if state is None:
+            return False
+        if now < state.banned_until:
+            return True
+        if state.probing and now < state.probe_until:
+            return True  # a trial is already in flight elsewhere
+        state.probing = True  # this route becomes (or renews) the trial
+        state.probe_until = now + self.probe_timeout
+        logger.info("half-open probe: trying banned peer %s", peer_id)
+        return False
+
+    def _prune_bans(self) -> None:
+        """Drop entries that can no longer matter: peers that left the
+        swarm view, and long-expired bans whose peer was never re-routed
+        (without this the map grows monotonically with churn)."""
+        now = time.monotonic()
+        for pid in list(self._bans):
+            state = self._bans[pid]
+            gone = self.spans and pid not in self.spans
+            long_expired = (
+                not state.probing
+                and now > state.banned_until + 4 * self.ban_max
+            )
+            if gone or long_expired:
+                del self._bans[pid]
 
     def _active_spans(self) -> list[RemoteSpanInfo]:
         now = time.monotonic()
         return [
             s
             for s in self.spans.values()
-            if self._banned_until.get(s.peer_id, 0.0) <= now
+            if s.server_info.state != ServerState.DRAINING
+            and not self._ban_excludes(s.peer_id, now)
             and s.peer_id not in self.blocked_servers
             and (
                 self.allowed_servers is None

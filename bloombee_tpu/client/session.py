@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 import uuid
 
 import numpy as np
@@ -213,6 +214,12 @@ class InferenceSession:
                     logger.warning("recovery attempt failed: %s", e2)
                     await asyncio.sleep(min(0.2 * attempt, 2.0))
 
+    def _note_spans_ok(self) -> None:
+        """A full step succeeded through every span: clear any ban history
+        (half-open probes resolve to healthy; backoff resets to base)."""
+        for s in self._spans:
+            self.manager.note_peer_ok(s.span.peer_id)
+
     async def _step_pruned(
         self, hidden, tree_mask, depths, prune, accept_per_span
     ):
@@ -232,14 +239,11 @@ class InferenceSession:
         assert tree_mask is not None and depths is not None
         step_id = self._step_counter
         self._step_counter += 1
-        b = hidden.shape[0]
         wire_dt = dtype_for_name(self._spans[0].span.server_info.wire_dtype)
         chunk = hidden.astype(wire_dt)
         mask_u8 = np.asarray(tree_mask).astype(np.uint8)
         depths_list = np.asarray(depths).tolist()
         keep = None
-
-        import time
 
         t_start = time.perf_counter()
         compute_ms = []
@@ -250,6 +254,7 @@ class InferenceSession:
                 "tree": True,
                 "depths": depths_list,
                 "reply": "tensor",
+                "deadline_s": self.step_timeout,
             }
             if accept_per_span is not None and accept_per_span[i] is not None:
                 meta["accept"] = [
@@ -283,6 +288,7 @@ class InferenceSession:
                 )
                 mask_u8 = mask_k.astype(np.uint8)
                 depths_list = depths_k.tolist()
+        self._note_spans_ok()
         self.timings.append(
             {
                 "step": step_id,
@@ -307,6 +313,10 @@ class InferenceSession:
             "step": step_id,
             "commit": commit,
             "tree": tree_mask is not None,
+            # remaining-time budget: the server aborts work this client
+            # has already given up on (it shrinks the budget by its own
+            # elapsed time before forwarding down a push route)
+            "deadline_s": self.step_timeout,
         }
         if depths is not None:
             meta_base["depths"] = np.asarray(depths).tolist()
@@ -370,8 +380,6 @@ class InferenceSession:
                 meta, [hidden_w[lo:hi]] + extra
             )
 
-        import time
-
         t_start = time.perf_counter()
         out = np.zeros(hidden.shape, dtype=np.float32)
         got_tensor = False
@@ -414,6 +422,7 @@ class InferenceSession:
                     )
             compute_ms.append(span_ms)
         assert got_tensor, "no span returned a tensor"
+        self._note_spans_ok()
         total_ms = (time.perf_counter() - t_start) * 1000.0
         self.timings.append(
             {
@@ -564,7 +573,14 @@ class InferenceSession:
             raise RpcError("session chain is closed (recovery pending)")
         step_id = self._step_counter
         self._step_counter += 1
-        meta = {"step": step_id, "decode_n": int(n), "reply": "tensor"}
+        meta = {
+            "step": step_id,
+            "decode_n": int(n),
+            "reply": "tensor",
+            # matches the client's own recv budget below: once that expires
+            # the client re-routes, so any remaining server work is wasted
+            "deadline_s": 2 * self.step_timeout + float(n),
+        }
         if eos_token_id is not None:
             meta["eos_token_id"] = int(eos_token_id)
         if finished is not None:
@@ -583,8 +599,6 @@ class InferenceSession:
                 for s in self._spans[1:]
             ]
         span_sess = self._spans[0]
-        import time
-
         t_start = time.perf_counter()
         try:
             await span_sess.stream.send(meta, [ids])
@@ -623,6 +637,7 @@ class InferenceSession:
                 resp_meta.get("reason")
                 or "server declined decode_n for this session"
             )
+        self._note_spans_ok()
         self.timings.append(
             {
                 "step": step_id,

@@ -145,8 +145,10 @@ async def rebalance_if_needed(server) -> bool:
     infos = await server.registry.get_module_infos(
         server.model_uid, range(server.spec.num_hidden_layers)
     )
+    # a DRAINING server is leaving: its span is not real coverage, so the
+    # balance decision must see the post-departure swarm
     target = rebalance_target(
-        server.server_id, infos, compute_spans(infos)
+        server.server_id, infos, compute_spans(infos, include_draining=False)
     )
     if target is None or target == (server.start_block, server.end_block):
         return False

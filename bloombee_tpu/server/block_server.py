@@ -39,6 +39,7 @@ from bloombee_tpu.server.compute_queue import (
     PRIORITY_INFERENCE,
     PRIORITY_TRAINING,
     ComputeQueue,
+    DeadlineExpired,
 )
 from bloombee_tpu.swarm.data import ServerInfo, ServerState
 from bloombee_tpu.utils import env
@@ -407,6 +408,13 @@ class BlockServer:
         self.rebalance_period = float(rebalance_period)
         self.drain_timeout = float(drain_timeout)
         self._rebalancing = False
+        # graceful shutdown: announces DRAINING (routing stops sending NEW
+        # sessions), keeps serving in-flight sessions up to drain_timeout
+        self._draining = False
+        # work dropped because the client's deadline budget (meta
+        # "deadline_s") expired before/while we would compute it; surfaced
+        # via rpc_info for operators and the chaos tests
+        self.deadlines_expired = 0
         self._kv_quant = kv_quant
         self._num_pages = num_pages
         self._adapter_dirs = adapter_dirs
@@ -455,6 +463,42 @@ class BlockServer:
             "server %s serving %s[%d:%d] on port %d",
             self.server_id, self.model_uid, self.start_block, self.end_block, self.port,
         )
+
+    async def drain(self, timeout: float | None = None) -> None:
+        """Graceful shutdown: announce DRAINING so routing stops starting
+        NEW sessions here, keep serving the in-flight ones until they
+        close (bounded by `timeout`, default drain_timeout), then stop.
+        Sessions that outlive the drain replay elsewhere via the client's
+        ordinary dead-server recovery path."""
+        import time as _time
+
+        if self._draining:
+            return
+        self._draining = True
+        deadline = _time.monotonic() + (
+            self.drain_timeout if timeout is None else float(timeout)
+        )
+        logger.info(
+            "draining %s: %d in-flight session(s), up to %.0fs",
+            self.server_id, len(self._sessions),
+            deadline - _time.monotonic(),
+        )
+        if self.registry is not None:
+            try:
+                # immediate announce — the periodic loop may be most of an
+                # announce_period away, and every new session routed here
+                # in that window dies with the server
+                await self._announce(ServerState.DRAINING)
+            except Exception as e:
+                logger.warning("DRAINING announce failed: %s", e)
+        while self._sessions and _time.monotonic() < deadline:
+            await asyncio.sleep(0.1)
+        if self._sessions:
+            logger.warning(
+                "%d session(s) outlived the drain; they will replay "
+                "elsewhere", len(self._sessions),
+            )
+        await self.stop()
 
     async def stop(self) -> None:
         for task in (self._supervisor_task, self._warmup_task,
@@ -690,7 +734,10 @@ class BlockServer:
 
     def server_info(self) -> ServerInfo:
         return ServerInfo(
-            state=ServerState.ONLINE,
+            state=(
+                ServerState.DRAINING if self._draining
+                else ServerState.ONLINE
+            ),
             host=self.public_host,
             port=self.port,
             throughput=self.throughput,
@@ -729,7 +776,10 @@ class BlockServer:
                 # announce FIRST (liveness must not wait on pings — a slow
                 # successor would expire our registry record); the pings
                 # measured after ride the NEXT announce
-                await self._announce(ServerState.ONLINE)
+                await self._announce(
+                    ServerState.DRAINING if self._draining
+                    else ServerState.ONLINE
+                )
                 if env.log_channel_enabled("transport"):
                     from bloombee_tpu.wire.tensor_codec import transport_stats
 
@@ -789,6 +839,10 @@ class BlockServer:
             "server_id": self.server_id,
             "server_time": _time.time(),  # NTP-style clock sync anchor
             "transport": transport_stats(),
+            # chaos/ops observability: expired-deadline work drops and the
+            # drain flag (also visible as state=DRAINING in server_info)
+            "deadlines_expired": self.deadlines_expired,
+            "draining": self._draining,
             # operator visibility into the decode_n fast paths: a client
             # falling back to per-step decoding is otherwise invisible.
             # decode_n: ANY single-span flavor (fused scan or host-driven
@@ -818,6 +872,11 @@ class BlockServer:
         start?, end?}; items: {step, commit, reply, route} + [hidden (B,T,D)]
         (+ tree mask u8 [B,T,T] when meta['tree'])."""
         meta = stream.open_meta
+        if self._draining:
+            # routing should already avoid us (DRAINING announce), but a
+            # client racing a stale swarm view can still arrive — refuse
+            # before allocating KV it could never finish using
+            raise RuntimeError("server is draining; open a session elsewhere")
         session_id = meta["session_id"]
         batch = int(meta["batch_size"])
         max_length = int(meta["max_length"])
@@ -952,6 +1011,32 @@ class BlockServer:
             return True
         return False
 
+    @staticmethod
+    def _local_deadline(meta: dict) -> float | None:
+        """meta['deadline_s'] (relative remaining seconds stamped by the
+        client or shrunk by the previous hop) -> local monotonic cutoff,
+        or None when the item carries no budget."""
+        import time as _time
+
+        budget = meta.get("deadline_s")
+        if budget is None:
+            return None
+        return _time.monotonic() + float(budget)
+
+    @staticmethod
+    def _deadline_passed(deadline: float | None) -> bool:
+        import time as _time
+
+        return deadline is not None and _time.monotonic() > deadline
+
+    def _note_deadline_expired(self, meta: dict, where: str) -> None:
+        self.deadlines_expired += 1
+        logger.info(
+            "dropping step %s: client deadline expired %s "
+            "(%d drops total)", meta.get("step"), where,
+            self.deadlines_expired,
+        )
+
     async def _run_step(
         self, session: _Session, stream: Stream, meta: dict, tensors: list
     ) -> None:
@@ -960,6 +1045,13 @@ class BlockServer:
             # stream): errors go back to the coordinator via chain_error,
             # not to our own client's stream
             await self._run_chain_step(session, meta, tensors)
+            return
+        # client deadline budget: "deadline_s" is RELATIVE remaining time
+        # (never an absolute timestamp — clocks differ across machines);
+        # convert to a local monotonic cutoff at arrival
+        deadline = self._local_deadline(meta)
+        if self._deadline_passed(deadline):
+            self._note_deadline_expired(meta, "on arrival")
             return
         if not self.manager.epoch_valid(session.handle):
             # cheap pre-check so a stale session's accept/decode never
@@ -1059,7 +1151,11 @@ class BlockServer:
                 tree_mask,
                 depths,
                 commit_lens,
+                deadline=deadline,
             )
+        except DeadlineExpired:
+            self._note_deadline_expired(meta, "while queued")
+            return
         except Exception as e:
             if await self._maybe_reply_session_lost(
                 session, stream, meta, e
@@ -1130,6 +1226,15 @@ class BlockServer:
                 push_meta["depths"] = meta["depths"]
             if accept is not None:
                 push_meta["accept"] = accept
+            if deadline is not None:
+                # each hop spends part of the budget; forward the REMAINDER
+                # so a downstream span never computes for a client whose
+                # overall step timeout already fired
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    self._note_deadline_expired(meta, "before forwarding")
+                    return
+                push_meta["deadline_s"] = remaining
             push_tensors = [out]  # executor output is already wire dtype
             if tree_mask is not None:
                 push_tensors.append(tree_mask.astype(np.uint8))
@@ -1272,8 +1377,12 @@ class BlockServer:
 
         try:
             out_dev, t_dispatch_ms = await self.compute.submit(
-                PRIORITY_INFERENCE, _dispatch
+                PRIORITY_INFERENCE, _dispatch,
+                deadline=self._local_deadline(meta),
             )
+        except DeadlineExpired:
+            self._note_deadline_expired(meta, "while queued")
+            return
         except Exception as e:
             if await self._maybe_reply_session_lost(
                 session, stream, meta, e
@@ -1345,6 +1454,11 @@ class BlockServer:
         # coordinator that was making slow-but-legal progress. A retry
         # after replay hits warm compile caches and converges.
         t_deadline = _time.monotonic() + self.chain_step_timeout + float(n)
+        budget = meta.get("deadline_s")
+        if budget is not None:
+            # never outlive the CLIENT's budget either: past it the reply
+            # lands on a closed ear and every further token is waste
+            t_deadline = min(t_deadline, _time.monotonic() + float(budget))
         try:
             for i in range(n):
                 if _time.monotonic() > t_deadline:
@@ -1387,6 +1501,7 @@ class BlockServer:
                     await self._push_hop(
                         route, chain, meta.get("step"),
                         meta.get("head_dtype"), out,
+                        deadline_s=t_deadline - _time.monotonic(),
                     )
                     nxt = await self._await_chain_ids(
                         session, cid, i, t_deadline
@@ -1442,7 +1557,8 @@ class BlockServer:
         )
 
     async def _push_hop(
-        self, route: list, chain: dict, step, head_dtype, out
+        self, route: list, chain: dict, step, head_dtype, out,
+        deadline_s: float | None = None,
     ) -> None:
         """Push one chained-decode hidden state to the next hop (shared by
         the coordinator and middle spans — the hop wire format lives in
@@ -1457,6 +1573,8 @@ class BlockServer:
         }
         if head_dtype is not None:
             push_meta["head_dtype"] = head_dtype
+        if deadline_s is not None:
+            push_meta["deadline_s"] = deadline_s
         conn = await self.peers.get(nxt_hop["host"], nxt_hop["port"])
         async with self.peers.limiter(
             nxt_hop["host"], nxt_hop["port"]
@@ -1506,6 +1624,7 @@ class BlockServer:
 
         chain = meta["chain"]
         origin = chain["origin"]
+        deadline = self._local_deadline(meta)
         try:
             hidden = np.asarray(tensors[0])
 
@@ -1532,16 +1651,31 @@ class BlockServer:
                 err = await self._chain_tail_ineligible(meta)
                 if err is not None:
                     raise _ChainError(err, permanent=True)
-            out_dev = await self.compute.submit(
-                PRIORITY_INFERENCE, _dispatch
-            )
+            try:
+                out_dev = await self.compute.submit(
+                    PRIORITY_INFERENCE, _dispatch, deadline=deadline
+                )
+            except DeadlineExpired:
+                # the coordinator's chain deadline already fired; it has
+                # answered its client, so a chain_error would land on a
+                # stale cid anyway — count the drop and stop quietly
+                self._note_deadline_expired(meta, "in chain hop queue")
+                return
             session.n_steps += 1
             session.sum_tokens += int(hidden.shape[0])
             if route:
                 out = await asyncio.to_thread(self.executor.fetch, out_dev)
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        self._note_deadline_expired(
+                            meta, "before chain forward"
+                        )
+                        return
                 await self._push_hop(
                     route, chain, meta.get("step"), meta.get("head_dtype"),
-                    out,
+                    out, deadline_s=remaining,
                 )
             else:
                 nxt = await self.compute.submit(

@@ -11,11 +11,18 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable
 
 PRIORITY_INFERENCE = 0.0  # reference DummyTaskPrioritizer: inference=1.0
 PRIORITY_TRAINING = 1.0  # beats forward/backward=2.0 — same ordering
+
+
+class DeadlineExpired(RuntimeError):
+    """The task's client-supplied deadline passed while it sat in the
+    queue: the client has already given up, so running it would only
+    delay work somebody still wants."""
 
 
 class ComputeQueue:
@@ -36,19 +43,35 @@ class ComputeQueue:
         self._thread.shutdown(wait=False, cancel_futures=True)
 
     async def submit(
-        self, priority: float, fn: Callable[..., Any], *args, **kwargs
+        self,
+        priority: float,
+        fn: Callable[..., Any],
+        *args,
+        deadline: float | None = None,  # time.monotonic() cutoff: the task
+        # is abandoned (DeadlineExpired) if the worker reaches it later
+        **kwargs,
     ) -> Any:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._queue.put_nowait(
-            (priority, next(self._seq), fn, args, kwargs, fut)
+            (priority, next(self._seq), deadline, fn, args, kwargs, fut)
         )
         return await fut
 
     async def _worker(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
-            _, _, fn, args, kwargs, fut = await self._queue.get()
+            _, _, deadline, fn, args, kwargs, fut = await self._queue.get()
             if fut.cancelled():
+                continue
+            if deadline is not None and time.monotonic() > deadline:
+                # checked at execution time, not submit time: a deep queue
+                # behind a slow step is exactly when expiry happens
+                if not fut.done():
+                    fut.set_exception(
+                        DeadlineExpired(
+                            "deadline passed while queued; dropping compute"
+                        )
+                    )
                 continue
             try:
                 result = await loop.run_in_executor(

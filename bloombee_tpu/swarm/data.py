@@ -14,6 +14,10 @@ class ServerState(enum.IntEnum):
     OFFLINE = 0
     JOINING = 1
     ONLINE = 2
+    # still serving its in-flight sessions but about to exit: routing must
+    # not start NEW sessions here (ordered above ONLINE so liveness filters
+    # `state >= ONLINE` keep draining servers visible to their open clients)
+    DRAINING = 3
 
 
 @dataclasses.dataclass

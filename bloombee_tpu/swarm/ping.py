@@ -41,6 +41,16 @@ class PingAggregator:
     def get(self, peer_id: str, default: float = DEFAULT_RTT_S) -> float:
         return self._rtt.get(peer_id, default)
 
+    def forget(self, peer_id: str) -> None:
+        """Drop a peer's RTT (and clock offset) so its next admission to
+        routing re-measures. Called when a peer is banned: the pre-failure
+        EMA describes a server that no longer exists in that form — a
+        recovered peer routing on stale low latency would soak up traffic
+        it can't serve (and a stale FAILED_RTT_S would shun a healthy one)."""
+        self._rtt.pop(peer_id, None)
+        self._measured_at.pop(peer_id, None)
+        self._clock_offset.pop(peer_id, None)
+
     def needs_measure(self, peer_id: str) -> bool:
         at = self._measured_at.get(peer_id)
         return at is None or time.monotonic() - at > self.stale_after

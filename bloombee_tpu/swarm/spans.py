@@ -13,15 +13,24 @@ from bloombee_tpu.swarm.data import ModuleInfo, RemoteSpanInfo, ServerState
 
 
 def compute_spans(
-    module_infos: list[ModuleInfo], min_state: ServerState = ServerState.ONLINE
+    module_infos: list[ModuleInfo],
+    min_state: ServerState = ServerState.ONLINE,
+    include_draining: bool = True,
 ) -> dict[str, RemoteSpanInfo]:
-    """server_id -> RemoteSpanInfo covering its contiguous ONLINE blocks."""
+    """server_id -> RemoteSpanInfo covering its contiguous live blocks.
+
+    DRAINING servers are included by default (their open sessions must keep
+    resolving them); pass include_draining=False for views that pick targets
+    for NEW work (routing handles this via _active_spans; block selection
+    should not count a departing server as coverage)."""
     spans: dict[str, RemoteSpanInfo] = {}
     for block_idx, info in enumerate(module_infos):
         if info is None:
             continue
         for peer_id, server in info.servers.items():
             if server.state < min_state:
+                continue
+            if not include_draining and server.state == ServerState.DRAINING:
                 continue
             span = spans.get(peer_id)
             if span is None:

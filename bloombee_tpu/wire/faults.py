@@ -1,0 +1,232 @@
+"""Deterministic fault injection for the wire layer.
+
+BloomBee's value proposition is surviving a flaky swarm, but the reactive
+machinery (session re-route + replay, registry TTL expiry, peer bans) could
+only be exercised by killing real servers at uncontrolled moments. This
+module makes failures *provokable*: a `FaultPlan` holds an ordered list of
+`FaultRule`s plus a seeded RNG, and `Connection._send` / `Connection._read_loop`
+consult the installed plan on every frame. Rules match per-site, per-method,
+per-peer-port and per-nth-call, so a test can say exactly "reset the
+connection to server B on the 3rd decode step" and replay it bit-for-bit.
+
+Actions:
+
+- ``delay``  — sleep ``delay_s`` before the frame proceeds (slow link)
+- ``reset``  — abort the transport (RST-style connection reset)
+- ``close``  — orderly close mid-stream (FIN after the current frame)
+- ``stall``  — on read: swallow the frame and never deliver it (wedged peer);
+  on send: sleep until the connection dies (stalled writer)
+- ``drop``   — on read: silently discard the frame (lost packet)
+
+Probabilistic chaos uses the plan's seeded RNG so a failing soak run can be
+reproduced from its seed alone. Env knobs (``BBTPU_CHAOS_*``) build a
+process-wide plan at first use for chaos-testing real deployments without
+touching code.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import random
+from typing import Callable, Optional
+
+from bloombee_tpu.utils import env
+
+logger = logging.getLogger(__name__)
+
+env.declare(
+    "BBTPU_CHAOS", bool, False,
+    "master switch: build a process-wide FaultPlan from the BBTPU_CHAOS_* "
+    "knobs below and inject faults into every wire connection",
+)
+env.declare(
+    "BBTPU_CHAOS_SEED", int, 0,
+    "seed for the chaos plan's RNG — identical seeds replay identical "
+    "fault sequences",
+)
+env.declare(
+    "BBTPU_CHAOS_DELAY_P", float, 0.0,
+    "per-frame probability of delaying a sent frame",
+)
+env.declare(
+    "BBTPU_CHAOS_DELAY_S", float, 0.05,
+    "how long a chaos-delayed frame sleeps before hitting the wire",
+)
+env.declare(
+    "BBTPU_CHAOS_RESET_P", float, 0.0,
+    "per-frame probability of aborting the connection instead of sending",
+)
+env.declare(
+    "BBTPU_CHAOS_STALL_P", float, 0.0,
+    "per-frame probability of swallowing a received frame (wedged peer)",
+)
+
+
+class InjectedFault(ConnectionResetError):
+    """Raised on the faulting side so callers see the same exception family
+    a real transport failure produces (retry paths must not special-case
+    injected faults — that would test nothing)."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One programmable fault. A rule matches a frame when every non-None
+    constraint holds; it fires on the ``nth`` match (1-based) and the
+    following ``count - 1`` matches (count=0 -> every match from nth on)."""
+
+    site: str  # "send" | "read"
+    action: str  # "delay" | "reset" | "close" | "stall" | "drop"
+    method: str | None = None  # frame's "m" (rpc method) or "t" (frame type)
+    port: int | None = None  # remote peer port (targets one server)
+    nth: int = 1
+    count: int = 1
+    delay_s: float = 0.0
+    prob: float | None = None  # None: deterministic; else seeded coin-flip
+    predicate: Optional[Callable[[dict], bool]] = None  # extra meta match
+    _matches: int = dataclasses.field(default=0, repr=False)
+    _fired: int = dataclasses.field(default=0, repr=False)
+
+    def wants(self, site: str, peer: tuple | None, header: dict,
+              rng: random.Random) -> bool:
+        if site != self.site:
+            return False
+        if self.method is not None and self.method not in (
+            header.get("m"), header.get("t")
+        ):
+            return False
+        if self.port is not None and (peer is None or peer[1] != self.port):
+            return False
+        if self.predicate is not None and not self.predicate(header):
+            return False
+        if self.prob is not None:
+            return rng.random() < self.prob
+        self._matches += 1
+        if self._matches < self.nth:
+            return False
+        if self.count and self._fired >= self.count:
+            return False
+        self._fired += 1
+        return True
+
+
+class FaultPlan:
+    """Seeded, ordered rule set consulted by every Connection."""
+
+    def __init__(self, rules: list[FaultRule] | None = None,
+                 seed: int = 0):
+        self.rules = list(rules or [])
+        self.rng = random.Random(seed)
+        # observability: tests assert exactly which faults landed
+        self.log: list[tuple[str, str, dict]] = []
+
+    def add(self, rule: FaultRule) -> "FaultPlan":
+        self.rules.append(rule)
+        return self
+
+    def _pick(self, site: str, peer, header) -> FaultRule | None:
+        for rule in self.rules:
+            if rule.wants(site, peer, header, self.rng):
+                return rule
+        return None
+
+    async def on_send(self, conn, header: dict) -> None:
+        """Consulted by Connection._send before the frame hits the wire.
+        May sleep, or raise InjectedFault after aborting the transport."""
+        rule = self._pick("send", conn.peer, header)
+        if rule is None:
+            return
+        self.log.append(("send", rule.action, dict(header)))
+        await self._apply(conn, rule, header)
+
+    async def on_read(self, conn, header: dict) -> str | None:
+        """Consulted by Connection._read_loop after decoding a frame and
+        before dispatch. Returns "drop" to swallow the frame."""
+        rule = self._pick("read", conn.peer, header)
+        if rule is None:
+            return None
+        self.log.append(("read", rule.action, dict(header)))
+        if rule.action == "delay":
+            await asyncio.sleep(rule.delay_s)
+            return None
+        if rule.action in ("stall", "drop"):
+            logger.info(
+                "chaos: swallowing %s frame from %s", header.get("t"),
+                conn.peer,
+            )
+            return "drop"
+        if rule.action in ("reset", "close"):
+            await self._kill(conn, abort=rule.action == "reset")
+            return "drop"
+        return None
+
+    async def _apply(self, conn, rule: FaultRule, header: dict) -> None:
+        if rule.action == "delay":
+            await asyncio.sleep(rule.delay_s)
+            return
+        if rule.action == "stall":
+            # a wedged writer: hold the frame until the connection dies
+            logger.info("chaos: stalling send to %s", conn.peer)
+            await conn._closed.wait()
+            raise InjectedFault("injected send stall")
+        if rule.action in ("reset", "close"):
+            logger.info(
+                "chaos: %s connection to %s on %s frame", rule.action,
+                conn.peer, header.get("t"),
+            )
+            await self._kill(conn, abort=rule.action == "reset")
+            raise InjectedFault(f"injected connection {rule.action}")
+
+    @staticmethod
+    async def _kill(conn, abort: bool) -> None:
+        try:
+            if abort:
+                transport = conn.writer.transport
+                if transport is not None:
+                    transport.abort()
+            else:
+                conn.writer.close()
+        except Exception:
+            pass
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan | None":
+        """Build a probabilistic plan from the BBTPU_CHAOS_* knobs; None
+        when chaos is off."""
+        if not env.get("BBTPU_CHAOS"):
+            return None
+        plan = cls(seed=env.get("BBTPU_CHAOS_SEED"))
+        delay_p = env.get("BBTPU_CHAOS_DELAY_P")
+        if delay_p > 0:
+            plan.add(FaultRule(
+                site="send", action="delay", prob=delay_p,
+                delay_s=env.get("BBTPU_CHAOS_DELAY_S"),
+            ))
+        reset_p = env.get("BBTPU_CHAOS_RESET_P")
+        if reset_p > 0:
+            plan.add(FaultRule(site="send", action="reset", prob=reset_p))
+        stall_p = env.get("BBTPU_CHAOS_STALL_P")
+        if stall_p > 0:
+            plan.add(FaultRule(site="read", action="stall", prob=stall_p))
+        return plan
+
+
+_active_plan: FaultPlan | None = None
+_env_checked = False
+
+
+def set_plan(plan: FaultPlan | None) -> None:
+    """Install the process-wide plan (tests). None disarms injection."""
+    global _active_plan, _env_checked
+    _active_plan = plan
+    _env_checked = True  # an explicit plan overrides the env knobs
+
+
+def get_plan() -> FaultPlan | None:
+    """Plan consulted by new Connections; lazily built from env once."""
+    global _active_plan, _env_checked
+    if not _env_checked:
+        _env_checked = True
+        _active_plan = FaultPlan.from_env()
+    return _active_plan

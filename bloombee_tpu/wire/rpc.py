@@ -22,6 +22,7 @@ from typing import Awaitable, Callable
 import msgpack
 import numpy as np
 
+from bloombee_tpu.wire import faults
 from bloombee_tpu.wire.tensor_codec import (
     deserialize_tensors,
     serialize_tensors,
@@ -116,20 +117,33 @@ class Connection:
         unary_handlers: dict[str, UnaryHandler] | None = None,
         stream_handlers: dict[str, StreamHandler] | None = None,
         push_handlers: dict[str, PushHandler] | None = None,
+        peer: tuple[str, int] | None = None,
     ):
         self.reader = reader
         self.writer = writer
         self.unary_handlers = unary_handlers or {}
         self.stream_handlers = stream_handlers or {}
         self.push_handlers = push_handlers or {}
+        # remote (host, port) when known — fault rules target peers by port
+        self.peer = peer or self._peername(writer)
+        self.fault_plan = faults.get_plan()
         self._ids = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
         self._streams: dict[int, Stream] = {}
+        self._unary_tasks: dict[int, asyncio.Task] = {}
         self._tasks: set[asyncio.Task] = set()
         self._send_lock = asyncio.Lock()
         self._reader_task: asyncio.Task | None = None
         self._closed = asyncio.Event()
         self.on_close: Callable[["Connection"], None] | None = None
+
+    @staticmethod
+    def _peername(writer: asyncio.StreamWriter) -> tuple[str, int] | None:
+        try:
+            name = writer.get_extra_info("peername")
+            return (name[0], name[1]) if name else None
+        except Exception:
+            return None
 
     # ------------------------------------------------------------------ setup
     def start(self) -> None:
@@ -179,6 +193,15 @@ class Connection:
         )
         try:
             return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            # the caller is abandoning this call: tell the server so it can
+            # stop computing for a client that will never read the reply
+            if not self.is_closing():
+                try:
+                    await self._send({"t": "cancel", "id": rid}, [])
+                except Exception:
+                    pass  # best-effort; the timeout still propagates
+            raise
         finally:
             self._pending.pop(rid, None)
 
@@ -215,6 +238,10 @@ class Connection:
 
     # --------------------------------------------------------------- internals
     async def _send(self, header: dict, blobs: list[bytes]) -> None:
+        if self.fault_plan is not None:
+            # may sleep (delayed frame) or raise after killing the
+            # transport (injected reset / mid-stream close / stalled write)
+            await self.fault_plan.on_send(self, header)
         frame = _encode_frame(header, blobs)
         async with self._send_lock:
             self.writer.write(frame)
@@ -234,6 +261,10 @@ class Connection:
                 for blen in header.get("bl", []):
                     blobs.append(body[off : off + blen])
                     off += blen
+                if self.fault_plan is not None:
+                    act = await self.fault_plan.on_read(self, header)
+                    if act == "drop":
+                        continue  # injected stall/loss: frame never arrives
                 self._dispatch(header, blobs)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
@@ -257,7 +288,20 @@ class Connection:
         t = header["t"]
         rid = header["id"]
         if t == "req":
-            self._spawn(self._handle_unary(header, blobs))
+            task = asyncio.create_task(self._handle_unary(header, blobs))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+            # indexed by request id so a later "cancel" frame can stop it
+            self._unary_tasks[rid] = task
+            task.add_done_callback(
+                lambda _t, rid=rid: self._unary_tasks.pop(rid, None)
+            )
+        elif t == "cancel":
+            # peer abandoned a unary call (client-side wait_for timeout):
+            # stop the in-flight handler; no reply is expected
+            task = self._unary_tasks.pop(rid, None)
+            if task is not None and not task.done():
+                task.cancel()
         elif t == "push":
             self._spawn(self._handle_push(header, blobs))
         elif t == "sopen":
@@ -305,6 +349,10 @@ class Connection:
             meta, out = await handler(header.get("meta", {}), tensors)
             tm, oblobs = serialize_tensors(out)
             await self._send({"t": "res", "id": rid, "meta": meta, "tm": tm}, oblobs)
+        except asyncio.CancelledError:
+            # cancelled by a peer "cancel" frame (abandoned call) or by
+            # connection teardown: either way nobody is reading the reply
+            logger.debug("unary handler %s cancelled", method)
         except Exception as e:
             logger.debug("unary handler %s failed: %s", method, e)
             if not self.is_closing():
@@ -400,6 +448,9 @@ async def connect(
     push_handlers: dict[str, PushHandler] | None = None,
 ) -> Connection:
     reader, writer = await asyncio.open_connection(host, port)
-    conn = Connection(reader, writer, unary_handlers, stream_handlers, push_handlers)
+    conn = Connection(
+        reader, writer, unary_handlers, stream_handlers, push_handlers,
+        peer=(host, port),
+    )
     conn.start()
     return conn

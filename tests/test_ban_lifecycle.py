@@ -1,0 +1,149 @@
+"""Ban lifecycle unit tests: exponential backoff, half-open probe
+re-admission, pruning, and exact missing-block reporting.
+
+Pure routing-layer tests (registry=None, spans injected directly) — no
+servers, no jax compute, so these run in milliseconds and pin down the
+state machine the chaos e2e suite exercises end-to-end.
+"""
+
+import random
+import time
+
+import pytest
+
+from bloombee_tpu.client.sequence_manager import (
+    MissingBlocksError,
+    RemoteSequenceManager,
+)
+from bloombee_tpu.swarm.data import RemoteSpanInfo, ServerInfo, ServerState
+
+
+def _span(peer_id, start, end, **info_kw):
+    info_kw.setdefault("host", "127.0.0.1")
+    info_kw.setdefault("port", 7000 + hash(peer_id) % 100)
+    return RemoteSpanInfo(
+        peer_id, start, end, ServerInfo(start_block=start, end_block=end,
+                                        **info_kw)
+    )
+
+
+def _manager(num_blocks=2, **kw):
+    kw.setdefault("ban_timeout", 0.2)
+    kw.setdefault("ban_max", 1.0)
+    kw.setdefault("rng", random.Random(0))
+    return RemoteSequenceManager(None, "uid", num_blocks, **kw)
+
+
+def test_banned_peer_excluded_from_routes():
+    m = _manager()
+    m.spans = {"a": _span("a", 0, 2), "b": _span("b", 0, 2)}
+    m.ban_peer("a")
+    for _ in range(5):
+        route = m.make_sequence()
+        assert [s.peer_id for s in route] == ["b"]
+
+
+def test_ban_backoff_doubles_with_jitter_and_caps():
+    m = _manager(ban_timeout=1.0, ban_max=4.0)
+    durations = []
+    for _ in range(5):
+        before = time.monotonic()
+        m.ban_peer("a")
+        durations.append(m._bans["a"].banned_until - before)
+    # strikes 1..5 -> base backoff 1, 2, 4, 4, 4 (capped), each with
+    # 0.75-1.25x jitter
+    for got, base in zip(durations, [1.0, 2.0, 4.0, 4.0, 4.0]):
+        assert base * 0.75 <= got <= base * 1.25 + 0.01
+    # a success resets the whole history: the next failure starts over
+    m.note_peer_ok("a")
+    assert "a" not in m._bans
+    m.ban_peer("a")
+    assert m._bans["a"].strikes == 1
+
+
+def test_half_open_probe_admits_one_route():
+    m = _manager(ban_timeout=0.05, ban_max=0.05)
+    m.spans = {"a": _span("a", 0, 2), "b": _span("b", 0, 2)}
+    m.ban_peer("a")
+    now = time.monotonic()
+    assert m._ban_excludes("a", now)  # still banned
+    time.sleep(0.08)
+    now = time.monotonic()
+    # ban expired: the FIRST caller becomes the half-open trial...
+    assert not m._ban_excludes("a", now)
+    assert m._bans["a"].probing
+    # ...and other routes keep avoiding the peer while the trial runs
+    assert m._ban_excludes("a", now)
+    # trial succeeds -> fully re-admitted everywhere
+    m.note_peer_ok("a")
+    assert "a" not in m._bans
+    assert not m._ban_excludes("a", time.monotonic())
+
+
+def test_probe_lease_expires_so_peer_is_not_stuck():
+    """If the trial route never resolves (client went away mid-probe), the
+    probe lease expires and the next route re-probes instead of the peer
+    being excluded forever."""
+    m = _manager(ban_timeout=0.01, ban_max=0.01)
+    m.ban_peer("a")
+    time.sleep(0.02)
+    assert not m._ban_excludes("a", time.monotonic())  # trial 1
+    st = m._bans["a"]
+    assert st.probing and st.probe_until > time.monotonic()
+    st.probe_until = time.monotonic() - 1.0  # the trial went silent
+    assert not m._ban_excludes("a", time.monotonic())  # trial renewed
+    assert st.probe_until > time.monotonic()
+
+
+def test_probe_failure_rebans_with_next_doubling():
+    m = _manager(ban_timeout=0.05, ban_max=10.0)
+    m.ban_peer("a")
+    time.sleep(0.08)
+    assert not m._ban_excludes("a", time.monotonic())  # half-open trial
+    m.ban_peer("a")  # the trial failed
+    st = m._bans["a"]
+    assert st.strikes == 2 and not st.probing
+    remaining = st.banned_until - time.monotonic()
+    assert 0.05 * 2 * 0.74 <= remaining <= 0.05 * 2 * 1.25 + 0.01
+
+
+def test_missing_blocks_error_reports_exact_indices():
+    m = _manager(num_blocks=5)
+    m.spans = {"a": _span("a", 0, 2), "b": _span("b", 3, 4)}
+    with pytest.raises(MissingBlocksError) as ei:
+        m.make_sequence()
+    assert ei.value.blocks == [2, 4]
+
+
+def test_prune_bans_drops_departed_and_long_expired():
+    m = _manager(ban_timeout=0.01, ban_max=0.01)
+    m.spans = {"b": _span("b", 0, 2)}
+    m.ban_peer("a")  # not in spans anymore -> departed
+    m.ban_peer("b")
+    m._prune_bans()
+    assert "a" not in m._bans and "b" in m._bans
+    # long-expired (> banned_until + 4*ban_max) entries age out too
+    m._bans["b"].banned_until = time.monotonic() - 1.0
+    m._prune_bans()
+    assert "b" not in m._bans
+
+
+def test_ban_forgets_measured_rtt():
+    """Banning drops the peer's RTT EMA: a recovered server re-measures
+    instead of routing on its pre-failure latency."""
+    m = _manager()
+    m.pinger.record("a", 0.002)
+    assert m.pinger.get("a", 9.9) == pytest.approx(0.002)
+    m.ban_peer("a")
+    assert m.pinger.get("a", 9.9) == 9.9
+    assert m.pinger.needs_measure("a")
+
+
+def test_draining_servers_excluded_from_new_routes():
+    m = _manager()
+    m.spans = {
+        "a": _span("a", 0, 2, state=ServerState.DRAINING, throughput=10.0),
+        "b": _span("b", 0, 2, throughput=1.0),
+    }
+    for _ in range(5):
+        assert [s.peer_id for s in m.make_sequence()] == ["b"]
