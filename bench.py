@@ -691,6 +691,10 @@ def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
             model_uid="bench", start=0, end=span_layers, params=params,
             spec=spec, registry=rc(), num_pages=768, page_size=16,
             client_params=client_params,
+            # the batcher is OFF here so phases A/B stay the per-step and
+            # serialized-multisession baselines; phase B2 below measures
+            # the same load with continuous batching enabled
+            max_batch=1,
         )
         await server.start()
         manager = RemoteSequenceManager(rc(), "bench", span_layers)
@@ -884,6 +888,94 @@ def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
                 eff_steps_per_sec * B / spans_per_model
             )
             phase("multisession", "ok")
+
+        # ---- phase B2: continuous batching — the same N_SESS concurrent
+        # sessions, against a server that coalesces their single-token
+        # decode steps into one merged span dispatch per round (ISSUE 2;
+        # BBTPU_BATCH_WINDOW_MS gather window + --max-batch group cap).
+        # Reported next to phase B's unbatched aggregate so BENCH_r*.json
+        # captures the win.
+        if not wedged:
+            server_cb = None
+            old_window = os.environ.get("BBTPU_BATCH_WINDOW_MS")
+            try:
+                os.environ["BBTPU_BATCH_WINDOW_MS"] = "4"
+                server_cb = BlockServer(
+                    model_uid="bench_cb", start=0, end=span_layers,
+                    params=params, spec=spec, registry=rc(),
+                    num_pages=768, page_size=16, max_batch=N_SESS,
+                )
+                await server_cb.start()
+                manager_cb = RemoteSequenceManager(
+                    rc(), "bench_cb", span_layers
+                )
+
+                async def one_session_cb():
+                    s = InferenceSession(
+                        manager_cb, max_length=PREFILL + DECODE,
+                        batch_size=B,
+                    )
+                    async with s:
+                        await s.step(hidden)
+                        for _ in range(DECODE):
+                            await s.step(step_h)
+
+                t0 = time.time()
+                gather_cb = asyncio.ensure_future(
+                    asyncio.gather(
+                        *(one_session_cb() for _ in range(N_SESS))
+                    )
+                )
+                done, pending = await asyncio.wait(
+                    {gather_cb}, timeout=300.0
+                )
+                if pending:
+                    gather_cb.cancel()  # best-effort, not awaited
+                    phase(
+                        "multisession_batched",
+                        "failed: timed out after 300s",
+                    )
+                else:
+                    gather_cb.result()
+                    wall = time.time() - t0
+                    eff = N_SESS * DECODE / wall
+                    width = server_cb.batched_steps / max(
+                        server_cb.batch_dispatches, 1
+                    )
+                    agg = eff * B / spans_per_model
+                    RESULTS["multisession_batched"] = {
+                        "agg_equiv_tok_per_s": agg,
+                        "unbatched_agg_tok_per_s": result[
+                            "effective_equiv_tok_per_s"
+                        ],
+                        "mean_batch_width": width,
+                        "batched_steps": server_cb.batched_steps,
+                        "batch_dispatches": server_cb.batch_dispatches,
+                        "batch_solo_steps": server_cb.batch_solo_steps,
+                        "queue_wait_ms": server_cb.compute.wait_stats_ms(),
+                    }
+                    log(
+                        f"batched multisession: {agg:.1f} equiv tok/s "
+                        f"(unbatched "
+                        f"{result['effective_equiv_tok_per_s']:.1f}), "
+                        f"mean batch width {width:.2f}"
+                    )
+                    phase("multisession_batched", "ok")
+            except Exception as e:  # noqa: BLE001
+                phase("multisession_batched", f"failed: {e!r}"[:200])
+                log(f"batched multisession phase FAILED: {e!r}")
+            finally:
+                if old_window is None:
+                    os.environ.pop("BBTPU_BATCH_WINDOW_MS", None)
+                else:
+                    os.environ["BBTPU_BATCH_WINDOW_MS"] = old_window
+                if server_cb is not None:
+                    try:
+                        await asyncio.wait_for(
+                            server_cb.stop(), timeout=30.0
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
 
         if not wedged:
             # TTFT on a fresh session with warm buckets (skipped when the

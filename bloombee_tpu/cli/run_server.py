@@ -55,6 +55,11 @@ def main(argv=None):
     parser.add_argument("--num-pages", type=int, default=256)
     parser.add_argument("--page-size", type=int, default=16)
     parser.add_argument("--max-chunk-tokens", type=int, default=512)
+    parser.add_argument("--max-batch", type=int, default=8,
+                        help="continuous batching: coalesce up to this many "
+                             "concurrent sessions' single-token decode "
+                             "steps into one span dispatch (1 disables; "
+                             "gather window via BBTPU_BATCH_WINDOW_MS)")
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["bfloat16", "float32"])
     parser.add_argument("--adapter-dirs", nargs="*", default=None,
@@ -161,6 +166,7 @@ def main(argv=None):
             host=args.host, port=args.port, public_host=args.public_host,
             num_pages=args.num_pages, page_size=args.page_size,
             compute_dtype=dtype, max_chunk_tokens=args.max_chunk_tokens,
+            max_batch=args.max_batch,
             announce_period=args.announce_period,
             adapter_dirs=args.adapter_dirs,
             adapters=parse_adapters(args.adapters),

@@ -628,6 +628,33 @@ class CacheManager:
         }
 
     @_locked
+    def combine_handles(self, handles: list["CacheHandle"]) -> "CacheHandle":
+        """Merged view over several live handles for ONE batched decode
+        step (continuous batching). The combined seq_id list is what drives
+        cross-session page-table row gathering: `page_table` /
+        `write_slots` / `context_lens` already operate per-sequence over
+        `handle.seq_ids`, so rows from different sessions compose into one
+        kernel launch with no new table machinery.
+
+        The combined handle is EPHEMERAL — it borrows the member sessions'
+        sequences for the duration of one dispatch and is never registered
+        (handle_id=-1), so dropping it frees nothing and it must not
+        outlive the member allocations."""
+        return CacheHandle(
+            handle_id=-1,
+            seq_ids=[sid for h in handles for sid in h.seq_ids],
+            max_length=max(h.max_length for h in handles),
+        )
+
+    @_locked
+    def has_parked(self, handle: "CacheHandle") -> bool:
+        """True when any sequence of `handle` is host-parked, i.e. its next
+        step must unpark first. The decode batcher runs such members solo:
+        an unpark inside a merged dispatch could raise OutOfPages for the
+        whole group, failing sessions whose KV was resident all along."""
+        return any(sid in self._parked for sid in handle.seq_ids)
+
+    @_locked
     def epoch_valid(self, handle: "CacheHandle") -> bool:
         """True iff every sequence in `handle` still has servable KV: its
         validity epoch matches the current arena epoch (either no rebuild

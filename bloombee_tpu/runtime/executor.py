@@ -352,6 +352,37 @@ class SpanExecutor:
             depths=depths, fetch=fetch, adapter=adapter,
         )
 
+    def decode_group(
+        self,
+        handles: list[CacheHandle],
+        hiddens: list[np.ndarray],  # per-member [b_i, 1, D], same dtype
+        layers: tuple[int, int] | None = None,
+        adapter: str | None = None,
+    ):
+        """Row-stack several sessions' single-token decode steps into ONE
+        span dispatch (Orca-style continuous batching over the paged
+        arena: each row's attention reads only its own pages, so the
+        merged step is numerically identical to the members run alone).
+        The total row count shares `_step`'s pow2 batch bucketing, so the
+        merged widths hit the same compile cache as big single-session
+        batches.
+
+        KV writes are SPECULATIVE (commit=False): the caller commits the
+        combined handle only after the dispatch succeeds, so a failed
+        batch rolls back cleanly and can replay row-by-row without ghost
+        tokens in any member's page table.
+
+        Returns (out, combined_handle): `out` is the lazy [sum(b_i), 1, D]
+        device result (slice rows per member, fetch off-queue), and the
+        combined handle is what the caller commits or rolls back."""
+        combined = self.manager.combine_handles(handles)
+        hidden = np.concatenate(hiddens, axis=0)
+        out = self._step(
+            combined, hidden, commit=False, layers=layers, fetch=False,
+            adapter=adapter,
+        )
+        return out, combined
+
     def fetch(self, out) -> np.ndarray:
         """Materialize a fetch=False result on host in the wire dtype
         (blocks on the device round trip — call off the compute queue)."""

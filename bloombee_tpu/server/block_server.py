@@ -21,6 +21,7 @@ asyncio process:
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 import uuid
 
@@ -94,6 +95,17 @@ class _ChainError(RuntimeError):
     def __init__(self, msg: str, permanent: bool = False):
         super().__init__(msg)
         self.permanent = permanent
+
+
+@dataclasses.dataclass
+class _BatchMember:
+    """One session's single-token decode step inside a merged dispatch
+    (continuous batching). `handle` is the session's cache handle or a row
+    slice of it (micro-batch chunks batch like any other member)."""
+
+    session: "_Session"
+    handle: object
+    hidden: np.ndarray  # [b, 1, D] in the wire dtype
 
 
 class _Session:
@@ -179,6 +191,11 @@ class BlockServer:
         page_size: int = 16,
         compute_dtype=jnp.bfloat16,
         max_chunk_tokens: int = 512,
+        max_batch: int = 8,  # continuous batching: coalesce up to this
+        # many compatible single-token decode steps (across sessions) into
+        # one span dispatch; 1 disables the batcher. The gather window is
+        # BBTPU_BATCH_WINDOW_MS (default 0: only already-queued steps
+        # coalesce, so idle-server latency is untouched)
         announce_period: float = 5.0,
         alloc_timeout: float = 60.0,
         throughput: float = 1.0,
@@ -380,7 +397,8 @@ class BlockServer:
         # downstream spans (generous: the first chain step may hit a cold
         # XLA compile on a middle/tail span)
         self.chain_step_timeout = 120.0
-        self.compute = ComputeQueue()
+        self.max_batch = max(1, int(max_batch))
+        self.compute = ComputeQueue(max_group=self.max_batch)
         self.peers = _PeerPool()
         # server-side multi-step decode (decode_n): needs the checkpoint's
         # embed/norm/lm_head trio; lazy-loaded from model_dir on first use
@@ -415,6 +433,13 @@ class BlockServer:
         # "deadline_s") expired before/while we would compute it; surfaced
         # via rpc_info for operators and the chaos tests
         self.deadlines_expired = 0
+        # continuous-batching counters (rpc_info): member steps that shared
+        # a merged dispatch, merged dispatches issued, and batcher-routed
+        # steps that ran alone (width-1 pops, parked/stale-epoch members,
+        # row-by-row replays after a failed merged dispatch)
+        self.batched_steps = 0
+        self.batch_dispatches = 0
+        self.batch_solo_steps = 0
         self._kv_quant = kv_quant
         self._num_pages = num_pages
         self._adapter_dirs = adapter_dirs
@@ -843,6 +868,17 @@ class BlockServer:
             # drain flag (also visible as state=DRAINING in server_info)
             "deadlines_expired": self.deadlines_expired,
             "draining": self._draining,
+            # continuous-batching observability: how often concurrent
+            # sessions' decode steps shared one span dispatch, and how long
+            # steps sat in the compute queue (ms percentiles)
+            "batched_steps": self.batched_steps,
+            "batch_dispatches": self.batch_dispatches,
+            "batch_solo_steps": self.batch_solo_steps,
+            "mean_batch_width": (
+                self.batched_steps / self.batch_dispatches
+                if self.batch_dispatches else 0.0
+            ),
+            "queue_wait_ms": self.compute.wait_stats_ms(),
             # operator visibility into the decode_n fast paths: a client
             # falling back to per-step decoding is otherwise invisible.
             # decode_n: ANY single-span flavor (fused scan or host-driven
@@ -1141,18 +1177,33 @@ class BlockServer:
             if rows is not None:
                 commit_lens = commit_lens[rows[0]:rows[1]]
         try:
-            out_dev, t_dispatch_ms = await self.compute.submit(
-                PRIORITY_INFERENCE,
-                self._compute_step,
-                session,
-                handle,
-                hidden,
-                commit,
-                tree_mask,
-                depths,
-                commit_lens,
-                deadline=deadline,
-            )
+            if self._batchable(commit, hidden, tree_mask, depths,
+                               commit_lens):
+                # continuous batching: compatible single-token decode steps
+                # of OTHER sessions that are queued right now (or arrive
+                # within BBTPU_BATCH_WINDOW_MS) share one merged span
+                # dispatch; this call still returns only our own rows
+                out_dev, t_dispatch_ms = await self.compute.submit_group(
+                    PRIORITY_INFERENCE,
+                    ("decode1", session.layers, session.adapter,
+                     str(hidden.dtype)),
+                    _BatchMember(session, handle, hidden),
+                    self._compute_step_group,
+                    deadline=deadline,
+                )
+            else:
+                out_dev, t_dispatch_ms = await self.compute.submit(
+                    PRIORITY_INFERENCE,
+                    self._compute_step,
+                    session,
+                    handle,
+                    hidden,
+                    commit,
+                    tree_mask,
+                    depths,
+                    commit_lens,
+                    deadline=deadline,
+                )
         except DeadlineExpired:
             self._note_deadline_expired(meta, "while queued")
             return
@@ -1892,6 +1943,115 @@ class BlockServer:
                 session.id, hidden.shape[1], dt_ms,
             )
         return out, dt_ms
+
+    def _batchable(
+        self, commit, hidden, tree_mask, depths, commit_lens
+    ) -> bool:
+        """Whether this step may share a merged dispatch: plain committing
+        single-token decode only. Tree-verify steps, prefills, ragged
+        replays and speculative (commit=False) steps keep their own
+        compute task — their table side effects are per-session. A
+        draining server also stops coalescing: its sessions are winding
+        down and the simple per-step path keeps the drain predictable."""
+        return (
+            self.max_batch > 1
+            and hidden.shape[1] == 1
+            and tree_mask is None
+            and depths is None
+            and commit_lens is None
+            and commit
+            and not self._draining
+        )
+
+    def _compute_step_group(self, members: list[_BatchMember]) -> list:
+        """Runs on the compute thread: execute a group of compatible
+        single-token decode steps as ONE merged span dispatch. Returns one
+        outcome per member — (lazy out rows, dispatch_ms) or an Exception
+        instance, which the queue raises only at that member's caller.
+
+        Members whose KV can't safely join the merged dispatch (stale
+        epoch, host-parked) fall out to the solo path so their failure
+        modes stay their own; if the merged dispatch itself fails, its
+        speculative writes roll back and the group replays row-by-row, so
+        one member's fault never sinks its co-batched peers."""
+        results: list = [None] * len(members)
+        ready: list[int] = []
+        for i, m in enumerate(members):
+            if not self.manager.epoch_valid(m.handle):
+                results[i] = SessionKVLost(
+                    "server KV arena was rebuilt; session cache lost — "
+                    "replay"
+                )
+            elif self.manager.has_parked(m.handle):
+                # unparking inside a merged dispatch could OutOfPages the
+                # whole batch; alone, only this member wears the failure
+                results[i] = self._solo_member_step(m)
+            else:
+                ready.append(i)
+        if len(ready) == 1:
+            results[ready[0]] = self._solo_member_step(members[ready[0]])
+        elif ready:
+            group = [members[i] for i in ready]
+            try:
+                outs = self._dispatch_batched(group)
+            except Exception as e:
+                logger.warning(
+                    "batched decode of %d sessions failed (%r); "
+                    "replaying row-by-row", len(group), e,
+                )
+                outs = [self._solo_member_step(m) for m in group]
+            for i, out in zip(ready, outs):
+                results[i] = out
+        return results
+
+    def _solo_member_step(self, m: _BatchMember):
+        self.batch_solo_steps += 1
+        try:
+            return self._compute_step(
+                m.session, m.handle, m.hidden, True, None
+            )
+        except Exception as e:
+            return e
+
+    def _dispatch_batched(self, group: list[_BatchMember]) -> list:
+        """One row-stacked span dispatch for >= 2 sessions' decode steps.
+        KV writes go in speculatively and commit only after the dispatch
+        succeeds, so a failure rolls the whole group's tables back to the
+        pre-step state and the row-by-row replay appends no ghost tokens."""
+        import time
+
+        t0 = time.perf_counter()
+        now = time.monotonic()
+        for m in group:
+            m.session.last_step_at = now
+        handles = [m.handle for m in group]
+        try:
+            out, combined = self.executor.decode_group(
+                handles,
+                [m.hidden for m in group],
+                layers=group[0].session.layers,
+                adapter=group[0].session.adapter,
+            )
+        except Exception:
+            self.manager.rollback(self.manager.combine_handles(handles))
+            raise
+        self.manager.commit(combined)
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        self.batch_dispatches += 1
+        self.batched_steps += len(group)
+        if env.log_channel_enabled("timing"):
+            logger.info(
+                "[timing] batched decode: %d sessions, %d rows, "
+                "dispatch_ms=%.2f",
+                len(group), sum(m.handle.batch_size for m in group), dt_ms,
+            )
+        outs = []
+        row = 0
+        for m in group:
+            b = m.handle.batch_size
+            outs.append((out[row:row + b], dt_ms))
+            row += b
+        return outs
 
     def _reclaim_idle(self, need_pages: int, exclude_seq_ids: set) -> int:
         """Park idle sessions' KV (LRU by last step) until `need_pages` are

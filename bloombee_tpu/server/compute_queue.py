@@ -1,22 +1,47 @@
-"""Prioritized single-worker compute queue.
+"""Prioritized single-worker compute queue with decode-step coalescing.
 
 Role of the reference's PrioritizedTaskPool + hivemind Runtime
 (/root/reference/src/bloombee/server/task_pool.py:30-236, task_prioritizer.py):
 all device work funnels through one worker so steps execute one at a time
 (the TPU is a serial resource), inference outranks forward/backward, and the
 asyncio event loop never blocks on device compute.
+
+On top of that, the queue implements the gathering half of Orca-style
+continuous batching (Yu et al., OSDI'22): callers may submit *batchable*
+tasks (`submit_group`) carrying a compatibility key. When the worker pops
+one, it drains every already-queued task with the same key — plus any that
+arrive within the `BBTPU_BATCH_WINDOW_MS` gather window — and hands all
+their payloads to ONE `run_group` call on the compute thread, scattering
+the per-member outcomes back to each caller's future. With N concurrent
+decode sessions this turns N serialized span dispatches per round into one.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
+import dataclasses
+import functools
 import itertools
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable
+from typing import Any, Callable, Hashable
+
+from bloombee_tpu.utils import env
 
 PRIORITY_INFERENCE = 0.0  # reference DummyTaskPrioritizer: inference=1.0
 PRIORITY_TRAINING = 1.0  # beats forward/backward=2.0 — same ordering
+
+env.declare(
+    "BBTPU_BATCH_WINDOW_MS", float, 0.0,
+    "continuous-batching gather window: after popping a batchable decode "
+    "step the worker waits this long for more same-key steps before "
+    "dispatching (0 = coalesce only steps already queued, no added latency)",
+)
+
+# wait-time samples kept for the p50/p95 queue-wait estimate in rpc_info;
+# bounded so a long-lived server's stats track recent load, not its lifetime
+_WAIT_SAMPLES = 512
 
 
 class DeadlineExpired(RuntimeError):
@@ -25,14 +50,43 @@ class DeadlineExpired(RuntimeError):
     delay work somebody still wants."""
 
 
+@dataclasses.dataclass
+class _Task:
+    """A plain (non-batchable) unit of compute: one zero-arg callable."""
+
+    fn: Callable[[], Any]
+    fut: asyncio.Future
+    deadline: float | None  # time.monotonic() cutoff, checked at pop time
+    enqueued_at: float
+
+
+@dataclasses.dataclass
+class _GroupTask:
+    """One member of a batchable group. Tasks whose `key` compares equal
+    may be executed by a single `run_group([payload, ...])` call; the
+    callable must return one outcome per payload, in order (a returned
+    Exception instance fails just that member's future)."""
+
+    key: Hashable
+    payload: Any
+    run_group: Callable[[list], list]
+    fut: asyncio.Future
+    deadline: float | None
+    enqueued_at: float
+
+
 class ComputeQueue:
-    def __init__(self) -> None:
+    def __init__(self, max_group: int = 8) -> None:
         self._queue: asyncio.PriorityQueue = asyncio.PriorityQueue()
         self._seq = itertools.count()
         self._thread = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="compute"
         )
         self._worker_task: asyncio.Task | None = None
+        self.max_group = max(1, int(max_group))
+        self._waits: collections.deque = collections.deque(
+            maxlen=_WAIT_SAMPLES
+        )
 
     def start(self) -> None:
         self._worker_task = asyncio.create_task(self._worker())
@@ -40,7 +94,30 @@ class ComputeQueue:
     async def stop(self) -> None:
         if self._worker_task is not None:
             self._worker_task.cancel()
+        # fail everything still queued: a future that never resolves leaves
+        # its awaiter (a session handler) hanging forever on server shutdown
+        while True:
+            try:
+                _, _, task = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if not task.fut.done():
+                task.fut.cancel()
         self._thread.shutdown(wait=False, cancel_futures=True)
+
+    def wait_stats_ms(self) -> dict:
+        """p50/p95 of recent queue-wait times (submit -> worker pickup), in
+        milliseconds. Rough percentile over a bounded sample window — an
+        operator signal for "is the compute queue backed up", not a
+        benchmark."""
+        if not self._waits:
+            return {"p50": 0.0, "p95": 0.0}
+        xs = sorted(self._waits)
+
+        def pct(p: float) -> float:
+            return xs[min(len(xs) - 1, round(p * (len(xs) - 1)))] * 1000.0
+
+        return {"p50": pct(0.50), "p95": pct(0.95)}
 
     async def submit(
         self,
@@ -52,33 +129,165 @@ class ComputeQueue:
         **kwargs,
     ) -> Any:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._queue.put_nowait(
-            (priority, next(self._seq), deadline, fn, args, kwargs, fut)
+        task = _Task(
+            # bind fn/args NOW: a late-binding closure would capture the
+            # worker loop's variables, not this submission's
+            fn=functools.partial(fn, *args, **kwargs),
+            fut=fut,
+            deadline=deadline,
+            enqueued_at=time.monotonic(),
         )
+        self._queue.put_nowait((priority, next(self._seq), task))
+        return await fut
+
+    async def submit_group(
+        self,
+        priority: float,
+        key: Hashable,
+        payload: Any,
+        run_group: Callable[[list], list],
+        *,
+        deadline: float | None = None,
+    ) -> Any:
+        """Submit one member of a batchable group. All queued members whose
+        `key` equals this one's (arriving before the worker dispatches, or
+        within the gather window) execute as ONE `run_group` call; this
+        caller gets back its own member's outcome. Each member keeps its
+        own deadline — an expired member is dropped from the group with
+        DeadlineExpired, the rest still run."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        task = _GroupTask(
+            key=key,
+            payload=payload,
+            run_group=run_group,
+            fut=fut,
+            deadline=deadline,
+            enqueued_at=time.monotonic(),
+        )
+        self._queue.put_nowait((priority, next(self._seq), task))
         return await fut
 
     async def _worker(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
-            _, _, deadline, fn, args, kwargs, fut = await self._queue.get()
-            if fut.cancelled():
-                continue
-            if deadline is not None and time.monotonic() > deadline:
-                # checked at execution time, not submit time: a deep queue
-                # behind a slow step is exactly when expiry happens
-                if not fut.done():
-                    fut.set_exception(
-                        DeadlineExpired(
-                            "deadline passed while queued; dropping compute"
-                        )
-                    )
-                continue
+            _, _, task = await self._queue.get()
             try:
-                result = await loop.run_in_executor(
-                    self._thread, lambda: fn(*args, **kwargs)
+                if isinstance(task, _GroupTask):
+                    await self._run_group(loop, task)
+                else:
+                    await self._run_one(loop, task)
+            except asyncio.CancelledError:
+                # stop() cancelled us mid-task: the popped task is no
+                # longer in the queue, so stop()'s drain can't see it —
+                # resolve its future(s) here or the awaiter hangs
+                if not task.fut.done():
+                    task.fut.cancel()
+                raise
+
+    async def _run_one(self, loop, task: _Task) -> None:
+        if task.fut.cancelled():
+            return
+        self._note_wait(task)
+        if self._expired(task):
+            return
+        try:
+            result = await loop.run_in_executor(self._thread, task.fn)
+            if not task.fut.done():
+                task.fut.set_result(result)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if not task.fut.done():
+                task.fut.set_exception(e)
+
+    async def _run_group(self, loop, first: _GroupTask) -> None:
+        members = [first]
+        members += self._gather(first.key, self.max_group - len(members))
+        window_s = float(env.get("BBTPU_BATCH_WINDOW_MS")) / 1000.0
+        if window_s > 0 and len(members) < self.max_group:
+            # hold the device for one short window: steps of other sessions
+            # in the same decode round are typically in flight right now
+            await asyncio.sleep(window_s)
+            members += self._gather(first.key, self.max_group - len(members))
+        try:
+            live = []
+            for m in members:
+                if m.fut.cancelled():
+                    continue
+                self._note_wait(m)
+                if self._expired(m):
+                    continue
+                live.append(m)
+            if not live:
+                return
+            outcomes = await loop.run_in_executor(
+                self._thread,
+                functools.partial(
+                    first.run_group, [m.payload for m in live]
+                ),
+            )
+            if len(outcomes) != len(live):
+                raise RuntimeError(
+                    f"run_group returned {len(outcomes)} outcomes for "
+                    f"{len(live)} members"
                 )
-                if not fut.done():
-                    fut.set_result(result)
-            except Exception as e:
-                if not fut.done():
-                    fut.set_exception(e)
+        except asyncio.CancelledError:
+            for m in members:
+                if not m.fut.done():
+                    m.fut.cancel()
+            raise
+        except Exception as e:
+            # a failure of the group call itself (not a per-member outcome)
+            # fails every member; callers own their per-session recovery
+            for m in live:
+                if not m.fut.done():
+                    m.fut.set_exception(e)
+            return
+        for m, out in zip(live, outcomes):
+            if m.fut.done():
+                continue
+            if isinstance(out, BaseException):
+                m.fut.set_exception(out)
+            else:
+                m.fut.set_result(out)
+
+    def _gather(self, key: Hashable, limit: int) -> list[_GroupTask]:
+        """Pull up to `limit` queued group tasks matching `key`; everything
+        else goes back with its original (priority, seq) so ordering is
+        untouched."""
+        taken: list[_GroupTask] = []
+        keep: list = []
+        while True:
+            try:
+                entry = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            task = entry[2]
+            if (
+                len(taken) < limit
+                and isinstance(task, _GroupTask)
+                and task.key == key
+                and not task.fut.cancelled()
+            ):
+                taken.append(task)
+            else:
+                keep.append(entry)
+        for entry in keep:
+            self._queue.put_nowait(entry)
+        return taken
+
+    def _note_wait(self, task) -> None:
+        self._waits.append(time.monotonic() - task.enqueued_at)
+
+    def _expired(self, task) -> bool:
+        # checked at execution time, not submit time: a deep queue behind
+        # a slow step is exactly when expiry happens
+        if task.deadline is not None and time.monotonic() > task.deadline:
+            if not task.fut.done():
+                task.fut.set_exception(
+                    DeadlineExpired(
+                        "deadline passed while queued; dropping compute"
+                    )
+                )
+            return True
+        return False

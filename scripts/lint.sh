@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Lint gate: ruff over the package, tests, bench, and entry scripts.
+# Config lives in pyproject.toml ([tool.ruff]); run with --fix to apply
+# safe autofixes (e.g. deleting unused imports) in place.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if ! command -v ruff >/dev/null 2>&1 && ! python -m ruff --version >/dev/null 2>&1; then
+    echo "lint: ruff not installed; skipping (pip install ruff to enable)" >&2
+    exit 0
+fi
+
+RUFF=ruff
+command -v ruff >/dev/null 2>&1 || RUFF="python -m ruff"
+
+exec $RUFF check "$@" bloombee_tpu tests bench.py __graft_entry__.py

@@ -1,0 +1,369 @@
+"""ComputeQueue unit suite: priority ordering, deadline expiry while
+queued, caller cancellation, shutdown drain, and the continuous-batching
+group pop (coalescing, compatibility keys, gather window, per-member
+outcomes)."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from bloombee_tpu.server.compute_queue import (
+    PRIORITY_INFERENCE,
+    PRIORITY_TRAINING,
+    ComputeQueue,
+    DeadlineExpired,
+)
+
+
+def _jam(q):
+    """Occupy the single compute worker until the returned event is set,
+    so later submissions provably sit in the queue."""
+    gate = threading.Event()
+    task = asyncio.create_task(
+        q.submit(PRIORITY_INFERENCE, gate.wait, 5.0)
+    )
+    return gate, task
+
+
+# ------------------------------------------------------------ plain tasks
+def test_priority_ordering():
+    """Inference submitted AFTER training still runs first once the worker
+    frees up — the queue orders by priority, not arrival."""
+
+    async def run():
+        q = ComputeQueue()
+        q.start()
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)  # the jam is now on the worker thread
+        order = []
+        t_train = asyncio.create_task(
+            q.submit(PRIORITY_TRAINING, order.append, "train")
+        )
+        t_inf = asyncio.create_task(
+            q.submit(PRIORITY_INFERENCE, order.append, "inference")
+        )
+        await asyncio.sleep(0.05)
+        gate.set()
+        await asyncio.gather(jam, t_train, t_inf)
+        assert order == ["inference", "train"]
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_args_bound_at_submit_time():
+    """Each submission's fn/args bind when submitted (functools.partial),
+    so rapid-fire submissions can never see each other's arguments."""
+
+    async def run():
+        q = ComputeQueue()
+        q.start()
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        tasks = [
+            asyncio.create_task(q.submit(PRIORITY_INFERENCE, lambda x: x, i))
+            for i in range(8)
+        ]
+        gate.set()
+        results = await asyncio.gather(jam, *tasks)
+        assert results[1:] == list(range(8))
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_deadline_expires_while_queued():
+    """A task whose monotonic deadline passes while it waits behind a slow
+    step raises DeadlineExpired instead of running; in-budget work behind
+    it is unaffected."""
+
+    async def run():
+        q = ComputeQueue()
+        q.start()
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        ran = []
+        doomed = asyncio.create_task(
+            q.submit(PRIORITY_INFERENCE, ran.append, "doomed",
+                     deadline=time.monotonic() + 0.05)
+        )
+        healthy = asyncio.create_task(
+            q.submit(PRIORITY_INFERENCE, ran.append, "healthy",
+                     deadline=time.monotonic() + 60.0)
+        )
+        await asyncio.sleep(0.2)  # burn the doomed task's budget
+        gate.set()
+        await jam
+        with pytest.raises(DeadlineExpired):
+            await doomed
+        await healthy
+        assert ran == ["healthy"]
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_cancelled_caller_is_skipped():
+    """Cancelling the awaiting task while its work is queued drops the
+    work without poisoning the worker loop."""
+
+    async def run():
+        q = ComputeQueue()
+        q.start()
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        ran = []
+        victim = asyncio.create_task(
+            q.submit(PRIORITY_INFERENCE, ran.append, "victim")
+        )
+        await asyncio.sleep(0.05)
+        victim.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await victim
+        survivor = asyncio.create_task(
+            q.submit(PRIORITY_INFERENCE, ran.append, "survivor")
+        )
+        gate.set()
+        await asyncio.gather(jam, survivor)
+        assert ran == ["survivor"]
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_stop_drains_pending_futures():
+    """stop() must fail queued-but-unstarted work with CancelledError —
+    a future that never resolves would hang its awaiter (a session
+    handler) forever on server shutdown."""
+
+    async def run():
+        q = ComputeQueue()
+        q.start()
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        pending = [
+            asyncio.create_task(q.submit(PRIORITY_INFERENCE, lambda: 1))
+            for _ in range(3)
+        ]
+        await asyncio.sleep(0.05)
+        await q.stop()
+        gate.set()
+        for t in pending:
+            with pytest.raises(asyncio.CancelledError):
+                await asyncio.wait_for(t, timeout=5.0)
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------- group pop
+def test_group_coalesces_queued_members():
+    """Same-key batchable tasks queued while the worker is busy execute as
+    ONE run_group call; each caller gets its own member's outcome."""
+
+    async def run():
+        q = ComputeQueue(max_group=8)
+        q.start()
+        calls = []
+
+        def run_group(payloads):
+            calls.append(list(payloads))
+            return [p * 10 for p in payloads]
+
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        ts = [
+            asyncio.create_task(
+                q.submit_group(PRIORITY_INFERENCE, "k", i, run_group)
+            )
+            for i in range(4)
+        ]
+        await asyncio.sleep(0.05)
+        gate.set()
+        results = await asyncio.gather(jam, *ts)
+        assert results[1:] == [0, 10, 20, 30]
+        assert calls == [[0, 1, 2, 3]]
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_group_respects_max_group():
+    """More same-key members than max_group split into multiple dispatches
+    — none are dropped."""
+
+    async def run():
+        q = ComputeQueue(max_group=2)
+        q.start()
+        calls = []
+
+        def run_group(payloads):
+            calls.append(list(payloads))
+            return payloads
+
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        ts = [
+            asyncio.create_task(
+                q.submit_group(PRIORITY_INFERENCE, "k", i, run_group)
+            )
+            for i in range(5)
+        ]
+        await asyncio.sleep(0.05)
+        gate.set()
+        results = await asyncio.gather(jam, *ts)
+        assert results[1:] == [0, 1, 2, 3, 4]
+        assert [len(c) for c in calls] == [2, 2, 1]
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_group_keys_do_not_mix():
+    """Different compatibility keys (e.g. different adapters or dtypes)
+    never share a dispatch."""
+
+    async def run():
+        q = ComputeQueue(max_group=8)
+        q.start()
+        calls = []
+
+        def run_group(payloads):
+            calls.append(sorted(payloads))
+            return payloads
+
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        ts = [
+            asyncio.create_task(
+                q.submit_group(PRIORITY_INFERENCE, key, f"{key}{i}",
+                               run_group)
+            )
+            for i in range(2)
+            for key in ("a", "b")
+        ]
+        await asyncio.sleep(0.05)
+        gate.set()
+        await asyncio.gather(jam, *ts)
+        assert sorted(map(tuple, calls)) == [
+            ("a0", "a1"), ("b0", "b1"),
+        ]
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_group_member_exception_is_scattered():
+    """run_group returning an Exception instance for one member fails only
+    that member's future; the rest resolve normally."""
+
+    async def run():
+        q = ComputeQueue(max_group=8)
+        q.start()
+
+        def run_group(payloads):
+            return [
+                ValueError("bad row") if p == 1 else p for p in payloads
+            ]
+
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        ok = asyncio.create_task(
+            q.submit_group(PRIORITY_INFERENCE, "k", 0, run_group)
+        )
+        bad = asyncio.create_task(
+            q.submit_group(PRIORITY_INFERENCE, "k", 1, run_group)
+        )
+        await asyncio.sleep(0.05)
+        gate.set()
+        await jam
+        assert await ok == 0
+        with pytest.raises(ValueError, match="bad row"):
+            await bad
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_group_member_deadline_drops_only_that_member():
+    async def run():
+        q = ComputeQueue(max_group=8)
+        q.start()
+        calls = []
+
+        def run_group(payloads):
+            calls.append(list(payloads))
+            return payloads
+
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        doomed = asyncio.create_task(
+            q.submit_group(PRIORITY_INFERENCE, "k", "doomed", run_group,
+                           deadline=time.monotonic() + 0.05)
+        )
+        healthy = asyncio.create_task(
+            q.submit_group(PRIORITY_INFERENCE, "k", "healthy", run_group,
+                           deadline=time.monotonic() + 60.0)
+        )
+        await asyncio.sleep(0.2)
+        gate.set()
+        await jam
+        with pytest.raises(DeadlineExpired):
+            await doomed
+        assert await healthy == "healthy"
+        assert calls == [["healthy"]]
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_gather_window_catches_late_arrivals(monkeypatch):
+    """With BBTPU_BATCH_WINDOW_MS set, a member submitted shortly AFTER
+    the worker popped the first one still joins the same dispatch."""
+    monkeypatch.setenv("BBTPU_BATCH_WINDOW_MS", "250")
+
+    async def run():
+        q = ComputeQueue(max_group=8)
+        q.start()
+        calls = []
+
+        def run_group(payloads):
+            calls.append(list(payloads))
+            return payloads
+
+        first = asyncio.create_task(
+            q.submit_group(PRIORITY_INFERENCE, "k", "early", run_group)
+        )
+        await asyncio.sleep(0.05)  # worker popped "early", window open
+        second = asyncio.create_task(
+            q.submit_group(PRIORITY_INFERENCE, "k", "late", run_group)
+        )
+        assert await first == "early"
+        assert await second == "late"
+        assert calls == [["early", "late"]]
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_wait_stats_report_queue_time():
+    async def run():
+        q = ComputeQueue()
+        q.start()
+        assert q.wait_stats_ms() == {"p50": 0.0, "p95": 0.0}
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        waiter = asyncio.create_task(
+            q.submit(PRIORITY_INFERENCE, lambda: None)
+        )
+        await asyncio.sleep(0.15)
+        gate.set()
+        await asyncio.gather(jam, waiter)
+        stats = q.wait_stats_ms()
+        # the second task waited >= ~150 ms behind the jam
+        assert stats["p95"] >= 100.0
+        assert stats["p50"] >= 0.0
+        await q.stop()
+
+    asyncio.run(run())
