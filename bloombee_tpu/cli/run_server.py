@@ -97,6 +97,12 @@ def main(argv=None):
     parser.add_argument("--kv-quant", default=None,
                         choices=["none", "int4"],
                         help="KV cache quantization (int4 = ~3.2x capacity)")
+    parser.add_argument("--prefix-cache", action="store_true", default=None,
+                        help="share KV pages of common prompt prefixes "
+                             "across sessions (refcounted hash pool with "
+                             "copy-on-write; clients probe before prefill "
+                             "and ship only the uncached suffix). Default "
+                             "follows BBTPU_PREFIX_CACHE")
     parser.add_argument("--oversubscribe", type=float, default=1.0,
                         help="admit up to this x KV capacity; idle "
                         "sessions' KV parks to host under pressure")
@@ -176,6 +182,7 @@ def main(argv=None):
             weight_quant=args.weight_quant,
             oversubscribe=args.oversubscribe,
             idle_park_s=args.idle_park_s,
+            prefix_cache=args.prefix_cache,
             offload_layers=args.offload_layers,
             attn_sparsity=args.attn_sparsity,
             rebalance_period=(

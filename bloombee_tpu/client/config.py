@@ -48,3 +48,9 @@ class ClientConfig:
     # decline and the client falls back to per-step decoding
     server_decode: bool = False
     server_decode_chunk: int = 32
+    # shared-prefix KV cache: probe servers' page pools before the first
+    # prefill and ship only the uncached suffix (servers adopt pooled pages
+    # for the matched prefix — kv/paged.py hash pool). None defers to the
+    # BBTPU_PREFIX_CACHE env switch; servers with the cache off just report
+    # zero matches, so leaving this on against a mixed swarm is safe
+    prefix_cache: bool | None = None

@@ -189,6 +189,7 @@ class DistributedModelForCausalLM:
             ),
             embed_fn=self.embed,
             adapter=cfg.active_adapter,
+            prefix_cache=cfg.prefix_cache,
         )
 
     # --------------------------------------------------------------- generate

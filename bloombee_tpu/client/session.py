@@ -33,6 +33,11 @@ env.declare(
     "k+1 — the reference's BLOOMBEE_MICRO_BATCH_SIZE overlap)",
 )
 
+# the first no-embed_fn decode_n session in the process warns loudly; later
+# sessions demote to DEBUG (a bench tail spawning many raw sessions would
+# otherwise repeat the identical warning once per session)
+_warned_no_embed_process = False
+
 
 class DecodeNUnsupported(RuntimeError):
     """The server cannot run server-side multi-step decode for this session
@@ -83,6 +88,9 @@ class InferenceSession:
         microbatch: int | str | None = None,  # count or "auto"
         embed_fn=None,  # ids [B, T] -> hidden; enables token-id replay
         adapter: str | None = None,  # per-request LoRA adapter name
+        prefix_cache: bool | None = None,  # probe servers' shared-prefix
+        # pools before the first prefill and send only the uncached suffix
+        # (None -> BBTPU_PREFIX_CACHE env)
     ):
         self.manager = manager
         self.adapter = adapter
@@ -92,6 +100,10 @@ class InferenceSession:
         self.max_retries = max_retries
         self.step_timeout = step_timeout
         self.embed_fn = embed_fn
+        self.prefix_cache = (
+            env.get("BBTPU_PREFIX_CACHE") if prefix_cache is None
+            else bool(prefix_cache)
+        )
         # within-stage micro-batch pipelining (reference
         # microbatch_config.py:84-130 overlap-only mode): split each step's
         # batch into chunks so downstream spans start on chunk k while
@@ -160,6 +172,60 @@ class InferenceSession:
         )
         return _SpanSession(span, conn, stream, session_id)
 
+    # ----------------------------------------------------------- prefix cache
+    async def _probe_prefix(self, id_rows: list[list[int]]) -> int:
+        """Ask every span how much of each row's prompt its shared-prefix
+        pool already holds; returns the chain-wide skippable token count
+        (min over spans AND rows — every span receives the same suffix
+        hidden, so the chain can only skip what ALL of them have).
+
+        Spans that don't advertise a page size (cache off / old server)
+        force 0. Wire failures propagate as step errors so the caller's
+        retry loop rebuilds the chain — a timed-out probe must never leave
+        a stale reply queued on a reused stream."""
+        ps_list = [s.span.server_info.page_size for s in self._spans]
+        if not ps_list or any(ps <= 0 for ps in ps_list) or not any(id_rows):
+            # some span can't share (or nothing to hash): whole-chain miss
+            return 0
+        sizes = set(ps_list)
+        from bloombee_tpu.kv.prefix import page_hash_chain
+
+        chains_by_ps = {
+            ps: [page_hash_chain(row, ps) for row in id_rows]
+            for ps in sizes
+        }
+        step_id = self._step_counter
+        self._step_counter += 1
+        for s in self._spans:
+            chains = chains_by_ps[s.span.server_info.page_size]
+            await s.stream.send(
+                {"step": step_id, "prefix_probe": chains}, []
+            )
+        matched = None
+        for i, s in enumerate(self._spans):
+            try:
+                item = await asyncio.wait_for(
+                    s.stream.recv(), self.step_timeout
+                )
+            except (RpcError, OSError, asyncio.TimeoutError):
+                self.manager.ban_peer(s.span.peer_id)
+                raise
+            if item is None:
+                self.manager.ban_peer(s.span.peer_id)
+                raise RpcError(f"span {i} closed during prefix probe")
+            resp_meta, _ = item
+            _raise_if_session_lost(resp_meta)
+            span_min = min(
+                int(x) for x in resp_meta.get("prefix_matched") or [0]
+            )
+            matched = span_min if matched is None else min(matched, span_min)
+        # cap below the shortest row so the final prompt position always
+        # computes (the caller consumes its output) — ALSO the genuine
+        # divergence point: the uncached tail writes into the last shared
+        # page and copy-on-write splits it server-side
+        shortest = min(len(r) for r in id_rows)
+        return max(0, min(matched or 0, shortest - 1))
+
     # ------------------------------------------------------------------ steps
     async def step(
         self,
@@ -185,8 +251,29 @@ class InferenceSession:
                     return await self._step_pruned(
                         hidden, tree_mask, depths, prune, accept_per_span
                     )
+                # shared-prefix fast path: on the session's FIRST committed
+                # prefill, probe the chain's prefix pools and ship only the
+                # uncached suffix (the servers' KV for the skipped positions
+                # is adopted from pooled pages). The returned output covers
+                # only the suffix — callers consume the last position, which
+                # is always kept (the probe caps the skip below the prompt).
+                send_hidden, skip = hidden, None
+                if (
+                    self.prefix_cache
+                    and commit
+                    and tree_mask is None
+                    and ids is not None
+                    and self.position == 0
+                    and hidden.shape[1] > 1
+                ):
+                    skip = await self._probe_prefix(
+                        [list(map(int, row)) for row in np.asarray(ids)]
+                    )
+                    if skip:
+                        send_hidden = hidden[:, skip:]
                 out = await self._step_once(
-                    hidden, commit, tree_mask, depths, accept, commit_lens
+                    send_hidden, commit, tree_mask, depths, accept,
+                    commit_lens, prefix_skip=skip,
                 )
                 if commit and tree_mask is None:
                     if ids is not None and self.embed_fn is not None:
@@ -301,7 +388,7 @@ class InferenceSession:
 
     async def _step_once(
         self, hidden, commit, tree_mask, depths=None, accept=None,
-        commit_lens=None,
+        commit_lens=None, prefix_skip=None,
     ):
         if not self._spans:
             # a failed recovery left no open chain; surface as a retryable
@@ -324,6 +411,11 @@ class InferenceSession:
             meta_base["accept"] = [np.asarray(a).tolist() for a in accept]
         if commit_lens is not None:
             meta_base["commit_lens"] = [int(x) for x in commit_lens]
+        if prefix_skip is not None:
+            # settle the preceding probe: servers keep exactly this many
+            # adopted tokens per row (0 drops the adoption). Present on
+            # every mb chunk and relay forward via **meta_base.
+            meta_base["prefix_skip"] = int(prefix_skip)
         # ship hidden in the first span's advertised wire dtype (bf16 for
         # bf16-compute servers: half the bytes on the latency-critical hop)
         wire_dt = dtype_for_name(self._spans[0].span.server_info.wire_dtype)
@@ -503,9 +595,16 @@ class InferenceSession:
             # ids recorded without an embed_fn cannot be replayed: a later
             # transient transport failure becomes a hard RuntimeError in
             # _recover instead of a transparent re-route (fail-loud is
-            # intentional; the warning makes the trade visible up front)
+            # intentional; the warning makes the trade visible up front).
+            # WARNING once per process, DEBUG for later sessions — a bench
+            # tail spawning many raw sessions repeats the identical line
+            global _warned_no_embed_process
             self._warned_no_embed = True
-            logger.warning(
+            log = (
+                logger.debug if _warned_no_embed_process else logger.warning
+            )
+            _warned_no_embed_process = True
+            log(
                 "decode_n on a session without embed_fn: the session loses "
                 "failure recovery (id history cannot be re-embedded); use "
                 "model.inference_session() for recoverable decode"
@@ -759,9 +858,22 @@ class InferenceSession:
                 padded = np.zeros((self.batch_size, width), np.int64)
                 for i, r in enumerate(self._id_rows):
                     padded[i, : len(r)] = r
+                # a prior session (this one, before it failed) likely left
+                # its prompt pages in the servers' prefix pools — probe so
+                # the replay re-embeds and re-ships only the uncached
+                # suffix. Chains come from the RAGGED rows, never the
+                # padded rectangle: pad garbage must not hash-alias a
+                # pooled page of real zeros. commit_lens are absolute, so
+                # they need no adjustment for the adopted offset.
+                skip = 0
+                if self.prefix_cache:
+                    skip = await self._probe_prefix(
+                        [list(r) for r in self._id_rows]
+                    )
                 replay = self.embed_fn(padded)
                 await self._step_once(
-                    replay, commit=False, tree_mask=None, commit_lens=lens
+                    replay[:, skip:], commit=False, tree_mask=None,
+                    commit_lens=lens, prefix_skip=skip,
                 )
             elif self._history:
                 replay = np.concatenate(self._history, axis=1)

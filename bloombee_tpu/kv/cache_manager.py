@@ -50,6 +50,18 @@ env.declare(
     "quantized host parking, ~3.2x token capacity; reference "
     "compression.py TorchCompressedDevice)",
 )
+env.declare(
+    "BBTPU_PREFIX_CACHE", bool, False,
+    "cross-session shared-prefix KV cache: finished sequences' committed "
+    "prompt pages stay pooled under content hashes; new sessions whose "
+    "prompt chain matches adopt them and prefill only the suffix "
+    "(forces the pure-Python paged table)",
+)
+env.declare(
+    "BBTPU_PREFIX_MAX_PAGES", int, 0,
+    "cap on refcount-0 pages retained in the prefix pool "
+    "(0 = bounded only by allocation pressure / LRU eviction)",
+)
 
 
 class AllocationTimeout(RuntimeError):
@@ -180,14 +192,29 @@ class CacheManager:
         hetero_spec=None,  # ModelSpec with per-layer geometry (gemma-4)
         start_block: int = 0,
         oversubscribe: float = 1.0,  # admit up to this x capacity (parking)
+        prefix_cache: bool | None = None,  # None -> BBTPU_PREFIX_CACHE env
     ):
         dtype = dtype or jnp.bfloat16
         if quant is None:
             quant = env.get("BBTPU_KV_QUANT")
         self.quant = None if quant in (None, "none") else quant
+        if prefix_cache is None:
+            prefix_cache = env.get("BBTPU_PREFIX_CACHE")
+        self.prefix_cache = bool(prefix_cache)
         from bloombee_tpu.kv.paged_native import make_table
 
-        self.table = make_table(num_pages, page_size)
+        self.table = make_table(
+            num_pages, page_size, prefix_cache=self.prefix_cache
+        )
+        if self.prefix_cache:
+            self.table.max_cached_pages = env.get("BBTPU_PREFIX_MAX_PAGES")
+        # prefix-cache serving counters (rpc_info observability)
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
+        # probe-adopted token counts per seq, consumed by trim_adopted once
+        # the prefill's final skip arrives (also the idempotency guard: a
+        # retried prefill must not trim real committed tokens)
+        self._adopted: dict[int, int] = {}
         if hetero_spec is not None and hetero_spec.heterogeneous:
             from bloombee_tpu.runtime.hetero import make_hetero_arena
 
@@ -314,6 +341,7 @@ class CacheManager:
                         self.table.drop_seq(sid)
                     self._parked.pop(sid, None)
                     self._seq_epoch.pop(sid, None)
+                    self._adopted.pop(sid, None)
                     self._live_seqs.discard(sid)
             async with cond:
                 self._reserved_tokens -= need
@@ -350,12 +378,17 @@ class CacheManager:
                 f"batch write needs {need} pages, only "
                 f"{table.free_pages} free"
             )
-        return np.concatenate(
+        slots = np.concatenate(
             [
                 table.assign_write_slots(sid, num_tokens, commit=commit)
                 for sid in handle.seq_ids
             ]
         )
+        # copy-on-write pairs queued by the assigns must land on device
+        # BEFORE the step scatters into `slots` (dispatch order == device
+        # order, same guarantee parking relies on)
+        self._apply_pending_copies()
+        return slots
 
     def page_table(self, handle: CacheHandle, max_pages: int) -> np.ndarray:
         return self.table.page_table(handle.seq_ids, max_pages)
@@ -433,6 +466,98 @@ class CacheManager:
                 )
             self.unpark_sequence(sid)
 
+    # ------------------------------------------------------- prefix cache
+    def _apply_pending_copies(self) -> None:
+        """Drain the table's queued copy-on-write page pairs into one fused
+        device copy (the same gather+scatter jit the speculative accept
+        uses). Caller holds the lock (write_slots / write paths)."""
+        take = getattr(self.table, "take_pending_copies", None)
+        if take is None:
+            return
+        pairs = take()
+        if not pairs:
+            return
+        ps = self.page_size
+        offs = np.arange(ps, dtype=np.int64)
+        src = np.concatenate([s * ps + offs for s, _ in pairs])
+        dst = np.concatenate([d * ps + offs for _, d in pairs])
+        from bloombee_tpu.runtime.executor import next_pow2
+
+        n = next_pow2(len(src), floor=4)
+        oob = self.capacity_tokens  # out-of-bounds slot => dropped scatter
+        src_p = np.zeros((n,), np.int32)  # padded gathers read slot 0
+        dst_p = np.full((n,), oob, np.int32)  # padded scatters are dropped
+        src_p[: len(src)] = src
+        dst_p[: len(dst)] = dst
+        self.arena["k"], self.arena["v"] = _reorder_all_layers(
+            self.arena["k"], self.arena["v"],
+            jnp.asarray(src_p), jnp.asarray(dst_p),
+        )
+
+    @_locked
+    def adopt_prefix(self, handle: "CacheHandle", chains) -> list[int]:
+        """Map each row's longest pooled prompt prefix into its (empty)
+        sequence; returns per-row adopted token counts. Rows with no chain,
+        non-empty state, or a parked copy adopt nothing. Adopted pages are
+        refcount-pinned until the prefill's trim_adopted settles the final
+        skip — or session teardown drops them."""
+        out: list[int] = []
+        for sid, chain in zip(handle.seq_ids, chains):
+            matched = 0
+            if (
+                self.prefix_cache
+                and chain
+                and sid not in self._parked
+                and hasattr(self.table, "adopt_prefix")
+            ):
+                st = self.table.seq(sid)
+                if not (st.l_seq or st.l_acc or st.pages):
+                    matched = self.table.adopt_prefix(
+                        sid, chain, max_tokens=handle.max_length
+                    )
+                    if matched:
+                        self._adopted[sid] = matched
+                elif st.hashes is None:
+                    # active seq (e.g. a retried probe): just attach the
+                    # chain so its committed pages publish
+                    self.table.set_seq_hashes(sid, chain)
+            out.append(matched)
+        return out
+
+    @_locked
+    def trim_adopted(self, handle: "CacheHandle", keep_tokens: int) -> None:
+        """Settle a probe: shrink each adopted prefix to the chain-wide
+        skip the client actually uses (min across spans, capped below the
+        prompt length so the last position still computes) and record the
+        hit. Idempotent — only sequences with an outstanding adoption are
+        touched, so a retried prefill can't trim real tokens."""
+        for sid in handle.seq_ids:
+            adopted = self._adopted.pop(sid, None)
+            if adopted is None:
+                continue
+            kept = min(keep_tokens, adopted)
+            if kept < adopted:
+                self.table.trim_adopted(sid, kept)
+            if kept > 0:
+                self.prefix_hits += 1
+                self.prefix_hit_tokens += kept
+
+    def has_adopted(self, handle: "CacheHandle") -> bool:
+        """True while a probe's adoption awaits its prefill's settle."""
+        return any(sid in self._adopted for sid in handle.seq_ids)
+
+    @_locked
+    def prefix_stats(self) -> dict:
+        """Prefix-cache observability for rpc_info."""
+        return {
+            "prefix_hits": int(self.prefix_hits),
+            "prefix_hit_tokens": int(self.prefix_hit_tokens),
+            "cow_copies": int(getattr(self.table, "cow_count", 0)),
+            "prefix_cached_pages": int(
+                getattr(self.table, "cached_pages", 0)
+            ),
+        }
+
     # ------------------------------------------------------- host tiering
     @_locked
     def park_sequence(self, seq_id: int, tier: str = "host") -> None:
@@ -458,6 +583,11 @@ class CacheManager:
         if tier not in ("host", "disk"):
             # before the expensive d2h copy, not after
             raise ValueError(f"unknown park tier {tier!r}")
+        if seq_id in self._adopted:
+            # probe-adopted, prefill imminent: parking now would record the
+            # un-trimmed adopted length and desync the client's suffix
+            # offset on unpark — skip; the reclaimer finds other victims
+            return
         slots = self.table.prefix_slots(seq_id, committed_only=False)
         state = self.table.seq(seq_id)
 
@@ -585,6 +715,11 @@ class CacheManager:
         for sid in list(self._live_seqs):
             if self.table.has_seq(sid) and sid not in self._parked:
                 self.table.reset_seq(sid)
+        # pooled pages describe the OLD arena's bytes — a hit against them
+        # would serve garbage KV
+        if hasattr(self.table, "invalidate_pool"):
+            self.table.invalidate_pool()
+        self._adopted.clear()
         self.arena = self._make_arena()
         self.arena_epoch += 1
         for sid in self._parked:

@@ -16,6 +16,7 @@ step scatters into (see bloombee_tpu/kv/arena.py). The reference's
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 
 import numpy as np
@@ -32,6 +33,12 @@ class SeqState:
     pages: list[int]
     l_acc: int = 0  # committed token count
     l_seq: int = 0  # total written (committed + speculative)
+    # prefix-cache identity: chained page hashes of this sequence's prompt
+    # (kv/prefix.py) and how many leading pages have been offered to the
+    # shared pool so far (publication is monotone per seq, clamped when the
+    # committed prefix shrinks)
+    hashes: list[str] | None = None
+    published: int = 0
 
     @property
     def num_pages(self) -> int:
@@ -39,7 +46,20 @@ class SeqState:
 
 
 class PagedKVTable:
-    """Page allocator + per-sequence length bookkeeping (host side)."""
+    """Page allocator + per-sequence length bookkeeping (host side).
+
+    Prefix-cache extension (vLLM block sharing + SGLang-style reuse): every
+    page carries a refcount; fully-committed pages whose content hash is
+    known are *published* into a hash-indexed pool. When the last reference
+    drops, a published page parks in an LRU of reclaimable cached pages
+    instead of the free list — a later sequence whose prompt chain matches
+    adopts it (refcount back up, prefill skipped), while allocation pressure
+    evicts from the LRU's cold end. A write into a page that is shared
+    (ref > 1) or still advertised in the pool triggers copy-on-write: the
+    writer gets a fresh page and the (src, dst) pair is queued for the
+    device-side page copy (drained by CacheManager before the step's
+    scatter lands).
+    """
 
     def __init__(self, num_pages: int, page_size: int = DEFAULT_PAGE_SIZE):
         if num_pages <= 0 or page_size <= 0:
@@ -48,15 +68,35 @@ class PagedKVTable:
         self.page_size = page_size
         self._free: list[int] = list(range(num_pages - 1, -1, -1))
         self._seqs: dict[int, SeqState] = {}
+        # prefix-cache state. _pool and _page_hash are exact inverses:
+        # _pool[h] == p  <=>  _page_hash[p] == h. _lru holds refcount-0
+        # published pages, oldest-released first (eviction order).
+        self._ref: list[int] = [0] * num_pages
+        self._pool: dict[str, int] = {}
+        self._page_hash: dict[int, str] = {}
+        self._lru: collections.OrderedDict[int, None] = (
+            collections.OrderedDict()
+        )
+        self._pending_copies: list[tuple[int, int]] = []
+        self.cow_count = 0
+        # optional cap on the cached pool (BBTPU_PREFIX_MAX_PAGES); 0 = no
+        # cap beyond what allocation pressure evicts naturally
+        self.max_cached_pages = 0
 
     # ------------------------------------------------------------- lifecycle
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        """Allocatable pages: truly free + reclaimable cached (LRU)."""
+        return len(self._free) + len(self._lru)
 
     @property
     def free_tokens(self) -> int:
-        return len(self._free) * self.page_size
+        return self.free_pages * self.page_size
+
+    @property
+    def cached_pages(self) -> int:
+        """Refcount-0 pages held in the prefix pool (LRU-evictable)."""
+        return len(self._lru)
 
     def has_seq(self, seq_id: int) -> bool:
         return seq_id in self._seqs
@@ -71,11 +111,49 @@ class PagedKVTable:
 
     def drop_seq(self, seq_id: int) -> None:
         state = self._seqs.pop(seq_id)
-        self._free.extend(state.pages)
+        for page in state.pages:
+            self._release_page(page)
 
     # ------------------------------------------------------------ allocation
     def _pages_for(self, tokens: int) -> int:
         return -(-tokens // self.page_size)
+
+    def _alloc_page(self) -> int:
+        """One refcount-1 page: free list first, else evict the coldest
+        cached page (de-publishing it — its content is about to be
+        overwritten)."""
+        if self._free:
+            page = self._free.pop()
+        elif self._lru:
+            page, _ = self._lru.popitem(last=False)
+            self._unpublish(page)
+        else:
+            raise OutOfPages("no free or cached pages left")
+        self._ref[page] = 1
+        return page
+
+    def _release_page(self, page: int) -> None:
+        """Drop one reference; at zero, published pages park in the cached
+        LRU (warm for future adoption), unpublished pages free."""
+        self._ref[page] -= 1
+        assert self._ref[page] >= 0, f"page {page} refcount underflow"
+        if self._ref[page] > 0:
+            return
+        if page in self._page_hash:
+            self._lru[page] = None
+            self._lru.move_to_end(page)
+            if self.max_cached_pages > 0:
+                while len(self._lru) > self.max_cached_pages:
+                    cold, _ = self._lru.popitem(last=False)
+                    self._unpublish(cold)
+                    self._free.append(cold)
+        else:
+            self._free.append(page)
+
+    def _unpublish(self, page: int) -> None:
+        h = self._page_hash.pop(page, None)
+        if h is not None:
+            del self._pool[h]
 
     def reserve(self, seq_id: int, new_total_len: int) -> None:
         """Grow the sequence's page list to cover `new_total_len` tokens."""
@@ -83,12 +161,12 @@ class PagedKVTable:
         need = self._pages_for(new_total_len) - len(state.pages)
         if need <= 0:
             return
-        if need > len(self._free):
+        if need > self.free_pages:
             raise OutOfPages(
-                f"need {need} pages, only {len(self._free)} free"
+                f"need {need} pages, only {self.free_pages} free"
             )
         for _ in range(need):
-            state.pages.append(self._free.pop())
+            state.pages.append(self._alloc_page())
 
     # --------------------------------------------------------------- writing
     def assign_write_slots(
@@ -112,6 +190,40 @@ class PagedKVTable:
                 "committed write must follow the committed prefix "
                 f"(l_acc={state.l_acc}, write starts at {start})"
             )
+        # copy-on-write: a write landing in a page that is shared (ref > 1)
+        # or still advertised in the prefix pool must not mutate the shared
+        # bytes — swap in a private copy first. Checked against the full
+        # availability (reserve need + cow need) so a mid-batch OutOfPages
+        # cannot leave the sequence half-diverged.
+        cow_idx: list[int] = []
+        if num_tokens > 0 and state.pages:
+            first = start // self.page_size
+            last = (start + num_tokens - 1) // self.page_size
+            for i in range(first, min(last + 1, len(state.pages))):
+                page = state.pages[i]
+                if self._ref[page] > 1 or page in self._page_hash:
+                    cow_idx.append(i)
+        need = max(
+            0, self._pages_for(start + num_tokens) - len(state.pages)
+        )
+        if need + len(cow_idx) > self.free_pages:
+            raise OutOfPages(
+                f"need {need + len(cow_idx)} pages "
+                f"({len(cow_idx)} copy-on-write), only "
+                f"{self.free_pages} free"
+            )
+        for i in cow_idx:
+            src = state.pages[i]
+            dst = self._alloc_page()
+            self._pending_copies.append((src, dst))
+            state.pages[i] = dst
+            self._release_page(src)
+            self.cow_count += 1
+            # the diverged copy no longer matches the hash chain from this
+            # page on: truncate so it can never be (re)published stale
+            if state.hashes is not None and i < len(state.hashes):
+                state.hashes = state.hashes[:i]
+            state.published = min(state.published, i)
         self.reserve(seq_id, start + num_tokens)
         positions = np.arange(start, start + num_tokens)
         pages = np.asarray(state.pages, dtype=np.int64)[
@@ -121,6 +233,7 @@ class PagedKVTable:
         state.l_seq = start + num_tokens
         if commit:
             state.l_acc = state.l_seq
+            self._publish(state)
         return slots.astype(np.int32)
 
     # ------------------------------------------------------ commit / rollback
@@ -140,6 +253,7 @@ class PagedKVTable:
         state.l_acc = length
         state.l_seq = length
         self._trim(state)
+        self._publish(state)
 
     def accept(self, seq_id: int, num_accepted: int) -> None:
         """Keep the first `num_accepted` speculative tokens (after the caller
@@ -153,6 +267,7 @@ class PagedKVTable:
         state.l_acc += num_accepted
         state.l_seq = state.l_acc
         self._trim(state)
+        self._publish(state)
 
     def range_slots(self, seq_id: int, start: int, end: int) -> np.ndarray:
         """Flat slot ids for positions [start, end) (must be materialized)."""
@@ -191,11 +306,127 @@ class PagedKVTable:
                 f"l_acc {l_acc} outside [0, {state.l_seq}]"
             )
         state.l_acc = l_acc
+        self._publish(state)
 
     def _trim(self, state: SeqState) -> None:
         keep = self._pages_for(max(state.l_seq, state.l_acc))
         while len(state.pages) > keep:
-            self._free.append(state.pages.pop())
+            self._release_page(state.pages.pop())
+        state.published = min(
+            state.published, state.l_acc // self.page_size
+        )
+
+    # ---------------------------------------------------------- prefix cache
+    def set_seq_hashes(self, seq_id: int, hashes: list[str]) -> None:
+        """Attach the prompt's page-hash chain (kv/prefix.py) so this
+        sequence's fully-committed prompt pages get published to the pool
+        as they commit."""
+        self._seqs[seq_id].hashes = list(hashes)
+
+    def _publish(self, state: SeqState) -> None:
+        """Offer newly fully-committed hash-covered pages to the pool.
+
+        A hash already pooled (another copy of the same content) is skipped
+        — the pool keeps one canonical page per chain hash. `published` is
+        monotone per call so retried commits don't re-offer."""
+        if state.hashes is None:
+            return
+        limit = min(state.l_acc // self.page_size, len(state.hashes))
+        for i in range(state.published, limit):
+            h = state.hashes[i]
+            page = state.pages[i]
+            if h not in self._pool and page not in self._page_hash:
+                self._pool[h] = page
+                self._page_hash[page] = h
+        state.published = max(state.published, limit)
+
+    def match_prefix(self, hashes: list[str]) -> int:
+        """Tokens of the chain currently servable from the pool (a probe —
+        no state change; adoption may still race an eviction)."""
+        n = 0
+        for h in hashes:
+            if h not in self._pool:
+                break
+            n += 1
+        return n * self.page_size
+
+    def adopt_prefix(
+        self, seq_id: int, hashes: list[str], max_tokens: int | None = None
+    ) -> int:
+        """Map the longest pooled prefix of `hashes` into an EMPTY sequence.
+
+        Adopted pages are refcounted up (pulled out of the LRU — pinned
+        against eviction until released) and the sequence starts life with
+        a committed prefix of the returned token count. The chain is kept so
+        pages this sequence computes itself get published in turn.
+        """
+        state = self._seqs[seq_id]
+        if state.pages or state.l_seq or state.l_acc:
+            raise ValueError("adopt_prefix target must be empty")
+        state.hashes = list(hashes)
+        max_pages = (
+            len(hashes) if max_tokens is None
+            else min(len(hashes), max_tokens // self.page_size)
+        )
+        n = 0
+        for i in range(max_pages):
+            page = self._pool.get(hashes[i])
+            if page is None:
+                break
+            state.pages.append(page)
+            self._ref[page] += 1
+            self._lru.pop(page, None)
+            n += 1
+        tokens = n * self.page_size
+        state.l_acc = tokens
+        state.l_seq = tokens
+        state.published = n
+        return tokens
+
+    def trim_adopted(self, seq_id: int, keep_tokens: int) -> None:
+        """Shrink an adopted (still-unwritten) committed prefix to
+        `keep_tokens` — the span chain agreed on a smaller common hit, or
+        the client keeps the last prompt position uncached so the final
+        step has an output. No-op when already at or below the target."""
+        state = self._seqs[seq_id]
+        if keep_tokens < 0:
+            raise ValueError(f"keep_tokens must be >= 0, got {keep_tokens}")
+        if keep_tokens >= state.l_acc or state.l_seq != state.l_acc:
+            return
+        state.l_acc = keep_tokens
+        state.l_seq = keep_tokens
+        self._trim(state)
+
+    def take_pending_copies(self) -> list[tuple[int, int]]:
+        """Drain queued copy-on-write (src_page, dst_page) pairs; the
+        caller applies the device copies before the write that triggered
+        them scatters."""
+        out = self._pending_copies
+        self._pending_copies = []
+        return out
+
+    def invalidate_pool(self) -> None:
+        """Forget every cached page (arena rebuilt — device bytes are
+        garbage). Cached LRU pages drop to the free list; referenced pages
+        just lose their pool identity."""
+        for page in self._lru:
+            self._free.append(page)
+        self._lru.clear()
+        self._pool.clear()
+        self._page_hash.clear()
+        for state in self._seqs.values():
+            state.published = 0
+            state.hashes = None
+
+    def counts(self) -> dict:
+        """Page accounting for the leak invariant:
+        free + referenced + cached == num_pages."""
+        referenced = sum(1 for r in self._ref if r > 0)
+        return {
+            "free": len(self._free),
+            "referenced": referenced,
+            "cached": len(self._lru),
+        }
 
     # ---------------------------------------------------------- device plans
     def page_table(

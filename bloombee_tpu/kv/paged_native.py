@@ -217,9 +217,18 @@ class NativePagedKVTable:
         return self.range_slots(seq_id, 0, n)
 
 
-def make_table(num_pages: int, page_size: int = DEFAULT_PAGE_SIZE):
-    """The serving table: native when available and enabled, else Python."""
-    if env.get("BBTPU_NATIVE_TABLE"):
+def make_table(
+    num_pages: int,
+    page_size: int = DEFAULT_PAGE_SIZE,
+    prefix_cache: bool = False,
+):
+    """The serving table: native when available and enabled, else Python.
+
+    The prefix cache (refcounts, hash pool, copy-on-write) lives only in
+    the Python table — enabling it forces the Python implementation even
+    when the native one would build.
+    """
+    if not prefix_cache and env.get("BBTPU_NATIVE_TABLE"):
         try:
             return NativePagedKVTable(num_pages, page_size)
         except Exception as e:

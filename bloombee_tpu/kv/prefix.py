@@ -1,0 +1,45 @@
+"""Page-aligned content hash chains for cross-session prefix sharing.
+
+The chain is the identity of a cached KV page: page i's hash covers its own
+token ids AND the parent page's hash, so equal hashes imply equal *full
+prefixes*, not just equal page contents (SGLang's RadixAttention collapses
+the same property into a trie; a chained flat list is equivalent for the
+page-granular pool in kv/paged.py and is trivially wire-serializable).
+
+Shared by the client (hash computation over the prompt), the server
+(pool lookup + adoption), the bench, and the tests — one definition so a
+version skew shows up as a clean cache miss, never a wrong hit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+# bumped whenever the hash layout changes: a stale client's chains must
+# miss, not alias, a newer server's pool
+_CHAIN_VERSION = b"bbtpu-prefix-v1"
+
+
+def page_hash_chain(ids, page_size: int) -> list[str]:
+    """Chained hashes of the *full* pages of one row of token ids.
+
+    Returns one hex digest per complete page (a trailing partial page gets
+    no hash — it cannot be shared, its content is still growing). Token ids
+    are canonicalized to int64 so the same prompt hashes identically
+    whatever integer dtype the caller tokenized into.
+    """
+    if page_size <= 0:
+        raise ValueError(f"page_size must be positive, got {page_size}")
+    row = np.asarray(ids).reshape(-1).astype(np.int64)
+    chain: list[str] = []
+    parent = _CHAIN_VERSION
+    for p in range(len(row) // page_size):
+        page = row[p * page_size : (p + 1) * page_size]
+        digest = hashlib.blake2b(
+            parent + page.tobytes(), digest_size=16
+        ).hexdigest()
+        chain.append(digest)
+        parent = digest.encode("ascii")
+    return chain

@@ -227,6 +227,10 @@ class BlockServer:
         # from host per step (FlexGen weight-offload: serve spans larger
         # than HBM; combine with --weight-quant to shrink the streamed
         # bytes 2-4x)
+        prefix_cache: bool | None = None,  # cross-session shared-prefix KV
+        # cache: pool committed prompt pages under content hashes, adopt
+        # them into matching sessions, prefill only the suffix
+        # (None -> BBTPU_PREFIX_CACHE env; forces the Python paged table)
     ):
         self.model_dir = model_dir
         if weight_quant is None:
@@ -348,6 +352,7 @@ class BlockServer:
             hetero_spec=spec if spec.heterogeneous else None,
             start_block=start,
             oversubscribe=oversubscribe,
+            prefix_cache=prefix_cache,
         )
         self.idle_park_s = idle_park_s
         if oversubscribe > 1.0:
@@ -726,6 +731,7 @@ class BlockServer:
                 quant=self._kv_quant,
                 start_block=start,
                 oversubscribe=self.manager.oversubscribe,
+                prefix_cache=self.manager.prefix_cache,
             )
             if self.manager.reclaimer is not None:
                 manager.reclaimer = self._reclaim_idle
@@ -774,6 +780,11 @@ class BlockServer:
             next_pings=self.next_pings.to_wire() or None,
             adapters=sorted(self.adapter_factors) or None,
             decode_n_max=self.decode_n_max,
+            # clients need the page geometry to build prefix hash chains
+            # (0 advertises "no prefix cache here")
+            page_size=(
+                self.manager.page_size if self.manager.prefix_cache else 0
+            ),
         )
 
     async def _announce(self, state: ServerState) -> None:
@@ -879,6 +890,10 @@ class BlockServer:
                 if self.batch_dispatches else 0.0
             ),
             "queue_wait_ms": self.compute.wait_stats_ms(),
+            # prefix-cache observability: sessions that adopted pooled
+            # prompt pages, tokens they skipped prefilling, copy-on-write
+            # page splits, and current cached-pool occupancy
+            **self.manager.prefix_stats(),
             # operator visibility into the decode_n fast paths: a client
             # falling back to per-step decoding is otherwise invisible.
             # decode_n: ANY single-span flavor (fused scan or host-driven
@@ -1102,6 +1117,18 @@ class BlockServer:
                 }
             )
             return
+        probe = meta.get("prefix_probe")
+        if probe is not None:
+            # prefix-cache probe: adopt each row's longest pooled prompt
+            # prefix NOW (refcount-pinning the pages against eviction) and
+            # report the per-row hit; the client follows up with the
+            # chain-wide skip on its prefill. Pure host-side table work —
+            # no reason to wait behind the compute queue.
+            matched = self.manager.adopt_prefix(session.handle, probe)
+            await stream.send(
+                {"step": meta.get("step"), "prefix_matched": matched}
+            )
+            return
         # speculative accept from the previous round: compact surviving KV
         # rows onto the committed prefix before this step's compute
         accept = meta.get("accept")
@@ -1178,7 +1205,7 @@ class BlockServer:
                 commit_lens = commit_lens[rows[0]:rows[1]]
         try:
             if self._batchable(commit, hidden, tree_mask, depths,
-                               commit_lens):
+                               commit_lens, meta.get("prefix_skip")):
                 # continuous batching: compatible single-token decode steps
                 # of OTHER sessions that are queued right now (or arrive
                 # within BBTPU_BATCH_WINDOW_MS) share one merged span
@@ -1202,6 +1229,7 @@ class BlockServer:
                     tree_mask,
                     depths,
                     commit_lens,
+                    meta.get("prefix_skip"),
                     deadline=deadline,
                 )
         except DeadlineExpired:
@@ -1270,7 +1298,7 @@ class BlockServer:
                 "reply": reply,
                 "route": route[1:],
             }
-            for key in ("mb", "mb_of", "rows", "commit_lens"):
+            for key in ("mb", "mb_of", "rows", "commit_lens", "prefix_skip"):
                 if meta.get(key) is not None:
                     push_meta[key] = meta[key]
             if meta.get("tree"):
@@ -1903,7 +1931,7 @@ class BlockServer:
 
     def _compute_step(
         self, session: _Session, handle, hidden, commit, tree_mask,
-        depths=None, commit_lens=None,
+        depths=None, commit_lens=None, prefix_skip=None,
     ):
         """Runs on the compute thread: plan packing + async device dispatch
         only (the d2h fetch happens off-queue in _run_step). The dispatch
@@ -1923,6 +1951,17 @@ class BlockServer:
             )
         session.last_step_at = time.monotonic()
         t0 = time.perf_counter()
+        if self.manager.has_adopted(handle):
+            # settle an outstanding probe adoption: unpark first so the
+            # trim acts on live lengths, then shrink each row's adopted
+            # prefix to the chain-wide skip the client actually uses. A
+            # step that never declares prefix_skip drops the adoption
+            # entirely (skip 0) — the safe interpretation of a client that
+            # changed its mind (or a stale retry).
+            self.manager.ensure_resident(handle)
+            self.manager.trim_adopted(
+                handle, int(prefix_skip or 0)
+            )
         if hidden.shape[1] > 1 and tree_mask is None:
             out = self.executor.prefill(
                 handle, hidden, commit=commit, layers=session.layers,
@@ -1945,20 +1984,25 @@ class BlockServer:
         return out, dt_ms
 
     def _batchable(
-        self, commit, hidden, tree_mask, depths, commit_lens
+        self, commit, hidden, tree_mask, depths, commit_lens,
+        prefix_skip=None,
     ) -> bool:
         """Whether this step may share a merged dispatch: plain committing
         single-token decode only. Tree-verify steps, prefills, ragged
         replays and speculative (commit=False) steps keep their own
-        compute task — their table side effects are per-session. A
-        draining server also stops coalescing: its sessions are winding
-        down and the simple per-step path keeps the drain predictable."""
+        compute task — their table side effects are per-session. A step
+        declaring prefix_skip is a suffix PREFILL even at one token (a
+        warm prefix hit can shrink the uncached tail that far) and must
+        settle its adoption on the solo path. A draining server also
+        stops coalescing: its sessions are winding down and the simple
+        per-step path keeps the drain predictable."""
         return (
             self.max_batch > 1
             and hidden.shape[1] == 1
             and tree_mask is None
             and depths is None
             and commit_lens is None
+            and prefix_skip is None
             and commit
             and not self._draining
         )
@@ -1982,9 +2026,12 @@ class BlockServer:
                     "server KV arena was rebuilt; session cache lost — "
                     "replay"
                 )
-            elif self.manager.has_parked(m.handle):
+            elif (self.manager.has_parked(m.handle)
+                  or self.manager.has_adopted(m.handle)):
                 # unparking inside a merged dispatch could OutOfPages the
-                # whole batch; alone, only this member wears the failure
+                # whole batch; alone, only this member wears the failure.
+                # An unsettled prefix adoption likewise needs the solo
+                # path: _compute_step drops it (skip 0) before computing
                 results[i] = self._solo_member_step(m)
             else:
                 ready.append(i)

@@ -46,6 +46,10 @@ class ServerInfo:
     # this BEFORE the first call (a larger chunk would be declined and
     # silently cost the whole fast path — advisor, round 4)
     decode_n_max: int | None = None
+    # KV page size when this server runs the shared-prefix cache (clients
+    # build page-aligned hash chains from it); 0 = no prefix cache, don't
+    # probe. Unknown-field filtering in from_wire keeps old peers happy.
+    page_size: int = 0
 
     def to_wire(self) -> dict:
         d = dataclasses.asdict(self)

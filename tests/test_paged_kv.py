@@ -185,6 +185,166 @@ def test_paged_table_fuzz_against_model():
         assert len(owned) + table.free_pages == num_pages
 
 
+def test_prefix_cache_fuzz_page_accounting():
+    """Randomized op sequences INCLUDING the prefix-cache ops (hash
+    publication, adoption, copy-on-write, LRU eviction, pool invalidation):
+    after EVERY op, free + referenced + cached == num_pages, the pool and
+    its inverse index agree exactly, and every page owned by a live
+    sequence holds a positive refcount."""
+    from bloombee_tpu.kv.prefix import page_hash_chain
+
+    rng = np.random.default_rng(3)
+    for trial in range(15):
+        num_pages = int(rng.integers(6, 16))
+        page_size = int(rng.integers(2, 5))
+        table = PagedKVTable(num_pages, page_size)
+        if rng.integers(0, 2):
+            table.max_cached_pages = int(rng.integers(1, num_pages))
+        # a small prompt set so adoptions genuinely hit pooled pages
+        prompts = [
+            rng.integers(
+                0, 50, size=int(rng.integers(page_size, 6 * page_size))
+            ).tolist()
+            for _ in range(3)
+        ]
+        chains = [page_hash_chain(p, page_size) for p in prompts]
+        live: list[int] = []
+        next_sid = 0
+
+        def check(op, table=table, live=live, num_pages=num_pages,
+                  trial=trial):
+            c = table.counts()
+            assert (
+                c["free"] + c["referenced"] + c["cached"] == num_pages
+            ), (trial, op, c)
+            assert table.free_pages == c["free"] + c["cached"], (trial, op)
+            assert (
+                {p: h for h, p in table._pool.items()} == table._page_hash
+            ), (trial, op)
+            owned = [p for s in live for p in table.seq(s).pages]
+            for p in owned:
+                assert table._ref[p] > 0, (trial, op, p)
+            # cached (LRU) pages are refcount-0 and published
+            for p in table._lru:
+                assert table._ref[p] == 0 and p in table._page_hash, (
+                    trial, op, p,
+                )
+
+        for _ in range(300):
+            op = str(rng.choice(
+                ["add", "adopt", "write", "write", "commit", "rollback",
+                 "accept", "drop", "trim", "invalidate"]
+            ))
+            if op == "invalidate" and rng.integers(0, 4):
+                op = "write"  # keep invalidation rare
+            if op in ("add", "adopt") or not live:
+                table.add_seq(next_sid)
+                if op == "adopt" or rng.integers(0, 2):
+                    ci = int(rng.integers(0, len(chains)))
+                    if op == "adopt":
+                        table.adopt_prefix(next_sid, chains[ci])
+                    else:
+                        table.set_seq_hashes(next_sid, chains[ci])
+                live.append(next_sid)
+                next_sid += 1
+                check(op)
+                continue
+            sid = int(rng.choice(live))
+            st = table.seq(sid)
+            if op == "write":
+                n = int(rng.integers(1, 2 * page_size))
+                commit = bool(rng.integers(0, 2))
+                try:
+                    table.assign_write_slots(sid, n, commit=commit)
+                except (OutOfPages, ValueError):
+                    pass
+            elif op == "commit":
+                if rng.integers(0, 2) and st.l_seq > st.l_acc:
+                    table.commit(
+                        sid, int(rng.integers(st.l_acc, st.l_seq + 1))
+                    )
+                else:
+                    table.commit(sid)
+            elif op == "rollback":
+                table.rollback(sid)
+            elif op == "accept":
+                spec = st.l_seq - st.l_acc
+                if spec:
+                    table.accept(sid, int(rng.integers(0, spec + 1)))
+            elif op == "trim":
+                if st.l_acc:
+                    table.trim_adopted(
+                        sid, int(rng.integers(0, st.l_acc + 1))
+                    )
+            elif op == "invalidate":
+                table.invalidate_pool()
+            elif op == "drop":
+                table.drop_seq(sid)
+                live.remove(sid)
+            if rng.integers(0, 8) == 0:
+                table.take_pending_copies()
+            check(op)
+        # teardown releases everything back: nothing may leak
+        for sid in list(live):
+            table.drop_seq(sid)
+            live.remove(sid)
+        table.invalidate_pool()
+        c = table.counts()
+        assert c == {
+            "free": num_pages, "referenced": 0, "cached": 0,
+        }, (trial, c)
+
+
+def test_prefix_adopt_cow_and_eviction():
+    """Directed coverage of the sharing lifecycle: publish -> adopt
+    (refcount pin) -> copy-on-write on divergence -> LRU eviction under
+    pressure."""
+    from bloombee_tpu.kv.prefix import page_hash_chain
+
+    t = PagedKVTable(num_pages=8, page_size=4)
+    ids = list(range(12))  # 3 full pages
+    chain = page_hash_chain(ids, 4)
+    t.add_seq(0)
+    t.set_seq_hashes(0, chain)
+    t.assign_write_slots(0, 12, commit=True)
+    assert t.cached_pages == 0  # still referenced by seq 0
+    assert t.match_prefix(chain) == 12
+
+    # adoption pins the pages (ref 2) and starts committed at 12 tokens
+    t.add_seq(1)
+    assert t.adopt_prefix(1, chain) == 12
+    assert t.seq(1).pages == t.seq(0).pages
+    assert t.seq(1).l_acc == 12
+
+    # trim to 9: still 3 pages (page 2 now partially covered, still shared)
+    t.trim_adopted(1, 9)
+    assert t.seq(1).l_acc == 9 and len(t.seq(1).pages) == 3
+    # writing token 9 lands inside shared page 2 -> copy-on-write
+    before = t.seq(1).pages[2]
+    t.assign_write_slots(1, 1, commit=True)
+    assert t.cow_count == 1
+    assert t.seq(1).pages[2] != before
+    assert t.take_pending_copies() == [(before, t.seq(1).pages[2])]
+    # seq 0's view of the shared page is untouched
+    assert t.seq(0).pages[2] == before
+
+    # dropping both: published pages park in the LRU, private pages free
+    t.drop_seq(0)
+    t.drop_seq(1)
+    c = t.counts()
+    assert c["referenced"] == 0
+    assert c["free"] + c["cached"] == 8
+    assert t.cached_pages >= 3  # the 3 published prompt pages survive
+    assert t.match_prefix(chain) == 12
+
+    # allocation pressure evicts from the LRU cold end once _free runs dry
+    t.add_seq(2)
+    t.assign_write_slots(2, 8 * 4, commit=True)  # every page in the arena
+    assert t.cached_pages == 0
+    assert t.match_prefix(chain) == 0
+    t.drop_seq(2)
+
+
 def test_native_table_bit_identical_to_python():
     """The C++ table must be BIT-IDENTICAL to the Python table across random
     op sequences (same LIFO free-list order => same slots)."""

@@ -1,0 +1,296 @@
+"""Cross-session shared-prefix KV cache, end to end.
+
+The correctness bar (ISSUE): greedy decode must be TOKEN-IDENTICAL with
+the cache on and off (both pinned to HF), servers must report nonzero
+prefix hits when sessions share a multi-page prompt, copy-on-write must
+fire when a sequence diverges inside a shared page, and no page may leak
+— including under seeded chaos mid-prefill and under eviction pressure
+when the pool is smaller than the shared prefix.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bloombee_tpu.client.config import ClientConfig
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.server.block_server import BlockServer
+from bloombee_tpu.wire import faults
+from bloombee_tpu.wire.faults import FaultPlan, FaultRule
+from bloombee_tpu.wire.rpc import connect
+from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=3,
+        vocab_size=128,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = tmp_path_factory.mktemp("tiny_llama_prefix")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model, config
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_plan():
+    yield
+    faults.set_plan(None)
+
+
+def _server(model_dir, registry, start, end, **kw):
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prefix_cache", True)
+    return BlockServer(
+        model_uid="tiny", start=start, end=end, model_dir=model_dir,
+        registry=registry, **kw,
+    )
+
+
+def _hf_greedy(model, input_ids, max_new_tokens):
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor(input_ids), max_new_tokens=max_new_tokens,
+            do_sample=False, use_cache=True,
+        )
+    return out.numpy()
+
+
+def _assert_no_leaks(server):
+    """free + referenced + cached == num_pages and nothing referenced
+    once every session is closed."""
+    table = server.manager.table
+    c = table.counts()
+    assert c["free"] + c["referenced"] + c["cached"] == table.num_pages, c
+    assert c["referenced"] == 0, c
+
+
+# ------------------------------------------------------------ cache on == off
+def test_prefix_cache_token_identical_and_hits(tiny_model_dir):
+    """Two-span chain, both servers caching: a cold session computes and
+    publishes a 3-page prompt; a warm session sharing it prefills only the
+    uncached tail (probed skip = prompt - 1, so the last shared page
+    diverges -> copy-on-write), and BOTH match HF greedy exactly. A
+    cache-off client against the same warm servers matches too, and
+    rpc_info reports the hits."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s_a = _server(model_dir, rc(), 0, 2)
+        s_b = _server(model_dir, rc(), 2, 3)
+        for s in (s_a, s_b):
+            await s.start()
+
+        # 12 tokens = 3 full pages at page_size 4 (>= 2-page shared prompt)
+        input_ids = (np.arange(12)[None, :] * 5 + 3) % config.vocab_size
+        ref = _hf_greedy(hf_model, input_ids, 6)
+
+        cfg_on = ClientConfig(use_push=False, prefix_cache=True)
+        model_on = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", config=cfg_on
+        )
+        # cold: miss, full prefill, pages published on close
+        ids_cold = await model_on.generate(input_ids, max_new_tokens=6)
+        np.testing.assert_array_equal(ids_cold, ref)
+        assert s_a.manager.prefix_stats()["prefix_hit_tokens"] == 0
+
+        # warm: the probe matches all 3 pages; the skip cap (prompt - 1)
+        # trims to 11 so the suffix write diverges INSIDE the last shared
+        # page and copy-on-write fires on the serving path
+        ids_warm = await model_on.generate(input_ids, max_new_tokens=6)
+        np.testing.assert_array_equal(ids_warm, ref)
+        for s in (s_a, s_b):
+            stats = s.manager.prefix_stats()
+            assert stats["prefix_hits"] >= 1
+            assert stats["prefix_hit_tokens"] >= 11
+            assert stats["cow_copies"] >= 1
+
+        # cache-off client against the SAME warm servers: identical tokens
+        model_off = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny",
+            config=ClientConfig(use_push=False, prefix_cache=False),
+        )
+        ids_off = await model_off.generate(input_ids, max_new_tokens=6)
+        np.testing.assert_array_equal(ids_off, ref)
+
+        # the wire surface advertises the cache and reports the counters
+        conn = await connect("127.0.0.1", s_a.port)
+        info, _ = await conn.call("rpc_info", {})
+        assert info["prefix_hit_tokens"] >= 11
+        assert info["prefix_hits"] >= 1
+        await conn.close()
+
+        await asyncio.sleep(0.2)  # server-side session teardown is async
+        for s in (s_a, s_b):
+            _assert_no_leaks(s)
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+# ----------------------------------------------------------------- chaos e2e
+@pytest.mark.chaos
+def test_prefix_cache_chaos_mid_prefill(tiny_model_dir):
+    """Seeded fault mid-prefill on a warm session: the relay forward to the
+    tail span resets right after the probe, forcing a recovery replay (which
+    probes again). Tokens stay exact, pages don't leak, and the head span —
+    which completed its suffix prefill before the fault — still recorded the
+    hit."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s_a = _server(model_dir, rc(), 0, 2, throughput=10.0)
+        s_b = _server(model_dir, rc(), 2, 3, throughput=10.0)  # preferred
+        s_c = _server(model_dir, rc(), 2, 3, throughput=1.0)  # backup
+        for s in (s_a, s_b, s_c):
+            await s.start()
+
+        input_ids = (np.arange(12)[None, :] * 7 + 1) % config.vocab_size
+        ref = _hf_greedy(hf_model, input_ids, 5)
+
+        cfg = ClientConfig(
+            use_push=False, prefix_cache=True, ban_timeout=0.5, ban_max=2.0,
+        )
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", config=cfg
+        )
+        # warm the pool fault-free
+        ids_cold = await model.generate(input_ids, max_new_tokens=5)
+        np.testing.assert_array_equal(ids_cold, ref)
+
+        # frame 1 to s_b is the probe, frame 2 the relay-forwarded suffix
+        # prefill: reset exactly there (mid-prefill, post-adoption)
+        plan = FaultPlan(seed=11)
+        plan.add(FaultRule(site="send", action="reset", method="sitem",
+                           port=s_b.port, nth=2, count=1))
+        faults.set_plan(plan)
+
+        session = model.inference_session(20, 1)
+        await session.__aenter__()
+        used = {s.span.server_info.port for s in session._spans}
+        assert s_b.port in used  # the fault targets the route taken
+        ids_warm = await model.generate(
+            input_ids, max_new_tokens=5, session=session
+        )
+        await session.__aexit__(None, None, None)
+        np.testing.assert_array_equal(ids_warm, ref)
+        assert ("send", "reset") in {(s, a) for s, a, _ in plan.log}
+        # the head span completed its suffix prefill before the tail reset
+        assert s_a.manager.prefix_stats()["prefix_hit_tokens"] > 0
+
+        faults.set_plan(None)
+        await asyncio.sleep(0.2)  # server-side session teardown is async
+        for s in (s_a, s_b, s_c):
+            _assert_no_leaks(s)
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+# --------------------------------------------------------- eviction pressure
+def test_prefix_cache_eviction_pressure(tiny_model_dir):
+    """Arena barely larger than one session's working set: adoptions,
+    copy-on-write, and LRU eviction contend for the same few pages across
+    back-to-back sessions. Every generation stays HF-exact and the table
+    balances to zero references after each close."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s = _server(model_dir, rc(), 0, 3, num_pages=6)
+        await s.start()
+
+        input_ids = (np.arange(12)[None, :] * 3 + 2) % config.vocab_size
+        ref = _hf_greedy(hf_model, input_ids, 6)
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny",
+            config=ClientConfig(use_push=False, prefix_cache=True),
+        )
+        for trial in range(3):
+            ids = await model.generate(input_ids, max_new_tokens=6)
+            np.testing.assert_array_equal(ids, ref, err_msg=f"trial {trial}")
+            await asyncio.sleep(0.2)  # server-side session teardown is async
+            _assert_no_leaks(s)
+        # later sessions adopted the survivor pages
+        assert s.manager.prefix_stats()["prefix_hit_tokens"] > 0
+
+        await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_prefix_max_pages_cap(tiny_model_dir, monkeypatch):
+    """BBTPU_PREFIX_MAX_PAGES caps the refcount-0 cached pool. With a cap
+    below the shared prefix's page count the chain can never fully pool
+    (chained hashes: evicting the head breaks the whole match), so warm
+    sessions fall back to full prefills — still HF-exact, pool never over
+    the cap."""
+    model_dir, hf_model, config = tiny_model_dir
+    monkeypatch.setenv("BBTPU_PREFIX_MAX_PAGES", "2")
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s = _server(model_dir, rc(), 0, 3)
+        await s.start()
+        assert s.manager.table.max_cached_pages == 2
+
+        input_ids = (np.arange(12)[None, :] * 11 + 5) % config.vocab_size
+        ref = _hf_greedy(hf_model, input_ids, 4)
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny",
+            config=ClientConfig(use_push=False, prefix_cache=True),
+        )
+        for _ in range(2):
+            ids = await model.generate(input_ids, max_new_tokens=4)
+            np.testing.assert_array_equal(ids, ref)
+            assert s.manager.table.cached_pages <= 2
+        await asyncio.sleep(0.2)  # server-side session teardown is async
+        _assert_no_leaks(s)
+
+        await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
