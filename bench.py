@@ -127,6 +127,20 @@ def _emit_json_locked():
         out["prefix_hit_tokens"] = int(pfx.get("hit_tokens", 0))
         out["prefix_hit_rate"] = round(pfx.get("hit_rate", 0.0), 3)
         out["prefix_warm_speedup"] = round(pfx.get("speedup", 0.0), 2)
+    fo = RESULTS.get("failover")
+    if fo:
+        # standby-KV replication: recovery stall + replayed tokens when a
+        # primary dies mid-decode, with replication on vs off (full replay)
+        out["failover_stall_repl_ms"] = round(fo.get("stall_repl_ms", 0.0), 1)
+        out["failover_stall_replay_ms"] = round(
+            fo.get("stall_replay_ms", 0.0), 1
+        )
+        out["failover_replayed_tokens_repl"] = int(
+            fo.get("replayed_repl", 0)
+        )
+        out["failover_replayed_tokens_full"] = int(
+            fo.get("replayed_full", 0)
+        )
     if RESULTS.get("phases"):
         out["phases"] = RESULTS["phases"]
     if RESULTS.get("cpu_fallback"):
@@ -462,6 +476,18 @@ def main():
         RESULTS.setdefault("degraded", f"prefix_cache phase failed: {e!r}")
         log(f"prefix_cache phase FAILED: {e!r}")
 
+    # ---- failover phase: kill the primary mid-decode and measure the
+    # recovery stall + replayed tokens with standby-KV replication on
+    # (probe-and-skip onto the standby's replicated pages) vs off (full
+    # history replay)
+    try:
+        phase("failover", "started")
+        run_failover(spec, params)
+    except Exception as e:  # noqa: BLE001
+        phase("failover", f"failed: {e!r}"[:200])
+        RESULTS.setdefault("degraded", f"failover phase failed: {e!r}")
+        log(f"failover phase FAILED: {e!r}")
+
     # value: SERVED full-model-equivalent PER-SEQUENCE decode tok/s (batch 8
     # session through registry + BlockServer + wire); baseline 35 tok/s =
     # single-A100 single-stream HF decode on Llama-3-8B (BASELINE.md).
@@ -778,6 +804,108 @@ def run_prefix_cache(spec, params) -> None:
                     pass
 
     asyncio.run(run())
+
+
+def run_failover(spec, params) -> None:
+    """Fast-failover phase: two same-span servers; a session decodes with
+    standby-KV replication, the primary dies mid-decode, and the client
+    recovers onto the standby. With replication the recovery probe adopts
+    the replicated pages and replays only the unsealed tail; without it
+    the whole history re-prefills. Reports both stalls + replayed-token
+    counts."""
+    import asyncio
+
+    from bloombee_tpu.client.session import InferenceSession
+    from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    span_layers = spec.num_hidden_layers
+    PAGE = 16
+    PROMPT, DECODE = 4 * PAGE, 24
+    VOCAB_EFF = min(1024, spec.vocab_size)
+
+    async def one_failover(repl_every: int) -> dict:
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        servers = [
+            BlockServer(
+                model_uid="bench_fo", start=0, end=span_layers,
+                params=params, spec=spec, registry=rc(), num_pages=256,
+                page_size=PAGE, max_batch=1, prefix_cache=True,
+            )
+            for _ in range(2)
+        ]
+        for srv in servers:
+            await srv.start()
+        manager = RemoteSequenceManager(rc(), "bench_fo", span_layers)
+        rng = np.random.default_rng(11)
+        embed_table = (
+            rng.standard_normal((VOCAB_EFF, spec.hidden_size)) * 0.02
+        ).astype(np.float32)
+
+        async def one_token(s):
+            nid = rng.integers(0, VOCAB_EFF, size=(1, 1))
+            await s.step(embed_table[nid], ids=nid)
+
+        try:
+            s = InferenceSession(
+                manager, max_length=PROMPT + DECODE + 4, batch_size=1,
+                prefix_cache=True, repl_every=repl_every,
+            )
+            async with s:
+                ids = rng.integers(0, VOCAB_EFF, size=(1, PROMPT))
+                await s.step(embed_table[ids], ids=ids)
+                for _ in range(DECODE // 2):
+                    await one_token(s)
+                primary_port = s._spans[0].span.server_info.port
+                primary = next(v for v in servers if v.port == primary_port)
+                standby = next(v for v in servers if v.port != primary_port)
+                if repl_every:
+                    # let the async kv_put backlog land before the kill
+                    for _ in range(200):
+                        stats = standby.manager.prefix_stats()
+                        if stats["repl_pages_installed"] >= (
+                            (PROMPT + DECODE // 2) // PAGE
+                        ):
+                            break
+                        await asyncio.sleep(0.05)
+                await primary.stop()
+                t0 = time.time()
+                await one_token(s)  # hits the dead primary -> recovery
+                stall_ms = (time.time() - t0) * 1000.0
+                for _ in range(DECODE // 2 - 1):
+                    await one_token(s)
+                return {
+                    "stall_ms": stall_ms,
+                    "replayed": int(s.failover_replayed_tokens),
+                }
+        finally:
+            for thing in (*servers, reg):
+                try:
+                    await asyncio.wait_for(thing.stop(), timeout=30.0)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    repl = asyncio.run(one_failover(repl_every=1))
+    full = asyncio.run(one_failover(repl_every=0))
+    RESULTS["failover"] = {
+        "stall_repl_ms": repl["stall_ms"],
+        "stall_replay_ms": full["stall_ms"],
+        "replayed_repl": repl["replayed"],
+        "replayed_full": full["replayed"],
+    }
+    phase("failover", "ok")
+    log(
+        f"failover: stall {repl['stall_ms']:.1f} ms replaying "
+        f"{repl['replayed']} tokens (replication on) vs "
+        f"{full['stall_ms']:.1f} ms replaying {full['replayed']} tokens "
+        f"(full replay)"
+    )
 
 
 def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:

@@ -56,12 +56,32 @@ def main(argv=None):
             )
             if info.cache_tokens_left is not None:
                 line += f"  cache_tokens_left={info.cache_tokens_left}"
+            if getattr(info, "kv_repl", False):
+                line += "  kv_repl"
             if args.probe:
                 conn = None
                 try:
                     conn = await connect(info.host, info.port)
-                    await asyncio.wait_for(conn.call("rpc_info", {}), 5)
+                    probe, _ = await asyncio.wait_for(
+                        conn.call("rpc_info", {}), 5
+                    )
                     line += "  [reachable]"
+                    # failover/replication counters: lets an operator see
+                    # replication running (or lagging) without log access
+                    repl = {
+                        k: probe[k]
+                        for k in (
+                            "repl_pages_sent",
+                            "repl_pages_installed",
+                            "repl_lag_pages",
+                            "failover_replayed_tokens",
+                        )
+                        if probe.get(k)
+                    }
+                    if repl:
+                        line += "  " + " ".join(
+                            f"{k}={v}" for k, v in sorted(repl.items())
+                        )
                 except Exception as e:
                     line += f"  [UNREACHABLE: {type(e).__name__}]"
                 finally:

@@ -210,8 +210,11 @@ def main(argv=None):
             server.server_id, model_uid, start, end, server.port,
         )
         # graceful shutdown: SIGTERM/SIGINT announce DRAINING (routing
-        # stops sending new sessions), in-flight sessions finish up to
-        # --drain-timeout, then the span is revoked and the process exits
+        # stops sending new sessions), pending session-KV replication is
+        # flushed to standbys (so surviving sessions fail over with at
+        # most the unsealed tail to replay), in-flight sessions finish up
+        # to --drain-timeout, then the span is revoked and the process
+        # exits
         import signal
 
         stop_requested = asyncio.Event()

@@ -54,3 +54,10 @@ class ClientConfig:
     # BBTPU_PREFIX_CACHE env switch; servers with the cache off just report
     # zero matches, so leaving this on against a mixed swarm is safe
     prefix_cache: bool | None = None
+    # standby KV replication interval in sealed pages: every N newly-sealed
+    # pages each span's server ships them (kv_put) into a same-span
+    # standby's prefix pool, so failover replays at most one interval plus
+    # the unsealed tail. Needs prefix_cache; 0 disables; None defers to the
+    # BBTPU_REPL_EVERY env switch. Swarms with no capable standby (old
+    # servers, mismatched page_size/span) silently fall back to full replay
+    kv_repl_every: int | None = None

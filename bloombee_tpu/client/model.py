@@ -190,6 +190,7 @@ class DistributedModelForCausalLM:
             embed_fn=self.embed,
             adapter=cfg.active_adapter,
             prefix_cache=cfg.prefix_cache,
+            repl_every=cfg.kv_repl_every,
         )
 
     # --------------------------------------------------------------- generate

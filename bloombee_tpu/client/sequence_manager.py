@@ -196,13 +196,45 @@ class RemoteSequenceManager:
         mode: str = "min_latency",
         cache_tokens_needed: int | None = None,
         relay: bool = False,  # True: hops go server->client->server
+        prefer: set[str] | None = None,  # peers to bias toward (recovery
+        # hint: standbys already holding this session's replicated pages)
     ) -> list[RemoteSpanInfo]:
         end = self.num_blocks if end is None else end
         spans = self._active_spans()
         if mode == "max_throughput":
             return self._random_route(spans, start, end)
         return self._dijkstra_route(
-            spans, start, end, cache_tokens_needed, relay
+            spans, start, end, cache_tokens_needed, relay, prefer=prefer
+        )
+
+    def pick_standby(
+        self, span: RemoteSpanInfo, exclude: set[str] | None = None
+    ) -> RemoteSpanInfo | None:
+        """A replication standby for `span`: an active peer serving EXACTLY
+        the same block range (replicated pages carry the full span's layers
+        at the server's page geometry, so only an identical span + page
+        size can install them), advertising kv_repl support, and not on
+        the session's current route. Highest-throughput candidate wins;
+        None when the swarm has no eligible alternative (the caller
+        degrades to plain full-replay recovery)."""
+        info = span.server_info
+        cands = [
+            s for s in self._active_spans()
+            if s.peer_id != span.peer_id
+            and s.peer_id not in (exclude or ())
+            and s.server_info.kv_repl
+            and s.server_info.start_block == info.start_block
+            and s.server_info.end_block == info.end_block
+            and s.server_info.page_size == info.page_size
+        ]
+        if not cands:
+            return None
+        return max(
+            cands,
+            key=lambda s: (
+                s.server_info.inference_rps
+                or s.server_info.throughput or 0.0
+            ),
         )
 
     def _compute_cost(
@@ -239,7 +271,7 @@ class RemoteSequenceManager:
 
     def _dijkstra_route(
         self, spans, start: int, end: int, cache_tokens_needed,
-        relay: bool = False,
+        relay: bool = False, prefer: set[str] | None = None,
     ) -> list[RemoteSpanInfo]:
         # states = (block boundary, arriving peer); a span [s, e) contributes
         # edges (b, p) -> (e, span.peer) for every b in [s, e) (a server can
@@ -271,6 +303,12 @@ class RemoteSequenceManager:
                 cost = self._hop_cost(node_p, span, relay) + self._compute_cost(
                     span, e - node_b, cache_tokens_needed
                 )
+                if prefer and span.peer_id in prefer:
+                    # recovery hint: a standby holding this session's
+                    # replicated KV saves an O(history) replay — worth far
+                    # more than a latency edge. Scaling (not zeroing)
+                    # keeps edge costs positive, so Dijkstra stays valid.
+                    cost *= 0.05
                 nxt = (e, span.peer_id)
                 nd = d + cost
                 if nd < dist.get(nxt, float("inf")):

@@ -32,6 +32,13 @@ env.declare(
     "each step's batch so stage N+1 computes chunk k while stage N computes "
     "k+1 — the reference's BLOOMBEE_MICRO_BATCH_SIZE overlap)",
 )
+env.declare(
+    "BBTPU_REPL_EVERY", int, 0,
+    "session-KV replication interval: every N newly-sealed pages the "
+    "client asks each span's server to ship them (kv_put) to a standby "
+    "covering the same span, so failover replays at most one interval "
+    "plus the unsealed tail (0 = replication off)",
+)
 
 # the first no-embed_fn decode_n session in the process warns loudly; later
 # sessions demote to DEBUG (a bench tail spawning many raw sessions would
@@ -91,6 +98,8 @@ class InferenceSession:
         prefix_cache: bool | None = None,  # probe servers' shared-prefix
         # pools before the first prefill and send only the uncached suffix
         # (None -> BBTPU_PREFIX_CACHE env)
+        repl_every: int | None = None,  # standby-KV replication interval
+        # in sealed pages (None -> BBTPU_REPL_EVERY env; 0 disables)
     ):
         self.manager = manager
         self.adapter = adapter
@@ -104,6 +113,21 @@ class InferenceSession:
             env.get("BBTPU_PREFIX_CACHE") if prefix_cache is None
             else bool(prefix_cache)
         )
+        # standby replication: every `repl_every` sealed pages the client
+        # tells each span's server (kv_repl stream item) to export the new
+        # pages and kv_put them into a same-span standby's prefix pool, so
+        # `_recover`'s probe adopts them and replays only the unsealed tail
+        self.repl_every = (
+            env.get("BBTPU_REPL_EVERY") if repl_every is None
+            else int(repl_every)
+        )
+        self._repl: list[dict | None] = []  # per-span replication state
+        # incremental full-history hash chains, keyed by page size
+        self._chains_by_ps: dict[int, list[list[str]]] = {}
+        # client-side failover observability: pages sealed but not yet
+        # announced to a standby, and tokens re-prefilled by recoveries
+        self.repl_lag_pages = 0
+        self.failover_replayed_tokens = 0
         # within-stage micro-batch pipelining (reference
         # microbatch_config.py:84-130 overlap-only mode): split each step's
         # batch into chunks so downstream spans start on chunk k while
@@ -146,6 +170,7 @@ class InferenceSession:
             relay=not self.use_push,
         )
         self._spans = [await self._open_span(s) for s in route]
+        self._init_repl()
         return self
 
     async def __aexit__(self, *exc) -> None:
@@ -173,26 +198,36 @@ class InferenceSession:
         return _SpanSession(span, conn, stream, session_id)
 
     # ----------------------------------------------------------- prefix cache
-    async def _probe_prefix(self, id_rows: list[list[int]]) -> int:
-        """Ask every span how much of each row's prompt its shared-prefix
+    async def _probe_prefix(
+        self,
+        id_rows: list[list[int]] | None = None,
+        hidden_rows: list[np.ndarray] | None = None,
+    ) -> int:
+        """Ask every span how much of each row's history its shared-prefix
         pool already holds; returns the chain-wide skippable token count
         (min over spans AND rows — every span receives the same suffix
         hidden, so the chain can only skip what ALL of them have).
 
-        Spans that don't advertise a page size (cache off / old server)
-        force 0. Wire failures propagate as step errors so the caller's
-        retry loop rebuilds the chain — a timed-out probe must never leave
-        a stale reply queued on a reused stream."""
+        Probes hash whichever history the caller passes: token-id rows
+        (the normal prompt / replay path) or raw [T, D] hidden rows
+        (embed-less sessions — their chains use a distinct hash root so
+        they can never alias an id chain). Spans that don't advertise a
+        page size (cache off / old server) force 0. Wire failures
+        propagate as step errors so the caller's retry loop rebuilds the
+        chain — a timed-out probe must never leave a stale reply queued
+        on a reused stream."""
+        from bloombee_tpu.kv.prefix import hidden_hash_chain, page_hash_chain
+
+        rows = id_rows if id_rows is not None else hidden_rows
+        builder = page_hash_chain if id_rows is not None else hidden_hash_chain
+        lens = [len(r) for r in rows] if rows else []
         ps_list = [s.span.server_info.page_size for s in self._spans]
-        if not ps_list or any(ps <= 0 for ps in ps_list) or not any(id_rows):
+        if not ps_list or any(ps <= 0 for ps in ps_list) or not any(lens):
             # some span can't share (or nothing to hash): whole-chain miss
             return 0
         sizes = set(ps_list)
-        from bloombee_tpu.kv.prefix import page_hash_chain
-
         chains_by_ps = {
-            ps: [page_hash_chain(row, ps) for row in id_rows]
-            for ps in sizes
+            ps: [builder(row, ps) for row in rows] for ps in sizes
         }
         step_id = self._step_counter
         self._step_counter += 1
@@ -223,8 +258,116 @@ class InferenceSession:
         # computes (the caller consumes its output) — ALSO the genuine
         # divergence point: the uncached tail writes into the last shared
         # page and copy-on-write splits it server-side
-        shortest = min(len(r) for r in id_rows)
+        shortest = min(lens)
         return max(0, min(matched or 0, shortest - 1))
+
+    # ------------------------------------------------------- kv replication
+    def _history_rows(self):
+        """(kind, per-row history) for hashing: ("ids", ragged id lists),
+        ("hidden", [T, D] arrays), or (None, None) when nothing committed
+        yet (or the history kinds are mixed — recovery refuses those)."""
+        if any(self._id_rows):
+            if self._history:
+                return None, None
+            return "ids", self._id_rows
+        if self._history:
+            full = np.concatenate(self._history, axis=1)
+            return "hidden", [full[i] for i in range(full.shape[0])]
+        return None, None
+
+    def _full_chains(self, ps: int) -> list[list[str]] | None:
+        """Per-row hash chains over the session's FULL committed history
+        (prompt + generated) at page size `ps`, extended incrementally —
+        sealed pages already hashed are never rehashed."""
+        kind, rows = self._history_rows()
+        if kind is None:
+            return None
+        from bloombee_tpu.kv.prefix import hidden_hash_chain, page_hash_chain
+
+        fn = page_hash_chain if kind == "ids" else hidden_hash_chain
+        cached = self._chains_by_ps.get(ps)
+        chains = [
+            fn(row, ps, chain=cached[i] if cached else None)
+            for i, row in enumerate(rows)
+        ]
+        self._chains_by_ps[ps] = chains
+        return chains
+
+    def _init_repl(self) -> None:
+        """(Re)select one standby per span for KV replication. A None slot
+        means that span can't replicate: knob off, no page size advertised,
+        the session uses a sub-span of the server (its pages would carry
+        layers the session doesn't own), or no capable same-span
+        alternative exists — all of which degrade to plain full-replay
+        recovery, byte-for-byte today's behavior."""
+        self._repl = [None] * len(self._spans)
+        if self.repl_every <= 0 or not self.prefix_cache:
+            return
+        exclude = {s.span.peer_id for s in self._spans}
+        for i, s in enumerate(self._spans):
+            info = s.span.server_info
+            if (
+                info.page_size <= 0
+                or s.span.start != info.start_block
+                or s.span.end != info.end_block
+            ):
+                continue
+            standby = self.manager.pick_standby(s.span, exclude=exclude)
+            if standby is None:
+                continue
+            self._repl[i] = {
+                "standby": {
+                    "host": standby.server_info.host,
+                    "port": standby.server_info.port,
+                },
+                "peer": standby.peer_id,
+                "announced": [0] * self.batch_size,
+            }
+
+    def _standby_peers(self) -> set[str]:
+        """Peers holding (some of) this session's replicated pages — the
+        recovery route hint."""
+        return {st["peer"] for st in self._repl or [] if st is not None}
+
+    async def _maybe_replicate(self) -> None:
+        """Announce newly-sealed pages to each span's server, which exports
+        them off the critical path and kv_puts them into the standby's
+        prefix pool. Fire-and-forget: no reply rides the stream (so the
+        step recv loop never desyncs) and a failed send just leaves the
+        pages for the next interval."""
+        live = [st for st in self._repl if st is not None]
+        if not live:
+            self.repl_lag_pages = 0
+            return
+        kind, rows = self._history_rows()
+        if kind is None:
+            return
+        lag = 0
+        for s, st in zip(self._spans, self._repl):
+            if st is None:
+                continue
+            ps = s.span.server_info.page_size
+            sealed = [len(r) // ps for r in rows]
+            behind = max(
+                sl - a for sl, a in zip(sealed, st["announced"])
+            )
+            if behind < self.repl_every:
+                lag = max(lag, behind)
+                continue
+            chains = self._full_chains(ps)
+            if chains is None:
+                return
+            try:
+                await s.stream.send(
+                    {"kv_repl": {"standby": st["standby"], "chains": chains}},
+                    [],
+                )
+            except (RpcError, OSError, asyncio.TimeoutError) as e:
+                logger.debug("kv_repl announce failed: %s", e)
+                lag = max(lag, behind)
+                continue
+            st["announced"] = sealed
+        self.repl_lag_pages = lag
 
     # ------------------------------------------------------------------ steps
     async def step(
@@ -282,6 +425,7 @@ class InferenceSession:
                     else:
                         self._history.append(hidden)
                     self.position += hidden.shape[1]
+                    await self._maybe_replicate()
                 return out
             except (RpcError, OSError, asyncio.TimeoutError) as e:
                 attempt += 1
@@ -644,6 +788,7 @@ class InferenceSession:
             for i, row in enumerate(written):
                 self._id_rows[i].extend(int(t) for t in row)
             self.position += n
+            await self._maybe_replicate()
             return toks
 
     def _check_decode_n_route(self) -> None:
@@ -789,6 +934,8 @@ class InferenceSession:
         for row in self._id_rows:
             del row[len(row) - n_drop:]
         self.position -= n_drop
+        # incremental chains cover tokens that no longer exist: rehash
+        self._chains_by_ps.clear()
         self._needs_rebuild = True
 
     def record_history_ids(self, rows: list[list[int]]) -> None:
@@ -808,7 +955,14 @@ class InferenceSession:
         """Rebuild the entire chain and replay history
         (v1 of reference `_update_sequence`: suffix-only rebuild is an
         optimization; full rebuild is correct because servers key KV caches by
-        session, and new sessions start empty)."""
+        session, and new sessions start empty).
+
+        Route selection prefers peers holding this session's replicated
+        pages (the standby hint), so the probe below usually adopts them
+        and the replay shrinks to the unsealed tail. A bounded retry loop
+        wraps rebuild + replay: each failed attempt bans the offending
+        peer (existing backoff machinery), so the next attempt routes
+        around it instead of one flaky standby killing the session."""
         if any(self._id_rows) and self.embed_fn is None:
             # id history can only be replayed by re-embedding; a session
             # that recorded ids without an embed_fn (e.g. decode_n from a
@@ -829,10 +983,30 @@ class InferenceSession:
                 "order is ambiguous"
             )
         await self.close()
+        attempts = max(1, int(self.max_retries))
+        last_exc: Exception | None = None
+        for attempt in range(attempts):
+            if attempt:
+                await asyncio.sleep(min(0.2 * attempt, 1.0))
+            try:
+                await self._recover_once()
+                return
+            except (RpcError, OSError, asyncio.TimeoutError) as e:
+                last_exc = e
+                await self.close()
+                logger.warning(
+                    "recovery attempt %d/%d failed: %s",
+                    attempt + 1, attempts, e,
+                )
+        raise last_exc
+
+    async def _recover_once(self) -> None:
+        """One rebuild + replay attempt (see _recover)."""
         await self.manager.update(force=True)
         route = self.manager.make_sequence(
             cache_tokens_needed=self.batch_size * self.max_length,
             relay=not self.use_push,
+            prefer=self._standby_peers() or None,
         )
         spans: list[_SpanSession] = []
         try:
@@ -859,12 +1033,13 @@ class InferenceSession:
                 for i, r in enumerate(self._id_rows):
                     padded[i, : len(r)] = r
                 # a prior session (this one, before it failed) likely left
-                # its prompt pages in the servers' prefix pools — probe so
-                # the replay re-embeds and re-ships only the uncached
-                # suffix. Chains come from the RAGGED rows, never the
-                # padded rectangle: pad garbage must not hash-alias a
-                # pooled page of real zeros. commit_lens are absolute, so
-                # they need no adjustment for the adopted offset.
+                # its prompt pages in the servers' prefix pools — and a
+                # standby holds whatever was replicated — probe so the
+                # replay re-embeds and re-ships only the uncached suffix.
+                # Chains come from the RAGGED rows, never the padded
+                # rectangle: pad garbage must not hash-alias a pooled page
+                # of real zeros. commit_lens are absolute, so they need no
+                # adjustment for the adopted offset.
                 skip = 0
                 if self.prefix_cache:
                     skip = await self._probe_prefix(
@@ -875,11 +1050,33 @@ class InferenceSession:
                     replay[:, skip:], commit=False, tree_mask=None,
                     commit_lens=lens, prefix_skip=skip,
                 )
+                self.failover_replayed_tokens += sum(
+                    max(0, ln - skip) for ln in lens
+                )
             elif self._history:
+                # hidden-state history probes too: replicated/pooled pages
+                # are keyed by hidden-byte chains for these sessions, so a
+                # standby hit trims the replay exactly like the id path
                 replay = np.concatenate(self._history, axis=1)
-                await self._step_once(replay, commit=True, tree_mask=None)
+                skip = 0
+                if self.prefix_cache:
+                    skip = await self._probe_prefix(
+                        hidden_rows=[
+                            replay[i] for i in range(replay.shape[0])
+                        ]
+                    )
+                await self._step_once(
+                    replay[:, skip:], commit=True, tree_mask=None,
+                    prefix_skip=skip if skip else None,
+                )
+                self.failover_replayed_tokens += replay.shape[0] * (
+                    replay.shape[1] - skip
+                )
         except Exception:
             # a half-replayed chain must not be reused: its KV caches are
             # incomplete and a later "successful" step would be garbage
             await self.close()
             raise
+        # replicate to a fresh standby from now on (the old one is likely
+        # on the new route — often it IS the new primary)
+        self._init_repl()

@@ -211,6 +211,8 @@ class CacheManager:
         # prefix-cache serving counters (rpc_info observability)
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
+        # KV replication receive counter (pages installed via kv_put)
+        self.repl_pages_installed = 0
         # probe-adopted token counts per seq, consumed by trim_adopted once
         # the prefill's final skip arrives (also the idempotency guard: a
         # retried prefill must not trim real committed tokens)
@@ -556,7 +558,117 @@ class CacheManager:
             "prefix_cached_pages": int(
                 getattr(self.table, "cached_pages", 0)
             ),
+            "repl_pages_installed": int(self.repl_pages_installed),
         }
+
+    # ------------------------------------------------------- kv replication
+    @property
+    def repl_supported(self) -> bool:
+        """Page payloads can be exported/installed byte-exact only on a
+        dense unquantized arena (int4 slabs and hetero tuples have no
+        single canonical page layout on the wire) with the prefix pool
+        available to hold them."""
+        return (
+            self.prefix_cache
+            and self.quant is None
+            and not isinstance(self.arena["k"], tuple)
+            and hasattr(self.table, "install_cached")
+        )
+
+    @_locked
+    def export_pages(self, seq_id: int, lo_page: int, hi_page: int):
+        """Gather sealed pages [lo_page, hi_page) of one sequence for
+        replication. Returns (k_dev, v_dev, hi) — device arrays of shape
+        [L, n * page_size, kv, hd] (the caller moves them to host off the
+        compute thread) and the page bound actually exported, clamped to
+        the fully-committed (sealed) prefix. None when the sequence has
+        nothing exportable (parked, reset, or replication unsupported)."""
+        if not self.repl_supported or not self.table.has_seq(seq_id):
+            return None
+        if seq_id in self._parked or seq_id in self._adopted:
+            return None
+        state = self.table.seq(seq_id)
+        sealed = state.l_acc // self.page_size
+        hi = min(hi_page, sealed, state.num_pages)
+        if hi <= max(lo_page, 0):
+            return None
+        slots = self.table.range_slots(
+            seq_id, lo_page * self.page_size, hi * self.page_size
+        )
+        idx = jnp.asarray(slots)
+        return self.arena["k"][:, idx], self.arena["v"][:, idx], hi
+
+    @_locked
+    def install_replicated(self, hashes, k_pages, v_pages) -> int:
+        """kv_put receive path: install replicated pages into the prefix
+        pool as refcount-0 cached entries and scatter their bytes into the
+        arena. `k_pages`/`v_pages` are host arrays [n, L, page_size, kv,
+        hd] aligned with `hashes` (chain order — parents first). Pages the
+        pool already holds, or that no free/cached page can back, are
+        skipped; returns the number actually installed."""
+        if not self.repl_supported:
+            return 0
+        want = (
+            self.num_layers, self.page_size,
+        ) + tuple(self.arena["k"].shape[2:])
+        k_pages = np.asarray(k_pages)
+        v_pages = np.asarray(v_pages)
+        if (
+            k_pages.shape != (len(hashes),) + want
+            or v_pages.shape != k_pages.shape
+        ):
+            raise ValueError(
+                f"replicated page payload {k_pages.shape} does not match "
+                f"arena geometry {(len(hashes),) + want}"
+            )
+        pages, rows = [], []
+        for i, h in enumerate(hashes):
+            page = self.table.install_cached(h)
+            if page is not None:
+                pages.append(page)
+                rows.append(i)
+        if not pages:
+            return 0
+        ps = self.page_size
+        offs = np.arange(ps, dtype=np.int64)
+        slots = jnp.asarray(
+            np.concatenate([p * ps + offs for p in pages]).astype(np.int32)
+        )
+
+        def flat(a):  # [m, L, ps, kv, hd] -> [L, m*ps, kv, hd]
+            sel = a[np.asarray(rows)]
+            return np.swapaxes(sel, 0, 1).reshape(
+                a.shape[1], len(rows) * ps, *a.shape[3:]
+            )
+
+        self.arena["k"] = self.arena["k"].at[:, slots].set(
+            jnp.asarray(flat(k_pages)).astype(self.arena["k"].dtype)
+        )
+        self.arena["v"] = self.arena["v"].at[:, slots].set(
+            jnp.asarray(flat(v_pages)).astype(self.arena["v"].dtype)
+        )
+        self.repl_pages_installed += len(pages)
+        return len(pages)
+
+    @_locked
+    def extend_seq_hashes(self, handle: "CacheHandle", chains) -> None:
+        """Attach each row's full-history hash chain (replication keeps
+        them growing past the prompt) so the primary's own sealed decode
+        pages publish locally too. Extend-only: a shorter chain than the
+        one on record is ignored (a stale replication message)."""
+        if not self.prefix_cache or not hasattr(
+            self.table, "set_seq_hashes"
+        ):
+            return
+        for sid, chain in zip(handle.seq_ids, chains):
+            if not chain or not self.table.has_seq(sid):
+                continue
+            if sid in self._parked or sid in self._adopted:
+                continue
+            st = self.table.seq(sid)
+            if st.hashes is not None and len(chain) < len(st.hashes):
+                continue
+            self.table.set_seq_hashes(sid, chain)
 
     # ------------------------------------------------------- host tiering
     @_locked

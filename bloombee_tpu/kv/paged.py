@@ -383,6 +383,39 @@ class PagedKVTable:
         state.published = n
         return tokens
 
+    def install_cached(self, h: str) -> int | None:
+        """Install one externally-supplied page under hash `h` as a
+        refcount-0 cached pool entry (the replication receive path).
+
+        The page is immediately evictable — installing can displace only
+        other cached pages, never referenced ones, so replication cannot
+        OOM a healthy server. Returns the page id the caller must fill
+        with the hash's content, or None when the hash is already pooled
+        (nothing to do) or no free/cached page is reclaimable."""
+        if h in self._pool:
+            return None
+        if self.max_cached_pages > 0 and self.max_cached_pages <= len(
+            self._lru
+        ):
+            # keep the cap by evicting the coldest cached page first;
+            # installing at the cap must not grow the pool
+            cold, _ = self._lru.popitem(last=False)
+            self._unpublish(cold)
+            self._free.append(cold)
+        if self._free:
+            page = self._free.pop()
+        elif self._lru:
+            page, _ = self._lru.popitem(last=False)
+            self._unpublish(page)
+        else:
+            return None
+        self._pool[h] = page
+        self._page_hash[page] = h
+        self._ref[page] = 0
+        self._lru[page] = None
+        self._lru.move_to_end(page)
+        return page
+
     def trim_adopted(self, seq_id: int, keep_tokens: int) -> None:
         """Shrink an adopted (still-unwritten) committed prefix to
         `keep_tokens` — the span chain agreed on a smaller common hit, or

@@ -82,6 +82,12 @@ env.declare(
     "asymmetric, ~4x); compute stays bf16 (reference compression.py "
     "weight compression)",
 )
+env.declare(
+    "BBTPU_REPL_INFLIGHT", int, 2,
+    "max concurrent standby-replication sweeps per server (the kv_put "
+    "sender side of session-KV replication; each sweep holds one export "
+    "+ one wire push at a time)",
+)
 
 
 class _ChainError(RuntimeError):
@@ -135,6 +141,14 @@ class _Session:
         # last pruned tree step's (hidden, tokens, parents) for online
         # pruner-head training when its accept arrives
         self.last_tree = None
+        # session-KV replication to a standby (client-directed kv_repl
+        # items): standby (host, port), the client's full-history hash
+        # chains per row, pages already shipped per row, and a lock so
+        # only one sweep drains the backlog at a time
+        self.repl_standby: tuple[str, int] | None = None
+        self.repl_chains: list[list[str]] | None = None
+        self.repl_sent: list[int] | None = None
+        self.repl_lock = asyncio.Lock()
 
 
 class _PeerPool:
@@ -445,6 +459,15 @@ class BlockServer:
         self.batched_steps = 0
         self.batch_dispatches = 0
         self.batch_solo_steps = 0
+        # session-KV replication (fast failover): sealed pages this primary
+        # shipped to standbys, and tokens recovering clients replayed into
+        # us; the semaphore bounds concurrent replication sweeps so standby
+        # traffic can never crowd out live inference
+        self.repl_pages_sent = 0
+        self.failover_replayed_tokens = 0
+        self._repl_sem = asyncio.Semaphore(
+            max(1, env.get("BBTPU_REPL_INFLIGHT"))
+        )
         self._kv_quant = kv_quant
         self._num_pages = num_pages
         self._adapter_dirs = adapter_dirs
@@ -454,6 +477,7 @@ class BlockServer:
                 "rpc_info": self._rpc_info,
                 "rpc_forward": self._rpc_forward,
                 "rpc_backward": self._rpc_backward,
+                "kv_put": self._kv_put,
             },
             stream_handlers={"rpc_inference": self._rpc_inference},
             push_handlers={"rpc_push": self._rpc_push},
@@ -521,6 +545,26 @@ class BlockServer:
                 await self._announce(ServerState.DRAINING)
             except Exception as e:
                 logger.warning("DRAINING announce failed: %s", e)
+        # flush pending standby replication FIRST so a standby holds every
+        # sealed page a recovering client will probe for — a drained
+        # server's sessions fail over with at most the unsealed tail to
+        # replay instead of their whole history
+        flush = [
+            asyncio.create_task(self._replicate_session(s))
+            for s in list(self._sessions.values())
+            if s.repl_standby is not None
+        ]
+        if flush:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*flush, return_exceptions=True),
+                    timeout=max(1.0, deadline - _time.monotonic()),
+                )
+            except asyncio.TimeoutError:
+                logger.warning(
+                    "replication flush outlived the drain window; standbys "
+                    "hold a partial backlog"
+                )
         while self._sessions and _time.monotonic() < deadline:
             await asyncio.sleep(0.1)
         if self._sessions:
@@ -785,6 +829,10 @@ class BlockServer:
             page_size=(
                 self.manager.page_size if self.manager.prefix_cache else 0
             ),
+            # clients only pick standbys that can actually install kv_put
+            # pages; a draining server is about to leave the swarm and
+            # must not attract fresh replication traffic
+            kv_repl=self.manager.repl_supported and not self._draining,
         )
 
     async def _announce(self, state: ServerState) -> None:
@@ -892,8 +940,15 @@ class BlockServer:
             "queue_wait_ms": self.compute.wait_stats_ms(),
             # prefix-cache observability: sessions that adopted pooled
             # prompt pages, tokens they skipped prefilling, copy-on-write
-            # page splits, and current cached-pool occupancy
+            # page splits, and current cached-pool occupancy (plus
+            # repl_pages_installed — kv_put pages accepted as a standby)
             **self.manager.prefix_stats(),
+            # kv-replication observability (fast failover): sealed pages
+            # shipped to standbys, the current sealed-but-unshipped
+            # backlog, and tokens recovering clients replayed into us
+            "repl_pages_sent": self.repl_pages_sent,
+            "repl_lag_pages": self._repl_lag(),
+            "failover_replayed_tokens": self.failover_replayed_tokens,
             # operator visibility into the decode_n fast paths: a client
             # falling back to per-step decoding is otherwise invisible.
             # decode_n: ANY single-span flavor (fused scan or host-driven
@@ -917,6 +972,173 @@ class BlockServer:
         if self._client_params is not None:
             info["head_dtype"] = str(self._client_params["lm_head"].dtype)
         return info, []
+
+    # -------------------------------------------- session-KV replication
+    async def _kv_put(self, meta: dict, tensors):
+        """Standby side of session-KV replication: install hash-addressed
+        sealed pages from a primary into the prefix pool as refcount-0
+        cached entries. Cached pages are evictable, so replication can
+        never OOM a healthy standby — a degraded pool just means a longer
+        replay on failover. Declines (installed=0 + reason) instead of
+        erroring so mixed swarms degrade to full replay."""
+        decline = None
+        if self._draining:
+            decline = "draining"
+        elif not self.manager.repl_supported:
+            decline = (
+                "kv replication unsupported (prefix cache off, quantized "
+                "or heterogeneous arena)"
+            )
+        elif int(meta.get("page_size", 0)) != self.manager.page_size:
+            decline = "page_size mismatch"
+        elif (
+            int(meta.get("start", -1)) != self.start_block
+            or int(meta.get("end", -1)) != self.end_block
+        ):
+            decline = "span mismatch"
+        if decline is not None:
+            return {"installed": 0, "reason": decline}, []
+        hashes = [str(h) for h in (meta.get("hashes") or [])]
+        if not hashes or len(tensors) != 2:
+            return {"installed": 0, "reason": "empty or malformed payload"}, []
+        k = np.asarray(tensors[0])
+        v = np.asarray(tensors[1])
+        try:
+            installed = await self.compute.submit(
+                PRIORITY_TRAINING,
+                self.manager.install_replicated, hashes, k, v,
+            )
+        except ValueError as e:
+            return {"installed": 0, "reason": str(e)}, []
+        return {"installed": int(installed)}, []
+
+    def _note_kv_repl(self, session: _Session, repl: dict) -> None:
+        """Primary side: a client's kv_repl stream item names the standby
+        and carries each row's full-history page-hash chain. Publish our
+        own freshly-sealed decode pages into the local pool under those
+        hashes (so a future session can adopt them here too), then sweep
+        the backlog to the standby in the background."""
+        standby = repl.get("standby") or {}
+        chains = [list(c) for c in (repl.get("chains") or [])]
+        if not standby.get("host") or not chains:
+            return
+        if (
+            session.repl_sent is None
+            or len(session.repl_sent) != len(chains)
+        ):
+            session.repl_sent = [0] * len(chains)
+        session.repl_standby = (str(standby["host"]), int(standby["port"]))
+        session.repl_chains = chains
+        try:
+            self.manager.extend_seq_hashes(session.handle, chains)
+        except Exception as e:
+            logger.debug("extend_seq_hashes failed: %s", e)
+        task = asyncio.create_task(self._replicate_session(session))
+        # step_tasks membership matters: the session loop gathers these
+        # before the allocate context frees the pages a sweep is exporting
+        session.step_tasks.add(task)
+        task.add_done_callback(session.step_tasks.discard)
+
+    async def _replicate_session(self, session: _Session) -> None:
+        """Drain the session's replication backlog. Serialized per session
+        (repl_sent is the only progress state); re-sweeps until no pages
+        ship, since the chains may grow while a sweep is in flight."""
+        if session.repl_lock.locked():
+            return  # an earlier trigger is still draining the backlog
+        async with session.repl_lock:
+            while await self._replicate_pass(session):
+                pass
+
+    async def _replicate_pass(self, session: _Session) -> bool:
+        """One sweep over the session's rows; True when any pages shipped
+        (caller sweeps again). Failures leave repl_sent untouched so the
+        next kv_repl trigger retries; a standby DECLINE stops replication
+        for this session — the client re-picks a standby on recovery."""
+        standby = session.repl_standby
+        chains = session.repl_chains
+        sent_by_row = session.repl_sent
+        if standby is None or not chains or sent_by_row is None:
+            return False
+        ps = self.manager.page_size
+        seq_ids = session.handle.seq_ids
+        progress = False
+        for row, chain in enumerate(chains):
+            if row >= len(seq_ids) or row >= len(sent_by_row):
+                break
+            sent = sent_by_row[row]
+            if sent >= len(chain):
+                continue
+            async with self._repl_sem:
+                try:
+                    res = await self.compute.submit(
+                        PRIORITY_TRAINING, self.manager.export_pages,
+                        seq_ids[row], sent, len(chain),
+                    )
+                except Exception as e:
+                    logger.debug("kv replication export failed: %s", e)
+                    return False
+                if res is None:
+                    continue  # row parked/adopted/unsupported — skip
+                k_dev, v_dev, hi = res
+                n = int(hi) - sent
+                if n <= 0:
+                    continue
+                # device [L, n*ps, kv, hd] -> host [n, L, ps, kv, hd]
+                # (one leading page axis so the standby scatters per hash)
+                k = await asyncio.to_thread(np.asarray, k_dev)
+                v = await asyncio.to_thread(np.asarray, v_dev)
+                shape = (k.shape[0], n, ps) + k.shape[2:]
+                k = np.ascontiguousarray(
+                    np.swapaxes(k.reshape(shape), 0, 1)
+                )
+                v = np.ascontiguousarray(
+                    np.swapaxes(v.reshape(shape), 0, 1)
+                )
+                try:
+                    conn = await self.peers.get(*standby)
+                    reply, _ = await conn.call(
+                        "kv_put",
+                        {
+                            "page_size": ps,
+                            "start": self.start_block,
+                            "end": self.end_block,
+                            "hashes": list(chain[sent:int(hi)]),
+                        },
+                        [k, v],
+                        timeout=30.0,
+                    )
+                except Exception as e:
+                    logger.debug("kv replication push failed: %s", e)
+                    return False
+                installed = (
+                    int(reply.get("installed", 0))
+                    if isinstance(reply, dict) else 0
+                )
+                if installed <= 0:
+                    logger.info(
+                        "standby %s:%d declined kv_put (%s); stopping "
+                        "replication for session %s", standby[0], standby[1],
+                        (reply or {}).get("reason", "?"), session.id,
+                    )
+                    session.repl_standby = None
+                    return False
+                sent_by_row[row] = int(hi)
+                self.repl_pages_sent += n
+                progress = True
+        return progress
+
+    def _repl_lag(self) -> int:
+        """Gauge: sealed-but-unshipped pages across replicating sessions
+        (bounds how much a failover would replay beyond the unsealed
+        tail)."""
+        lag = 0
+        for s in self._sessions.values():
+            if not s.repl_chains or s.repl_sent is None:
+                continue
+            for row, chain in enumerate(s.repl_chains):
+                if row < len(s.repl_sent):
+                    lag += max(0, len(chain) - s.repl_sent[row])
+        return lag
 
     async def _rpc_inference(self, stream: Stream) -> None:
         """One decode session. Open meta: {session_id, batch_size, max_length,
@@ -1096,6 +1318,15 @@ class BlockServer:
             # stream): errors go back to the coordinator via chain_error,
             # not to our own client's stream
             await self._run_chain_step(session, meta, tensors)
+            return
+        repl = meta.get("kv_repl")
+        if repl is not None:
+            # async session-KV replication control: record the standby +
+            # the client's full-history hash chains, publish our own
+            # sealed decode pages locally under those hashes, and schedule
+            # shipping the backlog. Fire-and-forget: NO reply (a reply
+            # would desync the client's strictly-ordered step stream).
+            self._note_kv_repl(session, repl)
             return
         # client deadline budget: "deadline_s" is RELATIVE remaining time
         # (never an absolute timestamp — clocks differ across machines);
@@ -1974,7 +2205,13 @@ class BlockServer:
                 adapter=session.adapter,
             )
         if commit_lens is not None:
+            # ragged explicit-length commit only happens on an id-session
+            # failover replay: account the replayed tokens so the chaos
+            # tests can assert the replication bound from rpc_info
             self.manager.commit(handle, lengths=commit_lens)
+            self.failover_replayed_tokens += int(
+                hidden.shape[0] * hidden.shape[1]
+            )
         dt_ms = (time.perf_counter() - t0) * 1000.0
         if env.log_channel_enabled("timing"):
             logger.info(

@@ -50,6 +50,11 @@ class ServerInfo:
     # build page-aligned hash chains from it); 0 = no prefix cache, don't
     # probe. Unknown-field filtering in from_wire keeps old peers happy.
     page_size: int = 0
+    # True when this server accepts kv_put page replication into its
+    # prefix pool (prefix cache on, dense unquantized arena). Standby
+    # selection requires it; old peers default to False via from_wire's
+    # unknown-field filtering, so mixed swarms just never replicate.
+    kv_repl: bool = False
 
     def to_wire(self) -> dict:
         d = dataclasses.asdict(self)

@@ -1,0 +1,23 @@
+"""Chaos gate: scripts/chaos.sh must pass as part of the tier-1 suite.
+
+The script replays every chaos-marked test under a fixed BBTPU_CHAOS_*
+seed matrix (ambient wire jitter on top of the tests' own seeded fault
+plans), so fault-recovery paths are exercised with injected noise on
+every run — not only when an operator remembers to soak them. It exits 0
+when pytest is unavailable, mirroring the scripts/lint.sh contract.
+"""
+
+import pathlib
+import subprocess
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_chaos_suite_under_seed_matrix():
+    proc = subprocess.run(
+        ["bash", str(REPO / "scripts" / "chaos.sh")],
+        cwd=REPO, capture_output=True, text=True, timeout=580,
+    )
+    assert proc.returncode == 0, (
+        f"chaos regressions:\n{proc.stdout[-8000:]}\n{proc.stderr[-4000:]}"
+    )

@@ -1,0 +1,490 @@
+"""Fast failover: incremental KV checkpoint replication to standbys.
+
+The correctness bar (ISSUE 4): with replication on, killing the primary
+mid-decode must recover token-identically to an uninterrupted greedy run
+while replaying at most one replication interval plus the unsealed tail
+(counter-asserted); mixed swarms (standby without support, replication
+off) must degrade byte-for-byte to today's full-history replay; kv_put
+installs only into prefix pools as evictable refcount-0 pages; and
+embed-less (hidden-history) sessions probe-and-skip on recovery too.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bloombee_tpu.client.config import ClientConfig
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.client.session import InferenceSession
+from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+from bloombee_tpu.kv.paged import PagedKVTable
+from bloombee_tpu.kv.prefix import hidden_hash_chain, page_hash_chain
+from bloombee_tpu.server.block_server import BlockServer
+from bloombee_tpu.wire import faults
+from bloombee_tpu.wire.faults import FaultPlan, FaultRule
+from bloombee_tpu.wire.rpc import connect
+from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=3,
+        vocab_size=128,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = tmp_path_factory.mktemp("tiny_llama_repl")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model, config
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_plan():
+    yield
+    faults.set_plan(None)
+
+
+def _server(model_dir, registry, start, end, **kw):
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prefix_cache", True)
+    return BlockServer(
+        model_uid="tiny", start=start, end=end, model_dir=model_dir,
+        registry=registry, **kw,
+    )
+
+
+def _hf_greedy(model, input_ids, max_new_tokens):
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor(input_ids), max_new_tokens=max_new_tokens,
+            do_sample=False, use_cache=True,
+        )
+    return out.numpy()
+
+
+def _assert_no_leaks(server):
+    table = server.manager.table
+    c = table.counts()
+    assert c["free"] + c["referenced"] + c["cached"] == table.num_pages, c
+    assert c["referenced"] == 0, c
+
+
+async def _greedy_decode(model, session, out, n, dtype=np.int64):
+    """Decode `n` greedy tokens from the last-position output `out`,
+    stepping EVERY token (so its page count is deterministic at the call
+    boundary); returns (new_ids [B, n], out). Mirrors model.generate's
+    loop but lets a test split one generation around a mid-decode kill."""
+    new = np.zeros((out.shape[0], 0), dtype=dtype)
+    for _ in range(n):
+        logits = model.logits(out[:, -1:])[:, 0]
+        nxt = np.argmax(logits, axis=-1).astype(dtype)[:, None]
+        new = np.concatenate([new, nxt], axis=1)
+        out = await session.step(model.embed(nxt), ids=nxt)
+    return new, out
+
+
+async def _wait_installed(standby, pages, timeout_s=10.0):
+    """Poll until the standby's prefix pool holds `pages` replicated
+    pages (replication is asynchronous on the primary)."""
+    for _ in range(int(timeout_s / 0.05)):
+        if standby.manager.prefix_stats()["repl_pages_installed"] >= pages:
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(
+        f"standby never installed {pages} pages: "
+        f"{standby.manager.prefix_stats()}"
+    )
+
+
+# ------------------------------------------------------------------- units
+def test_hidden_hash_chain_shapes_and_roots():
+    rng = np.random.default_rng(0)
+    hidden = rng.standard_normal((10, 8)).astype(np.float32)
+    chain = hidden_hash_chain(hidden, 4)
+    assert len(chain) == 2  # one digest per FULL page only
+    # incremental extension never rehashes sealed pages
+    partial = hidden_hash_chain(hidden[:8], 4)
+    assert hidden_hash_chain(hidden, 4, chain=partial) == chain
+    # chained: a different second page changes digest 2, not digest 1
+    other = hidden.copy()
+    other[7] += 1.0
+    chain2 = hidden_hash_chain(other, 4)
+    assert chain2[0] == chain[0] and chain2[1] != chain[1]
+    # distinct root from id chains: equal byte content can never alias
+    ids_chain = page_hash_chain(list(range(8)), 4)
+    assert set(ids_chain).isdisjoint(hidden_hash_chain(hidden[:8], 4))
+    with pytest.raises(ValueError):
+        hidden_hash_chain(hidden[0], 4)  # rows must be [T, D]
+
+
+def test_install_cached_evictable_never_referenced():
+    t = PagedKVTable(num_pages=3, page_size=4)
+    p = t.install_cached("h1")
+    assert p is not None and t._pool["h1"] == p
+    assert t.install_cached("h1") is None  # duplicate: no-op
+    c = t.counts()
+    assert (c["free"], c["referenced"], c["cached"]) == (2, 0, 1)
+    # referenced pages are never stolen: with 2 pages pinned by a live
+    # sequence, installs churn through the single remaining page
+    t.add_seq(0)
+    t.reserve(0, 8)
+    assert t.install_cached("h2") is not None
+    assert t.install_cached("h3") is not None  # evicts the coldest ("h1")
+    c = t.counts()
+    assert (c["free"], c["referenced"], c["cached"]) == (0, 2, 1)
+    assert "h1" not in t._pool and "h3" in t._pool
+    # fully-referenced table: install declines instead of stealing
+    t2 = PagedKVTable(num_pages=1, page_size=4)
+    t2.add_seq(0)
+    t2.reserve(0, 4)
+    assert t2.install_cached("h") is None
+
+
+def test_kv_put_declines_on_unsupported_server(tiny_model_dir):
+    """kv_put against a server without the prefix cache (and against a
+    mismatched page geometry) declines with installed=0 + reason instead
+    of erroring — the mixed-swarm contract."""
+    model_dir, _, _ = tiny_model_dir
+
+    async def run():
+        s_off = _server(model_dir, None, 0, 3, prefix_cache=False)
+        s_on = _server(model_dir, None, 0, 3)
+        for s in (s_off, s_on):
+            await s.start()
+        k = np.zeros((1, 3, 4, 2, 16), np.float32)
+        payload = {"page_size": 4, "start": 0, "end": 3, "hashes": ["h"]}
+        try:
+            conn = await connect("127.0.0.1", s_off.port)
+            meta, _ = await conn.call("kv_put", payload, [k, k])
+            assert meta["installed"] == 0 and "unsupported" in meta["reason"]
+            await conn.close()
+
+            conn = await connect("127.0.0.1", s_on.port)
+            meta, _ = await conn.call(
+                "kv_put", {**payload, "page_size": 8}, [k, k]
+            )
+            assert meta["installed"] == 0 and "page_size" in meta["reason"]
+            meta, _ = await conn.call(
+                "kv_put", {**payload, "end": 2}, [k, k]
+            )
+            assert meta["installed"] == 0 and "span" in meta["reason"]
+            await conn.close()
+        finally:
+            for s in (s_off, s_on):
+                await s.stop()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------- failover e2e
+@pytest.mark.chaos
+def test_failover_replays_one_interval_token_identical(tiny_model_dir):
+    """Primary dies mid-decode with replication on: the client recovers
+    onto the standby, the probe adopts the replicated pages, and the
+    replay is bounded by one replication interval + the unsealed tail —
+    while the full generation stays token-identical to HF greedy."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s_a = _server(model_dir, rc(), 0, 3, throughput=10.0)
+        s_b = _server(model_dir, rc(), 0, 3, throughput=1.0)
+        for s in (s_a, s_b):
+            await s.start()
+
+        # 12-token prompt + 4 decoded = 16 tokens: exactly 4 sealed pages
+        # at page_size 4, so a caught-up standby bounds the replay to the
+        # skip cap's single token
+        input_ids = (np.arange(12)[None, :] * 5 + 3) % config.vocab_size
+        ref = _hf_greedy(hf_model, input_ids, 9)
+
+        cfg = ClientConfig(
+            use_push=False, prefix_cache=True, kv_repl_every=1,
+            ban_timeout=0.5, ban_max=2.0,
+        )
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", config=cfg
+        )
+        session = model.inference_session(28, 1)
+        await session.__aenter__()
+        assert session._standby_peers()  # a standby was selected
+        primary_port = session._spans[0].span.server_info.port
+        primary = s_a if s_a.port == primary_port else s_b
+        standby = s_b if primary is s_a else s_a
+
+        out = await session.step(model.embed(input_ids), ids=input_ids)
+        first, out = await _greedy_decode(
+            model, session, out, 4, dtype=input_ids.dtype
+        )
+        # 16 committed tokens -> 4 sealed pages, all announced (interval 1)
+        await _wait_installed(standby, pages=4)
+        # the standby installs before the primary's kv_put reply lands, so
+        # give the sender's bookkeeping a beat to catch up
+        for _ in range(100):
+            if primary.repl_pages_sent >= 4:
+                break
+            await asyncio.sleep(0.05)
+        assert primary.repl_pages_sent >= 4
+
+        # the sender-side counters ride the primary's rpc_info
+        conn = await connect("127.0.0.1", primary.port)
+        info, _ = await conn.call("rpc_info", {})
+        assert info["repl_pages_sent"] >= 4
+        assert info["repl_lag_pages"] == 0
+        assert info["kv_repl"] is True
+        await conn.close()
+
+        await primary.stop()
+        rest, _ = await _greedy_decode(
+            model, session, out, 5, dtype=input_ids.dtype
+        )
+        await session.__aexit__(None, None, None)
+        np.testing.assert_array_equal(
+            np.concatenate([input_ids, first, rest], axis=1), ref
+        )
+
+        # the replay was one token, not the 16-token history: 4 sealed
+        # pages all matched on the standby, skip capped at len - 1
+        page_size, repl_every = 4, 1
+        assert 0 < session.failover_replayed_tokens
+        assert session.failover_replayed_tokens < (
+            page_size * repl_every + 1
+        )
+        # the standby (now primary) saw the same replay server-side and
+        # installed the pages as evictable cached entries
+        conn = await connect("127.0.0.1", standby.port)
+        info, _ = await conn.call("rpc_info", {})
+        assert info["repl_pages_installed"] >= 4
+        assert (
+            info["failover_replayed_tokens"]
+            == session.failover_replayed_tokens
+        )
+        await conn.close()
+
+        await asyncio.sleep(0.2)  # server-side session teardown is async
+        _assert_no_leaks(standby)
+        await standby.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.chaos
+def test_failover_mixed_swarm_full_replay(tiny_model_dir):
+    """Standby without prefix-cache support: the client finds no capable
+    standby (kv_repl not advertised), replicates nothing, and recovery
+    degrades to today's full-history replay — still token-identical."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s_a = _server(model_dir, rc(), 0, 3, throughput=10.0)
+        s_b = _server(
+            model_dir, rc(), 0, 3, throughput=1.0, prefix_cache=False
+        )
+        for s in (s_a, s_b):
+            await s.start()
+
+        input_ids = (np.arange(12)[None, :] * 7 + 1) % config.vocab_size
+        ref = _hf_greedy(hf_model, input_ids, 9)
+
+        cfg = ClientConfig(
+            use_push=False, prefix_cache=True, kv_repl_every=1,
+            ban_timeout=0.5, ban_max=2.0,
+        )
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", config=cfg
+        )
+        session = model.inference_session(28, 1)
+        await session.__aenter__()
+        assert not session._standby_peers()  # nothing capable to pick
+        primary_port = session._spans[0].span.server_info.port
+        primary = s_a if s_a.port == primary_port else s_b
+        assert primary is s_a  # the only prefix-cache server wins routing
+
+        out = await session.step(model.embed(input_ids), ids=input_ids)
+        first, out = await _greedy_decode(
+            model, session, out, 4, dtype=input_ids.dtype
+        )
+        assert s_b.manager.prefix_stats()["repl_pages_installed"] == 0
+        assert s_a.repl_pages_sent == 0
+
+        await primary.stop()
+        rest, _ = await _greedy_decode(
+            model, session, out, 5, dtype=input_ids.dtype
+        )
+        await session.__aexit__(None, None, None)
+        np.testing.assert_array_equal(
+            np.concatenate([input_ids, first, rest], axis=1), ref
+        )
+        # nothing was replicated, so the whole 16-token committed history
+        # replayed through s_b (which can't probe: its cache is off)
+        assert session.failover_replayed_tokens == 16
+
+        await asyncio.sleep(0.2)  # server-side session teardown is async
+        await s_b.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.chaos
+def test_failover_hidden_history_probes_standby(tiny_model_dir):
+    """Embed-less session (raw hidden steps, no ids): replication keys
+    pages by hidden-byte chains, and recovery's hidden replay path now
+    probes them — the standby hit trims the replay exactly like the id
+    path. Post-failover outputs match an uninterrupted session."""
+    model_dir, _, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s_a = _server(model_dir, rc(), 0, 3, throughput=10.0)
+        s_b = _server(model_dir, rc(), 0, 3, throughput=1.0)
+        for s in (s_a, s_b):
+            await s.start()
+        manager = RemoteSequenceManager(rc(), "tiny", 3)
+
+        rng = np.random.default_rng(3)
+        steps = [
+            rng.standard_normal((1, 12, config.hidden_size))
+            .astype(np.float32) * 0.02
+        ] + [
+            rng.standard_normal((1, 1, config.hidden_size))
+            .astype(np.float32) * 0.02
+            for _ in range(9)
+        ]
+
+        # uninterrupted reference outputs for the post-failover steps
+        ref_out = []
+        s_ref = InferenceSession(
+            manager, max_length=28, batch_size=1, prefix_cache=True,
+            repl_every=0,
+        )
+        async with s_ref:
+            for h in steps:
+                ref_out.append(await s_ref.step(h))
+
+        s = InferenceSession(
+            manager, max_length=28, batch_size=1, prefix_cache=True,
+            repl_every=1,
+        )
+        async with s:
+            for h in steps[:5]:  # 12 + 4 tokens = 4 sealed pages
+                await s.step(h)
+            primary_port = s._spans[0].span.server_info.port
+            primary = s_a if s_a.port == primary_port else s_b
+            standby = s_b if primary is s_a else s_a
+            await _wait_installed(standby, pages=4)
+            await primary.stop()
+            for i, h in enumerate(steps[5:], start=5):
+                out = await s.step(h)
+                np.testing.assert_allclose(
+                    out, ref_out[i], rtol=0, atol=1e-4,
+                    err_msg=f"step {i} diverged after failover",
+                )
+            # probe-and-skip on the hidden path: replay = the skip-capped
+            # single token, not the 16-token history
+            assert s.failover_replayed_tokens == 1
+
+        await asyncio.sleep(0.2)  # server-side session teardown is async
+        _assert_no_leaks(standby)
+        await standby.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.chaos
+def test_drain_flushes_replication_backlog(tiny_model_dir):
+    """A draining primary (SIGTERM path) flushes pending replication to
+    the standby before exiting, so sessions it abandons fail over with at
+    most the unsealed tail to replay."""
+    model_dir, _, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s_a = _server(model_dir, rc(), 0, 3, throughput=10.0)
+        s_b = _server(model_dir, rc(), 0, 3, throughput=1.0)
+        for s in (s_a, s_b):
+            await s.start()
+        manager = RemoteSequenceManager(rc(), "tiny", 3)
+
+        rng = np.random.default_rng(5)
+        s = InferenceSession(
+            manager, max_length=28, batch_size=1, prefix_cache=True,
+            repl_every=1,
+        )
+        async with s:
+            # the first kv_put to EITHER server resets: the primary's
+            # background sweep fails and leaves the whole 4-page backlog
+            # pending, so only the drain-time flush can deliver it
+            plan = FaultPlan(seed=7)
+            for srv in (s_a, s_b):
+                plan.add(FaultRule(site="send", action="reset",
+                                   method="kv_put", port=srv.port,
+                                   nth=1, count=1))
+            faults.set_plan(plan)
+            await s.step(
+                rng.standard_normal((1, 16, config.hidden_size))
+                .astype(np.float32) * 0.02
+            )
+            primary_port = s._spans[0].span.server_info.port
+            primary = s_a if s_a.port == primary_port else s_b
+            standby = s_b if primary is s_a else s_a
+            for _ in range(100):  # wait for the failed sweep to settle
+                if ("send", "reset") in {(x, a) for x, a, _ in plan.log}:
+                    break
+                await asyncio.sleep(0.05)
+            await asyncio.sleep(0.1)
+            assert (
+                standby.manager.prefix_stats()["repl_pages_installed"] == 0
+            )
+            assert primary._repl_lag() == 4
+            # drain with the session still open: the flush must push the
+            # whole backlog even though the session never closes here
+            await primary.drain(timeout=0.5)
+            assert (
+                standby.manager.prefix_stats()["repl_pages_installed"] >= 4
+            )
+
+        await asyncio.sleep(0.2)
+        await standby.stop()
+        await reg.stop()
+
+    asyncio.run(run())
