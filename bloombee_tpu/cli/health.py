@@ -82,6 +82,29 @@ def main(argv=None):
                         line += "  " + " ".join(
                             f"{k}={v}" for k, v in sorted(repl.items())
                         )
+                    # stall-free scheduling counters: is chunked prefill
+                    # firing, and are decode steps actually landing
+                    # between chunks
+                    sched = {
+                        k: probe[k]
+                        for k in (
+                            "prefill_chunks",
+                            "prefill_chunk_tokens",
+                            "decode_steps_interleaved",
+                        )
+                        if probe.get(k)
+                    }
+                    if sched:
+                        line += "  " + " ".join(
+                            f"{k}={v}" for k, v in sorted(sched.items())
+                        )
+                    waits = probe.get("queue_wait_ms") or {}
+                    for cls in ("prefill", "decode"):
+                        w = waits.get(cls) or {}
+                        if w.get("p95"):
+                            line += (
+                                f"  {cls}_wait_p95={w['p95']:.1f}ms"
+                            )
                 except Exception as e:
                     line += f"  [UNREACHABLE: {type(e).__name__}]"
                 finally:

@@ -60,6 +60,14 @@ def main(argv=None):
                              "concurrent sessions' single-token decode "
                              "steps into one span dispatch (1 disables; "
                              "gather window via BBTPU_BATCH_WINDOW_MS)")
+    parser.add_argument("--prefill-chunk", type=int, default=None,
+                        help="stall-free scheduling: split prefills into "
+                             "chunks of at most this many tokens, each its "
+                             "own compute-queue task, so concurrent "
+                             "sessions' decode steps interleave between "
+                             "chunks (0 = monolithic prefill; default "
+                             "follows BBTPU_PREFILL_CHUNK; aging via "
+                             "BBTPU_CHUNK_AGE_S)")
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["bfloat16", "float32"])
     parser.add_argument("--adapter-dirs", nargs="*", default=None,
@@ -173,6 +181,7 @@ def main(argv=None):
             num_pages=args.num_pages, page_size=args.page_size,
             compute_dtype=dtype, max_chunk_tokens=args.max_chunk_tokens,
             max_batch=args.max_batch,
+            prefill_chunk=args.prefill_chunk,
             announce_period=args.announce_period,
             adapter_dirs=args.adapter_dirs,
             adapters=parse_adapters(args.adapters),

@@ -61,6 +61,14 @@ env.declare(
     "when the prompt has at least this many tokens; short prefills stay "
     "single-chip (chunk overhead + collectives would dominate)",
 )
+env.declare(
+    "BBTPU_PREFILL_CHUNK", int, 0,
+    "stall-free scheduling (Sarathi-Serve): split prefills into chunks of "
+    "at most this many tokens, each a separate compute-queue task so "
+    "queued decode steps run between chunks (0 = monolithic prefill, one "
+    "queue task for the whole prompt). Rounded to a power of two so every "
+    "chunk hits the same compiled bucket",
+)
 
 
 def next_pow2(n: int, floor: int = 1) -> int:
@@ -68,6 +76,28 @@ def next_pow2(n: int, floor: int = 1) -> int:
     while v < n:
         v *= 2
     return v
+
+
+def plan_prefill_chunks(
+    t: int, budget: int, cap: int | None = None
+) -> list[tuple[int, int]]:
+    """Split a t-token prefill into [start, end) chunk spans of at most
+    `budget` tokens each (pow2-rounded so every full chunk compiles into
+    the SAME (batch, tokens) bucket; `cap` bounds the rounded budget, e.g.
+    at max_chunk_tokens). budget<=0 or t<=budget -> one whole-prompt span,
+    i.e. chunking disabled."""
+    if budget <= 0 or t <= budget:
+        return [(0, t)]
+    b = next_pow2(int(budget))
+    if b > budget:
+        b //= 2  # round DOWN: never exceed the operator's token budget
+    if cap is not None:
+        while b > cap:
+            b //= 2
+    b = max(1, b)
+    if t <= b:
+        return [(0, t)]
+    return [(s, min(s + b, t)) for s in range(0, t, b)]
 
 
 @functools.partial(jax.jit, donate_argnames=("arena_k", "arena_v"))
@@ -264,6 +294,78 @@ class SpanExecutor:
         cat = np.concatenate if fetch else jnp.concatenate
         return cat(outs, axis=1)
 
+    def prefill_chunk(
+        self,
+        handle: CacheHandle,
+        hidden: np.ndarray,
+        commit: bool = False,
+        layers: tuple[int, int] | None = None,
+        fetch: bool = False,
+        adapter: str | None = None,
+    ):
+        """Run ONE chunk of a resumable chunked prefill (Sarathi-Serve
+        stall-free batching): the caller slices the prompt with
+        `plan_prefill_chunks` and submits each chunk as its OWN compute
+        task, letting decode steps interleave between chunks.
+
+        The position offset carries automatically: `_step` reads the
+        handle's current context length (which includes earlier chunks'
+        speculative tokens) as the rotary/write start. Chunks should run
+        with commit=False — speculative writes let a mid-prefill abort
+        free every partial page via `manager.rollback`; the caller commits
+        the handle once after the final chunk, exactly like the batched
+        decode path."""
+        if hidden.shape[1] > self.max_chunk_tokens:
+            # one queue task must stay one device dispatch — feeding a
+            # chunk bigger than the attention-memory bound would silently
+            # re-monolith the schedule
+            raise ValueError(
+                f"prefill chunk of {hidden.shape[1]} tokens exceeds "
+                f"max_chunk_tokens={self.max_chunk_tokens}"
+            )
+        return self._step(
+            handle, hidden, commit=commit, layers=layers, fetch=fetch,
+            adapter=adapter,
+        )
+
+    def prefill_chunked(
+        self,
+        handle: CacheHandle,
+        hidden: np.ndarray,
+        chunk_tokens: int,
+        commit: bool = True,
+        layers: tuple[int, int] | None = None,
+        fetch: bool = True,
+        adapter: str | None = None,
+    ):
+        """Whole-prompt prefill via the chunked path, all chunks in ONE
+        call (no queue re-entry — warmup and tests; the server drives
+        chunks through the compute queue itself). Token-identical to
+        `prefill`: same program, same buckets, positions carried across
+        chunks; speculative writes committed after the last chunk."""
+        spans = plan_prefill_chunks(
+            hidden.shape[1], chunk_tokens, cap=self.max_chunk_tokens
+        )
+        outs = []
+        try:
+            for s, e in spans:
+                outs.append(
+                    self.prefill_chunk(
+                        handle, hidden[:, s:e], commit=False, layers=layers,
+                        fetch=fetch, adapter=adapter,
+                    )
+                )
+        except Exception:
+            if self.manager.epoch_valid(handle):
+                self.manager.rollback(handle)
+            raise
+        if commit:
+            self.manager.commit(handle)
+        if len(outs) == 1:
+            return outs[0]
+        cat = np.concatenate if fetch else jnp.concatenate
+        return cat(outs, axis=1)
+
     def _sp_eligible(self, handle, t, commit, layers, adapter) -> bool:
         """Sequence-parallel prefill fires for a FRESH full-span committed
         prefill of a long prompt (starts all zero); everything else takes
@@ -385,7 +487,12 @@ class SpanExecutor:
 
     def fetch(self, out) -> np.ndarray:
         """Materialize a fetch=False result on host in the wire dtype
-        (blocks on the device round trip — call off the compute queue)."""
+        (blocks on the device round trip — call off the compute queue).
+        A list of per-chunk results concatenates along the token axis."""
+        if isinstance(out, (list, tuple)):
+            return np.concatenate(
+                [np.asarray(o) for o in out], axis=1
+            ).astype(self.transfer_dtype)
         return np.asarray(out).astype(self.transfer_dtype)
 
     def decode_n(

@@ -35,12 +35,13 @@ from bloombee_tpu.kv.cache_manager import (
     SessionKVLost,
 )
 from bloombee_tpu.models.spec import ModelSpec
-from bloombee_tpu.runtime.executor import SpanExecutor
+from bloombee_tpu.runtime.executor import SpanExecutor, plan_prefill_chunks
 from bloombee_tpu.server.compute_queue import (
     PRIORITY_INFERENCE,
     PRIORITY_TRAINING,
     ComputeQueue,
     DeadlineExpired,
+    aged_chunk_priority,
 )
 from bloombee_tpu.swarm.data import ServerInfo, ServerState
 from bloombee_tpu.utils import env
@@ -245,6 +246,12 @@ class BlockServer:
         # cache: pool committed prompt pages under content hashes, adopt
         # them into matching sessions, prefill only the suffix
         # (None -> BBTPU_PREFIX_CACHE env; forces the Python paged table)
+        prefill_chunk: int | None = None,  # stall-free scheduling
+        # (Sarathi-Serve): split each prefill into chunks of at most this
+        # many tokens, each its own compute-queue task, so concurrent
+        # sessions' decode steps run between chunks instead of stalling
+        # behind the whole prompt (0 = monolithic prefill; None ->
+        # BBTPU_PREFILL_CHUNK env)
     ):
         self.model_dir = model_dir
         if weight_quant is None:
@@ -459,6 +466,16 @@ class BlockServer:
         self.batched_steps = 0
         self.batch_dispatches = 0
         self.batch_solo_steps = 0
+        # stall-free scheduling (chunked prefill): the per-server chunk
+        # token budget (None -> BBTPU_PREFILL_CHUNK env, 0 = monolithic),
+        # chunk/token counters, decode steps that dispatched while some
+        # session's prefill was mid-stream (the interleaving this feature
+        # exists for), and the live count of mid-stream chunked prefills
+        self.prefill_chunk = prefill_chunk
+        self.prefill_chunks = 0
+        self.prefill_chunk_tokens = 0
+        self.decode_steps_interleaved = 0
+        self._chunking_sessions = 0
         # session-KV replication (fast failover): sealed pages this primary
         # shipped to standbys, and tokens recovering clients replayed into
         # us; the semaphore bounds concurrent replication sweeps so standby
@@ -625,6 +642,35 @@ class BlockServer:
                 logger.info("warmed buckets for batch %d", b)
             except Exception as e:
                 logger.warning("warmup(batch=%d) failed: %s", b, e)
+        budget = self._chunk_budget()
+        if budget > 0 and self.executor.sp_mesh is None:
+            # chunked prefill hits buckets the whole-prompt warmup above
+            # misses: the chunk-sized token bucket, and (for continuation
+            # chunks) the next page bucket up — run a two-chunk prefill so
+            # the first real chunked prompt doesn't eat the compile stall
+            # this scheduler exists to remove
+            try:
+                spans = plan_prefill_chunks(
+                    2 * budget, budget, cap=self.executor.max_chunk_tokens
+                )
+                tokens = spans[-1][1]
+                async with self.manager.allocate(
+                    1, tokens + 1, timeout=5.0
+                ) as handle:
+                    hidden = np.zeros(
+                        (1, tokens, self.spec.hidden_size), np.float32
+                    )
+                    out = await self.compute.submit(
+                        PRIORITY_TRAINING, self.executor.prefill_chunked,
+                        handle, hidden, budget, True, None, False,
+                    )
+                    await asyncio.to_thread(self.executor.fetch, out)
+                logger.info(
+                    "warmed chunked-prefill buckets (%d chunks of <= %d "
+                    "tokens)", len(spans), spans[0][1] - spans[0][0],
+                )
+            except Exception as e:
+                logger.warning("chunk warmup failed: %s", e)
         if self.executor.sp_mesh is not None:
             # pre-compile the sp-prefill program at its smallest bucket:
             # the whole-span shard_map compile is exactly what would
@@ -937,7 +983,16 @@ class BlockServer:
                 self.batched_steps / self.batch_dispatches
                 if self.batch_dispatches else 0.0
             ),
+            # includes per-class sub-dicts ("prefill"/"decode"): bounded
+            # decode wait DURING a long prefill is the stall-free signal
             "queue_wait_ms": self.compute.wait_stats_ms(),
+            # stall-free scheduling observability (chunked prefill):
+            # chunk tasks run, prompt tokens prefilled through the chunked
+            # path, and decode steps that dispatched while a prefill was
+            # mid-stream (> 0 means prefills no longer head-of-line-block)
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
+            "decode_steps_interleaved": self.decode_steps_interleaved,
             # prefix-cache observability: sessions that adopted pooled
             # prompt pages, tokens they skipped prefilling, copy-on-write
             # page splits, and current cached-pool occupancy (plus
@@ -1448,21 +1503,37 @@ class BlockServer:
                     _BatchMember(session, handle, hidden),
                     self._compute_step_group,
                     deadline=deadline,
+                    task_class="decode",
                 )
             else:
-                out_dev, t_dispatch_ms = await self.compute.submit(
-                    PRIORITY_INFERENCE,
-                    self._compute_step,
-                    session,
-                    handle,
-                    hidden,
-                    commit,
-                    tree_mask,
-                    depths,
-                    commit_lens,
-                    meta.get("prefix_skip"),
-                    deadline=deadline,
+                spans = self._chunk_spans(
+                    hidden, commit, tree_mask, commit_lens
                 )
+                if spans is not None:
+                    # stall-free scheduling: the prefill becomes a stream
+                    # of resumable chunk tasks re-entering the priority
+                    # queue, so other sessions' decode steps run between
+                    # chunks instead of stalling behind the whole prompt
+                    out_dev, t_dispatch_ms = await self._run_chunked_prefill(
+                        session, handle, hidden, spans, deadline,
+                        meta.get("prefix_skip"),
+                    )
+                else:
+                    is_prefill = hidden.shape[1] > 1 and tree_mask is None
+                    out_dev, t_dispatch_ms = await self.compute.submit(
+                        PRIORITY_INFERENCE,
+                        self._compute_step,
+                        session,
+                        handle,
+                        hidden,
+                        commit,
+                        tree_mask,
+                        depths,
+                        commit_lens,
+                        meta.get("prefix_skip"),
+                        deadline=deadline,
+                        task_class="prefill" if is_prefill else "decode",
+                    )
         except DeadlineExpired:
             self._note_deadline_expired(meta, "while queued")
             return
@@ -2160,6 +2231,155 @@ class BlockServer:
             logger.warning("decode_n unavailable (client params): %s", e)
             self._client_params_unavailable = True
 
+    # ------------------------------------------- stall-free chunked prefill
+    def _chunk_budget(self) -> int:
+        """Per-prefill chunk token budget: the server ctor value wins,
+        else BBTPU_PREFILL_CHUNK; 0 disables (monolithic prefill)."""
+        if self.prefill_chunk is not None:
+            return int(self.prefill_chunk)
+        return int(env.get("BBTPU_PREFILL_CHUNK"))
+
+    def _chunk_spans(
+        self, hidden, commit, tree_mask, commit_lens
+    ) -> list[tuple[int, int]] | None:
+        """[start, end) chunk spans for this step, or None when the step
+        must stay one monolithic compute task. Only plain committing
+        prefills chunk: tree steps aren't prefills, speculative
+        (commit=False) and ragged-replay steps own bespoke table side
+        effects, and sp-mesh servers hand long prompts to ring attention
+        (which needs the whole prompt in one call). A suffix prefill after
+        a prefix-cache adoption chunks too — the adoption settles before
+        the first chunk."""
+        budget = self._chunk_budget()
+        if (
+            budget <= 0
+            or hidden.shape[1] <= 1
+            or tree_mask is not None
+            or not commit
+            or commit_lens is not None
+            or self.executor.sp_mesh is not None
+        ):
+            return None
+        spans = plan_prefill_chunks(
+            hidden.shape[1], budget, cap=self.executor.max_chunk_tokens
+        )
+        return spans if len(spans) > 1 else None
+
+    async def _run_chunked_prefill(
+        self, session: _Session, handle, hidden, spans, deadline,
+        prefix_skip=None,
+    ):
+        """Drive one prefill as a stream of resumable chunk tasks. Each
+        chunk is its own compute-queue submission at an AGING chunk
+        priority (fresh streams yield to queued decode steps; an old
+        stream reaches decode priority, so it cannot starve), with the
+        client deadline re-checked both between chunks (here) and at each
+        chunk's queue pop (the submit's deadline=).
+
+        Chunks write their KV speculatively; the LAST chunk's compute-
+        thread slot commits the whole prompt (same pattern as the batched
+        decode path), so any abort — deadline expiry, a failed chunk, a
+        lost arena — rolls back and frees every partial page. Returns
+        (per-chunk lazy outputs, total dispatch ms); `executor.fetch`
+        concatenates the chunk list off-queue."""
+        import time as _time
+
+        stream_t0 = _time.monotonic()
+        outs: list = []
+        total_ms = 0.0
+        last = len(spans) - 1
+        self._chunking_sessions += 1
+        try:
+            for idx, (s, e) in enumerate(spans):
+                if self._deadline_passed(deadline):
+                    raise DeadlineExpired(
+                        "client deadline expired between prefill chunks"
+                    )
+                out, dt_ms = await self.compute.submit(
+                    aged_chunk_priority(stream_t0),
+                    self._compute_prefill_chunk,
+                    session,
+                    handle,
+                    hidden[:, s:e],
+                    idx == 0,
+                    idx == last,
+                    prefix_skip,
+                    deadline=deadline,
+                    task_class="prefill",
+                )
+                outs.append(out)
+                total_ms += dt_ms
+                self.prefill_chunks += 1
+                self.prefill_chunk_tokens += int(hidden.shape[0]) * (e - s)
+        except BaseException:
+            # free the partial prefill's speculative pages — a session
+            # holding pages for a prompt nobody will finish is a leak
+            # until close; deadline-driven aborts especially must release
+            # capacity NOW (that is the point of aborting)
+            await self._abort_chunked_prefill(handle)
+            raise
+        finally:
+            self._chunking_sessions -= 1
+        return outs, total_ms
+
+    async def _abort_chunked_prefill(self, handle) -> None:
+        """Roll the handle back to its committed state, freeing the
+        aborted prefill's speculative pages. Runs on the compute thread —
+        the only thread that mutates the paged table — and is epoch-
+        guarded: an arena rebuild already invalidated (and freed) the
+        session's table state."""
+        try:
+            await self.compute.submit(
+                PRIORITY_INFERENCE, self._rollback_if_valid, handle
+            )
+        except Exception:
+            logger.warning(
+                "chunked-prefill rollback failed; pages free at session "
+                "close instead", exc_info=True,
+            )
+
+    def _rollback_if_valid(self, handle) -> None:
+        if self.manager.epoch_valid(handle):
+            self.manager.rollback(handle)
+
+    def _compute_prefill_chunk(
+        self, session: _Session, handle, hidden, first, last,
+        prefix_skip=None,
+    ):
+        """Runs on the compute thread: one chunk of a chunked prefill.
+        Same contract as _compute_step (dispatch only; fetch happens
+        off-queue) with the chunk-stream twists: the FIRST chunk settles
+        a pending prefix-cache adoption, every chunk writes speculatively,
+        and the LAST chunk commits the whole prompt."""
+        import time
+
+        if not self.manager.epoch_valid(handle):
+            raise SessionKVLost(
+                "server KV arena was rebuilt; session cache lost — replay"
+            )
+        session.last_step_at = time.monotonic()
+        t0 = time.perf_counter()
+        if first and self.manager.has_adopted(handle):
+            # settle the probe adoption before the suffix's first chunk
+            # (same semantics as _compute_step's settle)
+            self.manager.ensure_resident(handle)
+            self.manager.trim_adopted(handle, int(prefix_skip or 0))
+        out = self.executor.prefill_chunk(
+            handle, hidden, commit=False, layers=session.layers,
+            fetch=False, adapter=session.adapter,
+        )
+        if last:
+            self.manager.commit(handle)
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        if env.log_channel_enabled("timing"):
+            logger.info(
+                "[timing] session=%s prefill chunk tokens=%d%s "
+                "dispatch_ms=%.2f",
+                session.id, hidden.shape[1],
+                " (final)" if last else "", dt_ms,
+            )
+        return out, dt_ms
+
     def _compute_step(
         self, session: _Session, handle, hidden, commit, tree_mask,
         depths=None, commit_lens=None, prefix_skip=None,
@@ -2199,6 +2419,10 @@ class BlockServer:
                 fetch=False, adapter=session.adapter,
             )
         else:
+            if hidden.shape[1] == 1 and self._chunking_sessions:
+                # a decode step ran while some session's chunked prefill
+                # was mid-stream: the stall this scheduler removes
+                self.decode_steps_interleaved += 1
             out = self.executor.decode(
                 handle, hidden, commit=commit, tree_mask=tree_mask,
                 layers=session.layers, depths=depths, fetch=False,
@@ -2323,6 +2547,8 @@ class BlockServer:
         dt_ms = (time.perf_counter() - t0) * 1000.0
         self.batch_dispatches += 1
         self.batched_steps += len(group)
+        if self._chunking_sessions:
+            self.decode_steps_interleaved += len(group)
         if env.log_channel_enabled("timing"):
             logger.info(
                 "[timing] batched decode: %d sessions, %d rows, "

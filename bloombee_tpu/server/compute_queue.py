@@ -30,6 +30,11 @@ from typing import Any, Callable, Hashable
 from bloombee_tpu.utils import env
 
 PRIORITY_INFERENCE = 0.0  # reference DummyTaskPrioritizer: inference=1.0
+# resumable prefill chunks re-enter the queue BETWEEN decode steps and
+# training work: queued decode(-group) steps preempt the next chunk
+# (Sarathi-Serve's stall-free batching), but a chunk still outranks
+# forward/backward/warmup
+PRIORITY_PREFILL_CHUNK = 0.5
 PRIORITY_TRAINING = 1.0  # beats forward/backward=2.0 — same ordering
 
 env.declare(
@@ -38,6 +43,28 @@ env.declare(
     "step the worker waits this long for more same-key steps before "
     "dispatching (0 = coalesce only steps already queued, no added latency)",
 )
+env.declare(
+    "BBTPU_CHUNK_AGE_S", float, 2.0,
+    "chunked-prefill aging horizon: a chunk stream's priority decays "
+    "linearly from PRIORITY_PREFILL_CHUNK to decode priority over this "
+    "many seconds, so a constant decode load can delay a prefill but "
+    "never starve it forever",
+)
+
+
+def aged_chunk_priority(
+    stream_started_at: float, now: float | None = None
+) -> float:
+    """Priority for the next chunk of a prefill stream that began at
+    `stream_started_at` (time.monotonic()). Fresh streams yield to queued
+    decode steps; once the stream has aged past BBTPU_CHUNK_AGE_S its
+    chunks compete at decode priority (FIFO by submission order), bounding
+    worst-case prefill delay under sustained decode pressure."""
+    horizon = max(1e-9, float(env.get("BBTPU_CHUNK_AGE_S")))
+    if now is None:
+        now = time.monotonic()
+    frac = min(1.0, max(0.0, (now - stream_started_at) / horizon))
+    return PRIORITY_PREFILL_CHUNK * (1.0 - frac)
 
 # wait-time samples kept for the p50/p95 queue-wait estimate in rpc_info;
 # bounded so a long-lived server's stats track recent load, not its lifetime
@@ -58,6 +85,7 @@ class _Task:
     fut: asyncio.Future
     deadline: float | None  # time.monotonic() cutoff, checked at pop time
     enqueued_at: float
+    task_class: str | None = None  # "prefill"/"decode" wait-stat bucket
 
 
 @dataclasses.dataclass
@@ -73,6 +101,7 @@ class _GroupTask:
     fut: asyncio.Future
     deadline: float | None
     enqueued_at: float
+    task_class: str | None = None
 
 
 class ComputeQueue:
@@ -87,6 +116,10 @@ class ComputeQueue:
         self._waits: collections.deque = collections.deque(
             maxlen=_WAIT_SAMPLES
         )
+        # per-class windows ("prefill"/"decode"): chunked prefill is only
+        # stall-free if DECODE queue-wait stays bounded while chunks flow —
+        # a blended percentile would hide exactly that signal
+        self._class_waits: dict[str, collections.deque] = {}
 
     def start(self) -> None:
         self._worker_task = asyncio.create_task(self._worker())
@@ -105,19 +138,26 @@ class ComputeQueue:
                 task.fut.cancel()
         self._thread.shutdown(wait=False, cancel_futures=True)
 
-    def wait_stats_ms(self) -> dict:
-        """p50/p95 of recent queue-wait times (submit -> worker pickup), in
-        milliseconds. Rough percentile over a bounded sample window — an
-        operator signal for "is the compute queue backed up", not a
-        benchmark."""
-        if not self._waits:
+    @staticmethod
+    def _percentiles(samples) -> dict:
+        if not samples:
             return {"p50": 0.0, "p95": 0.0}
-        xs = sorted(self._waits)
+        xs = sorted(samples)
 
         def pct(p: float) -> float:
             return xs[min(len(xs) - 1, round(p * (len(xs) - 1)))] * 1000.0
 
         return {"p50": pct(0.50), "p95": pct(0.95)}
+
+    def wait_stats_ms(self) -> dict:
+        """p50/p95 of recent queue-wait times (submit -> worker pickup), in
+        milliseconds, overall plus per task class ("prefill"/"decode").
+        Rough percentile over a bounded sample window — an operator signal
+        for "is the compute queue backed up", not a benchmark."""
+        out = self._percentiles(self._waits)
+        for cls in ("prefill", "decode"):
+            out[cls] = self._percentiles(self._class_waits.get(cls))
+        return out
 
     async def submit(
         self,
@@ -126,6 +166,7 @@ class ComputeQueue:
         *args,
         deadline: float | None = None,  # time.monotonic() cutoff: the task
         # is abandoned (DeadlineExpired) if the worker reaches it later
+        task_class: str | None = None,  # wait-stat bucket, not passed to fn
         **kwargs,
     ) -> Any:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -136,6 +177,7 @@ class ComputeQueue:
             fut=fut,
             deadline=deadline,
             enqueued_at=time.monotonic(),
+            task_class=task_class,
         )
         self._queue.put_nowait((priority, next(self._seq), task))
         return await fut
@@ -148,6 +190,7 @@ class ComputeQueue:
         run_group: Callable[[list], list],
         *,
         deadline: float | None = None,
+        task_class: str | None = None,
     ) -> Any:
         """Submit one member of a batchable group. All queued members whose
         `key` equals this one's (arriving before the worker dispatches, or
@@ -163,6 +206,7 @@ class ComputeQueue:
             fut=fut,
             deadline=deadline,
             enqueued_at=time.monotonic(),
+            task_class=task_class,
         )
         self._queue.put_nowait((priority, next(self._seq), task))
         return await fut
@@ -277,7 +321,15 @@ class ComputeQueue:
         return taken
 
     def _note_wait(self, task) -> None:
-        self._waits.append(time.monotonic() - task.enqueued_at)
+        wait = time.monotonic() - task.enqueued_at
+        self._waits.append(wait)
+        if task.task_class is not None:
+            dq = self._class_waits.get(task.task_class)
+            if dq is None:
+                dq = self._class_waits[task.task_class] = collections.deque(
+                    maxlen=_WAIT_SAMPLES
+                )
+            dq.append(wait)
 
     def _expired(self, task) -> bool:
         # checked at execution time, not submit time: a deep queue behind

@@ -54,7 +54,15 @@ def _parse(flag: Flag, raw: str):
 
 def get(name: str):
     """Read a declared flag from the environment (or its default)."""
-    flag = _REGISTRY[name]
+    flag = _REGISTRY.get(name)
+    if flag is None:
+        # Some switches are read in more modules than the one declaring
+        # them (e.g. a pure-client process reading BBTPU_PREFIX_CACHE,
+        # declared next to the server-side pool it also controls). Pull
+        # in the declaring modules once; only a genuinely unknown name —
+        # a typo — still fails loudly after that.
+        import_declaring_modules()
+        flag = _REGISTRY[name]
     raw = os.environ.get(flag.name)
     if raw is None:
         return flag.default

@@ -11,9 +11,11 @@ import pytest
 
 from bloombee_tpu.server.compute_queue import (
     PRIORITY_INFERENCE,
+    PRIORITY_PREFILL_CHUNK,
     PRIORITY_TRAINING,
     ComputeQueue,
     DeadlineExpired,
+    aged_chunk_priority,
 )
 
 
@@ -351,7 +353,11 @@ def test_wait_stats_report_queue_time():
     async def run():
         q = ComputeQueue()
         q.start()
-        assert q.wait_stats_ms() == {"p50": 0.0, "p95": 0.0}
+        assert q.wait_stats_ms() == {
+            "p50": 0.0, "p95": 0.0,
+            "prefill": {"p50": 0.0, "p95": 0.0},
+            "decode": {"p50": 0.0, "p95": 0.0},
+        }
         gate, jam = _jam(q)
         await asyncio.sleep(0.05)
         waiter = asyncio.create_task(
@@ -364,6 +370,162 @@ def test_wait_stats_report_queue_time():
         # the second task waited >= ~150 ms behind the jam
         assert stats["p95"] >= 100.0
         assert stats["p50"] >= 0.0
+        await q.stop()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------- stall-free chunk scheduling
+def test_per_class_wait_stats_split():
+    """task_class buckets wait samples into per-class p50/p95 next to the
+    blended numbers — the decode-class wait is the stall-free signal."""
+
+    async def run():
+        q = ComputeQueue()
+        q.start()
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        pre = asyncio.create_task(
+            q.submit(PRIORITY_TRAINING, lambda: None, task_class="prefill")
+        )
+        dec = asyncio.create_task(
+            q.submit(PRIORITY_INFERENCE, lambda: None, task_class="decode")
+        )
+        await asyncio.sleep(0.15)
+        gate.set()
+        await asyncio.gather(jam, pre, dec)
+        stats = q.wait_stats_ms()
+        assert stats["prefill"]["p95"] >= 100.0
+        assert stats["decode"]["p95"] >= 100.0
+        assert stats["p95"] >= 100.0
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_fresh_chunk_yields_to_later_decode():
+    """A queued prefill chunk at PRIORITY_PREFILL_CHUNK loses to a decode
+    step submitted AFTER it — decodes preempt the next chunk."""
+
+    async def run():
+        q = ComputeQueue()
+        q.start()
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        order = []
+        t0 = time.monotonic()
+        assert aged_chunk_priority(t0, now=t0) == PRIORITY_PREFILL_CHUNK
+        chunk = asyncio.create_task(
+            q.submit(aged_chunk_priority(t0), order.append, "chunk",
+                     task_class="prefill")
+        )
+        await asyncio.sleep(0.02)
+        dec = asyncio.create_task(
+            q.submit(PRIORITY_INFERENCE, order.append, "decode",
+                     task_class="decode")
+        )
+        await asyncio.sleep(0.05)
+        gate.set()
+        await asyncio.gather(jam, chunk, dec)
+        assert order == ["decode", "chunk"]
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_aged_chunk_competes_at_decode_priority(monkeypatch):
+    """Past the BBTPU_CHUNK_AGE_S horizon a chunk stream's priority decays
+    to decode priority, so FIFO order protects it from starvation: an old
+    stream's chunk submitted BEFORE a decode now runs first."""
+    monkeypatch.setenv("BBTPU_CHUNK_AGE_S", "0.01")
+
+    async def run():
+        q = ComputeQueue()
+        q.start()
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        order = []
+        started_long_ago = time.monotonic() - 1.0
+        assert aged_chunk_priority(started_long_ago) == PRIORITY_INFERENCE
+        chunk = asyncio.create_task(
+            q.submit(aged_chunk_priority(started_long_ago),
+                     order.append, "chunk", task_class="prefill")
+        )
+        await asyncio.sleep(0.02)
+        dec = asyncio.create_task(
+            q.submit(PRIORITY_INFERENCE, order.append, "decode",
+                     task_class="decode")
+        )
+        await asyncio.sleep(0.05)
+        gate.set()
+        await asyncio.gather(jam, chunk, dec)
+        assert order == ["chunk", "decode"]
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_chunk_priority_decay_is_monotonic():
+    t0 = 1000.0
+    prios = [
+        aged_chunk_priority(t0, now=t0 + dt)
+        for dt in (0.0, 0.5, 1.0, 1.9, 2.0, 50.0)
+    ]
+    assert prios[0] == PRIORITY_PREFILL_CHUNK
+    assert all(a >= b for a, b in zip(prios, prios[1:]))
+    assert prios[-2] == prios[-1] == PRIORITY_INFERENCE
+    # chunks always outrank training work, even fresh
+    assert all(PRIORITY_INFERENCE <= p < PRIORITY_TRAINING for p in prios)
+
+
+def test_chunk_stream_interleaves_queued_decodes():
+    """Fake resumable chunk driver (the server's _run_chunked_prefill
+    shape, no model needed): each chunk is its own submission, so a decode
+    queued while chunk N occupies the worker runs BEFORE chunk N+1 —
+    decodes land between chunks instead of waiting out the whole prompt."""
+
+    async def run():
+        q = ComputeQueue()
+        q.start()
+        order = []
+        t0 = time.monotonic()
+
+        def work(tag):
+            time.sleep(0.02)  # occupy the worker like a device dispatch
+            order.append(tag)
+
+        async def chunk_stream():
+            # re-enters the queue between chunks at the aging priority,
+            # exactly like the server's chunked-prefill state machine
+            for i in range(4):
+                await q.submit(
+                    aged_chunk_priority(t0), work, f"C{i}",
+                    task_class="prefill",
+                )
+
+        done = asyncio.Event()
+
+        async def decode_loop():
+            i = 0
+            while not done.is_set():
+                await q.submit(
+                    PRIORITY_INFERENCE, work, f"D{i}", task_class="decode"
+                )
+                i += 1
+
+        dec = asyncio.create_task(decode_loop())
+        await asyncio.sleep(0.01)
+        await chunk_stream()
+        done.set()
+        await dec
+        chunks = [i for i, t in enumerate(order) if t.startswith("C")]
+        assert len(chunks) == 4
+        # at least one decode ran strictly between two chunks of the
+        # stream (with a monolithic prefill there is nothing "between")
+        assert any(b - a > 1 for a, b in zip(chunks, chunks[1:])), order
+        stats = q.wait_stats_ms()
+        # per-class stats saw both sides of the interleave
+        assert stats["decode"] != {"p50": 0.0, "p95": 0.0} or order
         await q.stop()
 
     asyncio.run(run())
