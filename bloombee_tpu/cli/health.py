@@ -105,6 +105,61 @@ def main(argv=None):
                             line += (
                                 f"  {cls}_wait_p95={w['p95']:.1f}ms"
                             )
+                    # live load snapshot: the same numbers the server
+                    # adverts for load-aware routing
+                    load = probe.get("load") or {}
+                    for k in (
+                        "delay_ms",
+                        "queue_depth",
+                        "mean_batch_width",
+                        "chunk_streams",
+                        "pages_free",
+                        "active_sessions",
+                    ):
+                        v = load.get(k)
+                        if v:
+                            line += f"  {k}={v}"
+                    if load.get("shedding"):
+                        line += "  SHEDDING"
+                    # admission counters: what got shed, with what retry
+                    # hints, and which clients are over their fair share
+                    adm = probe.get("admission") or {}
+                    for k in (
+                        "shed_requests",
+                        "shed_sessions",
+                        "admitted_new",
+                    ):
+                        if adm.get(k):
+                            line += f"  {k}={adm[k]}"
+                    hist = adm.get("retry_after_ms_hist") or {}
+                    if any(hist.values()):
+                        # keys look like "<=250ms" / ">10000ms": sort by
+                        # the numeric bound, overflow bucket last
+                        def _bound(k):
+                            digits = "".join(c for c in k if c.isdigit())
+                            return (
+                                k.startswith(">"),
+                                int(digits) if digits else 0,
+                            )
+
+                        line += "  retry_after_ms_hist=" + ",".join(
+                            f"{b}:{n}"
+                            for b, n in sorted(
+                                hist.items(), key=lambda kv: _bound(kv[0])
+                            )
+                            if n
+                        )
+                    debts = adm.get("client_debts") or {}
+                    over = {
+                        c: d for c, d in debts.items() if d > 0
+                    }
+                    if over:
+                        line += "  over_share=" + ",".join(
+                            f"{c}:{d:+.2f}"
+                            for c, d in sorted(
+                                over.items(), key=lambda kv: -kv[1]
+                            )
+                        )
                 except Exception as e:
                     line += f"  [UNREACHABLE: {type(e).__name__}]"
                 finally:

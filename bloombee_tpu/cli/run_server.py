@@ -124,6 +124,23 @@ def main(argv=None):
                         "BBTPU_SP_MIN_TOKENS spread over this many local "
                         "chips via ring attention; decode stays "
                         "single-chip paged")
+    parser.add_argument("--admit", action="store_true", default=None,
+                        help="admission control: past the queue-delay high "
+                             "watermark, shed NEW sessions/prefills with a "
+                             "retriable `overloaded` error (established "
+                             "sessions' decode steps are always admitted; "
+                             "heavy clients shed first via per-client "
+                             "fair-share accounting). Default follows "
+                             "BBTPU_ADMIT")
+    parser.add_argument("--admit-high-ms", type=float, default=None,
+                        help="queue-delay high watermark in ms before the "
+                             "admission controller starts shedding (default "
+                             "follows BBTPU_ADMIT_HIGH_MS)")
+    parser.add_argument("--load-advert-s", type=float, default=None,
+                        help="republish the live load snapshot at this "
+                             "cadence (seconds) when faster than "
+                             "--announce-period; 0 keeps the announce "
+                             "cadence (default follows BBTPU_LOAD_ADVERT_S)")
     parser.add_argument("--warmup-batches", default="1",
                         help="comma-separated batch buckets to pre-compile "
                         "at startup ('' = skip)")
@@ -199,6 +216,9 @@ def main(argv=None):
                 else args.rebalance_period
             ),
             drain_timeout=args.drain_timeout,
+            admit=args.admit,
+            admit_high_ms=args.admit_high_ms,
+            load_advert_s=args.load_advert_s,
         )
         await server.start()
         if args.warmup_batches:

@@ -61,3 +61,19 @@ class ClientConfig:
     # BBTPU_REPL_EVERY env switch. Swarms with no capable standby (old
     # servers, mismatched page_size/span) silently fall back to full replay
     kv_repl_every: int | None = None
+    # load-aware routing: add each server's predicted queue delay (from its
+    # live load advert) to the Dijkstra edge cost, steering new sessions
+    # away from hot servers before they start shedding
+    load_aware_routing: bool = True
+    # overload penalty class (shorter than fault bans — a shedding server
+    # is healthy, just hot): first shed backs the peer off overload_timeout
+    # seconds, doubling per strike up to overload_max
+    overload_timeout: float = 2.0
+    overload_max: float = 15.0
+    # how many retriable `overloaded` sheds one step tolerates before
+    # surfacing the error (separate from max_retries — a shed is the swarm
+    # working as designed, not a fault)
+    overload_retries: int = 10
+    # fair-share identity reported to servers' admission controllers; None
+    # uses one id per client process so extra sessions can't dodge fairness
+    client_id: str | None = None

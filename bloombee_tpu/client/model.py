@@ -105,6 +105,9 @@ class DistributedModelForCausalLM:
             )
             manager.blocked_servers = set(config.blocked_servers or ())
             manager.active_adapter = config.active_adapter
+            manager.load_aware = config.load_aware_routing
+            manager.overload_timeout = config.overload_timeout
+            manager.overload_max = config.overload_max
         self.config = config or ClientConfig(use_push=use_push)
         self.use_push = self.config.use_push
 
@@ -140,6 +143,9 @@ class DistributedModelForCausalLM:
             allowed_servers=config.allowed_servers,
             blocked_servers=config.blocked_servers,
             active_adapter=config.active_adapter,
+            load_aware=config.load_aware_routing,
+            overload_timeout=config.overload_timeout,
+            overload_max=config.overload_max,
         )
         return cls(spec, params, manager, config=config)
 
@@ -191,6 +197,8 @@ class DistributedModelForCausalLM:
             adapter=cfg.active_adapter,
             prefix_cache=cfg.prefix_cache,
             repl_every=cfg.kv_repl_every,
+            client_id=cfg.client_id,
+            overload_retries=cfg.overload_retries,
         )
 
     # --------------------------------------------------------------- generate

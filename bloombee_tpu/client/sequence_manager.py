@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import logging
+import math
 import random
 import time
 
@@ -24,6 +25,73 @@ logger = logging.getLogger(__name__)
 
 DEFAULT_HOP_COST_S = DEFAULT_RTT_S  # until a peer has been measured
 CACHE_MISSING_PENALTY_S = 10.0  # reference: +10s if cache won't fit
+
+# load-aware routing: how the live ServerInfo.load advert is turned into a
+# predicted-queue-delay edge-cost term. The term is defensive by
+# construction — adverts are untrusted wire input.
+LOAD_STALE_S = 30.0  # advert age at which the load term decays to zero
+LOAD_DELAY_CAP_S = 10.0  # hard cap on the load term: a garbage/hostile
+# advert can inflate only its OWN server's cost, and only this far
+LOAD_SHED_PENALTY_S = 1.0  # an actively-shedding server would refuse new
+# work anyway; make it about as unattractive as a missing-cache server
+_QUEUE_DEPTH_COST_S = 0.05  # per queued task, a rough serialized-step cost
+
+
+def _finite_pos(x) -> float:
+    """Clamp an untrusted advert number to a finite value >= 0 (NaN, inf,
+    negatives, non-numbers all collapse to 0 = 'no load evidence')."""
+    try:
+        v = float(x)
+    except (TypeError, ValueError):
+        return 0.0
+    if not math.isfinite(v) or v < 0.0:
+        return 0.0
+    return v
+
+
+def predicted_queue_delay_s(server_info, now: float | None = None) -> float:
+    """Predicted extra queueing delay (seconds) at this server, derived
+    from its live load advert. Properties the router depends on (enforced
+    here, property-tested in tests/test_overload_routing.py):
+
+    - always finite, >= 0, <= LOAD_DELAY_CAP_S: added to a positive edge
+      cost, Dijkstra stays valid no matter what the advert claims;
+    - monotone non-decreasing in reported load (delay/p95/queue depth), so
+      a server cannot make itself MORE attractive by advertising load —
+      the no-advert baseline (0) is the floor, meaning a malicious advert
+      can only repel traffic from its own server, never capture it;
+    - staleness-discounted: the term decays linearly to zero by
+      LOAD_STALE_S of advert age (load["ts"], writer wall clock, falling
+      back to the registry record's writer-stamped stored_at), so a dead
+      server's last hot advert doesn't repel traffic forever and a stale
+      cool advert doesn't attract a stampede.
+    """
+    load = getattr(server_info, "load", None)
+    if not isinstance(load, dict):
+        return 0.0
+    if now is None:
+        now = time.time()
+    ts = load.get("ts")
+    if not isinstance(ts, (int, float)) or not math.isfinite(float(ts)):
+        ts = getattr(server_info, "advert_stored_at", None)
+    if isinstance(ts, (int, float)) and math.isfinite(float(ts)):
+        age = min(max(now - float(ts), 0.0), LOAD_STALE_S)
+    else:
+        age = 0.0  # unstamped advert: treat as fresh (only repels traffic
+        # from the advertiser itself, so assuming fresh is the safe side)
+    weight = 1.0 - age / LOAD_STALE_S
+    if weight <= 0.0:
+        return 0.0
+    delay = _finite_pos(load.get("delay_ms")) / 1000.0
+    wait = load.get("decode_wait_ms") or load.get("wait_ms")
+    if isinstance(wait, dict):
+        delay = max(delay, _finite_pos(wait.get("p95")) / 1000.0)
+    delay += _QUEUE_DEPTH_COST_S * min(
+        _finite_pos(load.get("queue_depth")), 100.0
+    )
+    if load.get("shedding"):
+        delay += LOAD_SHED_PENALTY_S
+    return weight * min(delay, LOAD_DELAY_CAP_S)
 
 
 class MissingBlocksError(RuntimeError):
@@ -63,6 +131,12 @@ class RemoteSequenceManager:
         allowed_servers: list[str] | None = None,
         blocked_servers: list[str] | None = None,
         active_adapter: str | None = None,
+        load_aware: bool = True,  # add the predicted-queue-delay term
+        # from live load adverts to Dijkstra edge costs
+        overload_timeout: float = 2.0,  # base avoid-backoff after an
+        # overloaded shed — a distinct, much shorter penalty class than
+        # fault bans (the server is healthy, just busy right now)
+        overload_max: float = 15.0,  # overload-avoid cap (faults: ban_max)
     ):
         self.registry = registry
         self.model_uid = model_uid
@@ -71,6 +145,10 @@ class RemoteSequenceManager:
         self.ban_timeout = ban_timeout  # base (first-strike) backoff
         self.ban_max = ban_max
         self.probe_timeout = 30.0  # half-open trial lease
+        self.load_aware = load_aware
+        self.overload_timeout = overload_timeout
+        self.overload_max = overload_max
+        self.overload_probe_timeout = 10.0  # half-open lease, hot peers
         self.allowed_servers = (
             set(allowed_servers) if allowed_servers else None
         )
@@ -78,6 +156,10 @@ class RemoteSequenceManager:
         self.active_adapter = active_adapter
         self.spans: dict[str, RemoteSpanInfo] = {}
         self._bans: dict[str, _BanState] = {}
+        # overload penalty class: same half-open state machine as fault
+        # bans, but a separate map with shorter base/cap so "busy" never
+        # escalates into the minutes-long exile reserved for failures
+        self._hot: dict[str, _BanState] = {}
         self._last_update = 0.0
         self._rng = rng or random.Random()
         # measured client->server RTTs (reference ping.py PingAggregator);
@@ -132,18 +214,57 @@ class RemoteSequenceManager:
             state.strikes,
         )
 
+    def note_peer_overloaded(
+        self, peer_id: str, retry_after_s: float | None = None
+    ) -> None:
+        """Overload strike: the peer shed our work with a retriable
+        `overloaded` — it is healthy, just busy, so it gets the SHORT
+        penalty class (overload_timeout base / overload_max cap), never a
+        fault ban. The server's retry_after hint floors the backoff; the
+        measured RTT is kept (the peer is alive and its latency is
+        current)."""
+        state = self._hot.setdefault(peer_id, _BanState())
+        state.probing = False
+        state.strikes += 1
+        backoff = min(
+            self.overload_timeout * (2.0 ** (state.strikes - 1)),
+            self.overload_max,
+        )
+        if retry_after_s is not None and retry_after_s > 0:
+            backoff = max(backoff, min(retry_after_s, self.overload_max))
+        backoff *= 0.75 + 0.5 * self._rng.random()
+        state.banned_until = time.monotonic() + backoff
+        logger.info(
+            "avoiding overloaded peer %s for %.1fs (strike %d)", peer_id,
+            backoff, state.strikes,
+        )
+
     def note_peer_ok(self, peer_id: str) -> None:
         """A request through this peer succeeded: the half-open trial (or
-        any lingering strike history) is cleared so the next failure starts
-        from the base backoff again."""
+        any lingering strike/overload history) is cleared so the next
+        failure starts from the base backoff again."""
         if self._bans.pop(peer_id, None) is not None:
             logger.info("peer %s recovered; ban history reset", peer_id)
+        self._hot.pop(peer_id, None)
 
     def _ban_excludes(self, peer_id: str, now: float) -> bool:
-        """True when bans keep this peer out of routing right now. An
-        expired ban admits exactly ONE route as the half-open probe; other
-        routes keep avoiding the peer until the probe resolves."""
-        state = self._bans.get(peer_id)
+        """True when bans OR overload-avoidance keep this peer out of
+        routing right now. An expired entry admits exactly ONE route as the
+        half-open probe; other routes keep avoiding the peer until the
+        probe resolves."""
+        return self._state_excludes(
+            self._bans, peer_id, now, self.probe_timeout, "banned"
+        ) or self._state_excludes(
+            self._hot, peer_id, now, self.overload_probe_timeout,
+            "overloaded",
+        )
+
+    @staticmethod
+    def _state_excludes(
+        states: dict[str, _BanState], peer_id: str, now: float,
+        probe_timeout: float, kind: str,
+    ) -> bool:
+        state = states.get(peer_id)
         if state is None:
             return False
         if now < state.banned_until:
@@ -151,32 +272,57 @@ class RemoteSequenceManager:
         if state.probing and now < state.probe_until:
             return True  # a trial is already in flight elsewhere
         state.probing = True  # this route becomes (or renews) the trial
-        state.probe_until = now + self.probe_timeout
-        logger.info("half-open probe: trying banned peer %s", peer_id)
+        state.probe_until = now + probe_timeout
+        logger.info("half-open probe: trying %s peer %s", kind, peer_id)
         return False
+
+    def _overload_active(self, peer_id: str, now: float | None = None) -> bool:
+        """True while the peer is inside its overload-avoid backoff (no
+        probe side effects — a read-only check for standby selection)."""
+        state = self._hot.get(peer_id)
+        if state is None:
+            return False
+        if now is None:
+            now = time.monotonic()
+        return now < state.banned_until or (
+            state.probing and now < state.probe_until
+        )
 
     def _prune_bans(self) -> None:
         """Drop entries that can no longer matter: peers that left the
         swarm view, and long-expired bans whose peer was never re-routed
-        (without this the map grows monotonically with churn)."""
+        (without this the maps grow monotonically with churn)."""
         now = time.monotonic()
-        for pid in list(self._bans):
-            state = self._bans[pid]
-            gone = self.spans and pid not in self.spans
-            long_expired = (
-                not state.probing
-                and now > state.banned_until + 4 * self.ban_max
-            )
-            if gone or long_expired:
-                del self._bans[pid]
+        for states, cap in ((self._bans, self.ban_max),
+                            (self._hot, self.overload_max)):
+            for pid in list(states):
+                state = states[pid]
+                gone = self.spans and pid not in self.spans
+                long_expired = (
+                    not state.probing
+                    and now > state.banned_until + 4 * cap
+                )
+                if gone or long_expired:
+                    del states[pid]
 
-    def _active_spans(self) -> list[RemoteSpanInfo]:
+    def _active_spans(
+        self, overload_excludes: bool = True
+    ) -> list[RemoteSpanInfo]:
+        # overload_excludes=False keeps hot (but not fault-banned) peers in
+        # the pool: pick_standby prefers cool standbys itself but must be
+        # able to degrade to a hot one when nothing else qualifies.
         now = time.monotonic()
         return [
             s
             for s in self.spans.values()
             if s.server_info.state != ServerState.DRAINING
-            and not self._ban_excludes(s.peer_id, now)
+            and not (
+                self._ban_excludes(s.peer_id, now)
+                if overload_excludes
+                else self._state_excludes(
+                    self._bans, s.peer_id, now, self.probe_timeout, "banned"
+                )
+            )
             and s.peer_id not in self.blocked_servers
             and (
                 self.allowed_servers is None
@@ -219,7 +365,7 @@ class RemoteSequenceManager:
         degrades to plain full-replay recovery)."""
         info = span.server_info
         cands = [
-            s for s in self._active_spans()
+            s for s in self._active_spans(overload_excludes=False)
             if s.peer_id != span.peer_id
             and s.peer_id not in (exclude or ())
             and s.server_info.kv_repl
@@ -227,6 +373,13 @@ class RemoteSequenceManager:
             and s.server_info.end_block == info.end_block
             and s.server_info.page_size == info.page_size
         ]
+        # avoid HOT standbys: replicating to (or failing over onto) a
+        # server already past its watermark just moves the overload.
+        # Recently-shed peers are filtered outright (unless nothing else
+        # qualifies); among the rest, advertised load discounts throughput.
+        cool = [s for s in cands if not self._overload_active(s.peer_id)]
+        if cool:
+            cands = cool
         if not cands:
             return None
         return max(
@@ -234,7 +387,7 @@ class RemoteSequenceManager:
             key=lambda s: (
                 s.server_info.inference_rps
                 or s.server_info.throughput or 0.0
-            ),
+            ) / (1.0 + predicted_queue_delay_s(s.server_info)),
         )
 
     def _compute_cost(
@@ -249,6 +402,12 @@ class RemoteSequenceManager:
             and left < cache_tokens_needed
         ):
             cost += CACHE_MISSING_PENALTY_S
+        if self.load_aware:
+            # live-advert term: predicted queue delay ADDS to the cost
+            # (bounded, sanitized, staleness-discounted — see
+            # predicted_queue_delay_s), so Dijkstra's positivity invariant
+            # holds for arbitrary advert garbage
+            cost += predicted_queue_delay_s(span.server_info)
         return cost
 
     def _hop_cost(

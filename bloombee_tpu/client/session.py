@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 import uuid
 
@@ -21,7 +22,13 @@ import numpy as np
 from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
 from bloombee_tpu.swarm.data import RemoteSpanInfo
 from bloombee_tpu.utils import env
-from bloombee_tpu.wire.rpc import Connection, RpcError, Stream, connect
+from bloombee_tpu.wire.rpc import (
+    Connection,
+    OverloadedError,
+    RpcError,
+    Stream,
+    connect,
+)
 from bloombee_tpu.wire.tensor_codec import dtype_for_name
 
 logger = logging.getLogger(__name__)
@@ -45,6 +52,11 @@ env.declare(
 # otherwise repeat the identical warning once per session)
 _warned_no_embed_process = False
 
+# default admission-control identity: one id per client process, so all of
+# a process's sessions share one fair-share bucket server-side (a client
+# can't dodge fairness accounting by opening more sessions)
+_PROCESS_CLIENT_ID = f"cli-{uuid.uuid4().hex[:8]}"
+
 
 class DecodeNUnsupported(RuntimeError):
     """The server cannot run server-side multi-step decode for this session
@@ -59,6 +71,14 @@ def _raise_if_session_lost(resp_meta: dict) -> None:
     the peer (the ban paths only trigger on transport failures)."""
     if resp_meta.get("session_lost"):
         raise RpcError(resp_meta.get("reason", "session KV lost"))
+
+
+def _sanitize_retry_ms(retry_ms) -> int | None:
+    try:
+        v = int(retry_ms)
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else None
 
 
 class _SpanSession:
@@ -100,6 +120,12 @@ class InferenceSession:
         # (None -> BBTPU_PREFIX_CACHE env)
         repl_every: int | None = None,  # standby-KV replication interval
         # in sealed pages (None -> BBTPU_REPL_EVERY env; 0 disables)
+        client_id: str | None = None,  # admission-control identity sent in
+        # every session open (None -> one shared id per client process)
+        overload_retries: int = 10,  # how many `overloaded` sheds a step
+        # rides out (backoff + reroute) before failing hard — a separate,
+        # more generous budget than max_retries because a shed is the
+        # server WORKING AS DESIGNED under load, not a fault
     ):
         self.manager = manager
         self.adapter = adapter
@@ -108,6 +134,8 @@ class InferenceSession:
         self.use_push = use_push
         self.max_retries = max_retries
         self.step_timeout = step_timeout
+        self.client_id = client_id or _PROCESS_CLIENT_ID
+        self.overload_retries = max(0, int(overload_retries))
         self.embed_fn = embed_fn
         self.prefix_cache = (
             env.get("BBTPU_PREFIX_CACHE") if prefix_cache is None
@@ -192,10 +220,40 @@ class InferenceSession:
                 "max_length": self.max_length,
                 "start": span.start,
                 "end": span.end,
+                # fair-share identity for admission control (old servers
+                # ignore unknown meta keys)
+                "client_id": self.client_id,
                 **({"adapter": self.adapter} if self.adapter else {}),
             },
         )
         return _SpanSession(span, conn, stream, session_id)
+
+    def _raise_if_shed(self, resp_meta: dict, peer_id: str) -> None:
+        """Typed `overloaded` reply (in-stream shed of this session's new
+        work): penalize the peer with the SHORT overload class — never a
+        fault ban, the server is healthy — and raise the retriable error so
+        step()'s overload handler backs off and reroutes."""
+        if not resp_meta.get("overloaded"):
+            return
+        retry_ms = _sanitize_retry_ms(resp_meta.get("retry_after_ms"))
+        self.manager.note_peer_overloaded(
+            peer_id,
+            retry_after_s=retry_ms / 1000.0 if retry_ms else None,
+        )
+        raise OverloadedError(
+            resp_meta.get("reason", "server overloaded"),
+            retry_after_ms=retry_ms,
+        )
+
+    def _note_shed_exc(self, e: OverloadedError, peer_id: str) -> None:
+        """Wire-level overloaded err frame (session-open shed) seen on a
+        span's stream: short overload penalty instead of a fault ban."""
+        self.manager.note_peer_overloaded(
+            peer_id,
+            retry_after_s=(
+                e.retry_after_ms / 1000.0 if e.retry_after_ms else None
+            ),
+        )
 
     # ----------------------------------------------------------- prefix cache
     async def _probe_prefix(
@@ -242,6 +300,9 @@ class InferenceSession:
                 item = await asyncio.wait_for(
                     s.stream.recv(), self.step_timeout
                 )
+            except OverloadedError as e:
+                self._note_shed_exc(e, s.span.peer_id)
+                raise
             except (RpcError, OSError, asyncio.TimeoutError):
                 self.manager.ban_peer(s.span.peer_id)
                 raise
@@ -250,6 +311,7 @@ class InferenceSession:
                 raise RpcError(f"span {i} closed during prefix probe")
             resp_meta, _ = item
             _raise_if_session_lost(resp_meta)
+            self._raise_if_shed(resp_meta, s.span.peer_id)
             span_min = min(
                 int(x) for x in resp_meta.get("prefix_matched") or [0]
             )
@@ -385,6 +447,7 @@ class InferenceSession:
         """Push hidden through the whole chain; returns last span's output
         (or (output, keep) for pruned tree steps)."""
         attempt = 0
+        overload_waits = 0
         while True:
             try:
                 if self._needs_rebuild:
@@ -427,6 +490,29 @@ class InferenceSession:
                     self.position += hidden.shape[1]
                     await self._maybe_replicate()
                 return out
+            except OverloadedError as e:
+                # retriable shed: the peer told us to go elsewhere, not that
+                # it is broken. Separate (more generous) budget than fault
+                # retries, honor the server's retry_after hint, then reroute
+                # — the overload penalty in the manager steers the rebuilt
+                # chain away from the hot peer.
+                overload_waits += 1
+                if overload_waits > self.overload_retries:
+                    raise
+                wait_s = min((e.retry_after_ms or 500) / 1000.0, 5.0)
+                wait_s *= random.uniform(0.75, 1.25)
+                logger.info(
+                    "step shed by overloaded server (%s); rerouting in "
+                    "%.2fs (shed %d/%d)",
+                    e, wait_s, overload_waits, self.overload_retries,
+                )
+                await asyncio.sleep(wait_s)
+                try:
+                    await self._recover()
+                    accept = None
+                    accept_per_span = None
+                except (RpcError, OSError, asyncio.TimeoutError) as e2:
+                    logger.warning("recovery after shed failed: %s", e2)
             except (RpcError, OSError, asyncio.TimeoutError) as e:
                 attempt += 1
                 if attempt > self.max_retries:
@@ -498,6 +584,9 @@ class InferenceSession:
                 item = await asyncio.wait_for(
                     span_sess.stream.recv(), self.step_timeout
                 )
+            except OverloadedError as e:
+                self._note_shed_exc(e, span_sess.span.peer_id)
+                raise
             except (RpcError, OSError, asyncio.TimeoutError):
                 self.manager.ban_peer(span_sess.span.peer_id)
                 raise
@@ -506,6 +595,7 @@ class InferenceSession:
                 raise RpcError(f"span {i} closed mid-session")
             resp_meta, resp_tensors = item
             _raise_if_session_lost(resp_meta)
+            self._raise_if_shed(resp_meta, span_sess.span.peer_id)
             compute_ms.append(resp_meta.get("t_compute_ms"))
             chunk = resp_tensors[0]
             if i == 0 and resp_meta.get("keep") is not None:
@@ -627,6 +717,9 @@ class InferenceSession:
                     item = await asyncio.wait_for(
                         span_sess.stream.recv(), self.step_timeout
                     )
+                except OverloadedError as e:
+                    self._note_shed_exc(e, span_sess.span.peer_id)
+                    raise
                 except (RpcError, OSError, asyncio.TimeoutError):
                     self.manager.ban_peer(span_sess.span.peer_id)
                     raise
@@ -635,6 +728,7 @@ class InferenceSession:
                     raise RpcError(f"span {i} closed mid-session")
                 resp_meta, resp_tensors = item
                 _raise_if_session_lost(resp_meta)
+                self._raise_if_shed(resp_meta, span_sess.span.peer_id)
                 if resp_meta.get("t_compute_ms") is not None:
                     span_ms += resp_meta["t_compute_ms"]
                 if resp_meta.get("ack"):
@@ -756,6 +850,7 @@ class InferenceSession:
         self._check_decode_n_route()
         ids = np.asarray(ids).reshape(-1).astype(np.int32)
         attempt = 0
+        overload_waits = 0
         while True:
             try:
                 if self._needs_rebuild:
@@ -765,6 +860,27 @@ class InferenceSession:
                 toks = await self._decode_n_once(
                     ids, n, eos_token_id, finished, head_dtype
                 )
+            except OverloadedError as e:
+                # retriable shed (see step()): separate budget, honor the
+                # retry hint, reroute via the overload-penalized manager
+                overload_waits += 1
+                if overload_waits > self.overload_retries:
+                    raise
+                wait_s = min((e.retry_after_ms or 500) / 1000.0, 5.0)
+                wait_s *= random.uniform(0.75, 1.25)
+                logger.info(
+                    "decode_n shed by overloaded server (%s); rerouting in "
+                    "%.2fs (shed %d/%d)",
+                    e, wait_s, overload_waits, self.overload_retries,
+                )
+                await asyncio.sleep(wait_s)
+                try:
+                    await self._recover()
+                    self._needs_rebuild = False
+                    self._check_decode_n_route()
+                except (RpcError, OSError, asyncio.TimeoutError) as e2:
+                    logger.warning("recovery after shed failed: %s", e2)
+                continue
             except (RpcError, OSError, asyncio.TimeoutError) as e:
                 attempt += 1
                 if attempt > self.max_retries:
@@ -855,6 +971,9 @@ class InferenceSession:
             item = await asyncio.wait_for(
                 span_sess.stream.recv(), 2 * self.step_timeout + float(n)
             )
+        except OverloadedError as e:
+            self._note_shed_exc(e, span_sess.span.peer_id)
+            raise
         except (RpcError, OSError, asyncio.TimeoutError):
             self.manager.ban_peer(span_sess.span.peer_id)
             raise
@@ -863,6 +982,7 @@ class InferenceSession:
             raise RpcError("span closed mid-session")
         resp_meta, resp_tensors = item
         _raise_if_session_lost(resp_meta)
+        self._raise_if_shed(resp_meta, span_sess.span.peer_id)
         if resp_meta.get("decode_n_unsupported"):
             if resp_meta.get("dirty"):
                 # a chained decode failed mid-way: spans hold ragged extra
@@ -998,6 +1118,13 @@ class InferenceSession:
                     "recovery attempt %d/%d failed: %s",
                     attempt + 1, attempts, e,
                 )
+                if isinstance(e, OverloadedError):
+                    # replay prefill shed by the rebuilt chain: honor the
+                    # retry hint so back-to-back rebuilds don't hammer a
+                    # swarm that is uniformly hot
+                    await asyncio.sleep(
+                        min((e.retry_after_ms or 500) / 1000.0, 2.0)
+                    )
         raise last_exc
 
     async def _recover_once(self) -> None:
@@ -1013,6 +1140,11 @@ class InferenceSession:
             for s in route:
                 try:
                     spans.append(await self._open_span(s))
+                except OverloadedError as e:
+                    # session-open shed: short overload penalty, not a
+                    # fault ban — the peer is healthy, just hot
+                    self._note_shed_exc(e, s.peer_id)
+                    raise
                 except (OSError, RpcError, asyncio.TimeoutError):
                     self.manager.ban_peer(s.peer_id)
                     raise

@@ -1,0 +1,198 @@
+"""Admission control: per-client token-rate fair shares + overload shedding.
+
+Without this layer, overload protection degenerates to queue-time deadline
+aborts (PR 1): every request is accepted, rots in the compute queue, and
+dies at its deadline — established decode streams and brand-new prompts
+alike. The controller inverts that: once the measured queue delay crosses a
+high watermark, NEW work (session opens, a fresh session's prefill) is
+refused up front with a retriable ``overloaded(retry_after_ms)`` so the
+client can reroute immediately, while the next decode step of an
+established session is ALWAYS admitted — streams degrade (slower TBT)
+instead of dying.
+
+Fairness comes from per-client token-rate accounting over a sliding
+window (weighted fair shares, cf. the reference's per-client quota hooks
+and Sarathi-Serve's interference analysis): at the high watermark only
+clients consuming more than their equal share are shed; clients at or
+under their share keep being admitted until a harder watermark
+(``hard_factor`` x high). One heavy client therefore backs off long before
+it can starve light ones, and an uncontended client is never shed below
+the hard watermark at all.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import time
+
+from bloombee_tpu.utils import env
+
+env.declare(
+    "BBTPU_ADMIT", bool, False,
+    "enable the BlockServer admission controller: past BBTPU_ADMIT_HIGH_MS "
+    "of measured queue delay, NEW sessions/prefills are shed with a "
+    "retriable overloaded(retry_after_ms) wire error instead of queueing "
+    "into deadline aborts; established sessions' next decode step is "
+    "always admitted",
+)
+env.declare(
+    "BBTPU_ADMIT_HIGH_MS", float, 750.0,
+    "admission high watermark: queue delay (ms) past which new work from "
+    "over-fair-share clients is shed; under-share clients are shed only "
+    "past 4x this value",
+)
+env.declare(
+    "BBTPU_ADMIT_WINDOW_S", float, 5.0,
+    "sliding window (s) for per-client token-rate fair-share accounting "
+    "and for the recent-queue-wait estimate behind admission decisions",
+)
+env.declare(
+    "BBTPU_ADMIT_RETRY_MS", float, 250.0,
+    "base retry_after_ms hint on overloaded sheds; scaled up with overload "
+    "severity and with the shed client's fair-share debt",
+)
+
+# retry_after histogram buckets (upper bounds, ms) — coarse on purpose:
+# this is an operator signal in health --probe, not a benchmark
+_HIST_BUCKETS = (50, 100, 250, 500, 1000, 2500, 5000, 10000)
+_RETRY_CAP_MS = 30_000.0
+
+
+class AdmissionController:
+    """Decides whether NEW work is admitted given the live queue delay.
+
+    The caller (BlockServer) is responsible for only consulting
+    ``admit_new`` for new work — established sessions' decode steps must
+    never be routed through it (that asymmetry IS the failure-model
+    contract, see ARCHITECTURE.md "Failure model").
+    """
+
+    def __init__(
+        self,
+        *,
+        high_ms: float | None = None,
+        window_s: float | None = None,
+        retry_ms: float | None = None,
+        hard_factor: float = 4.0,
+    ) -> None:
+        self.high_ms = float(
+            env.get("BBTPU_ADMIT_HIGH_MS") if high_ms is None else high_ms
+        )
+        self.window_s = max(0.1, float(
+            env.get("BBTPU_ADMIT_WINDOW_S") if window_s is None else window_s
+        ))
+        self.retry_ms = float(
+            env.get("BBTPU_ADMIT_RETRY_MS") if retry_ms is None else retry_ms
+        )
+        self.hard_factor = float(hard_factor)
+        # client id -> deque of (monotonic_ts, tokens) admitted in-window
+        self._tokens: dict[str, collections.deque] = {}
+        # observability (surfaced via _rpc_info -> health --probe)
+        self.shed_requests = 0
+        self.shed_sessions = 0
+        self.admitted_new = 0
+        self.retry_after_hist: dict[str, int] = {}
+        self.shedding = False  # live gauge, re-published in load adverts
+
+    # ------------------------------------------------------------ accounting
+    def note_tokens(self, client: str, tokens: int, now: float | None = None):
+        """Charge `tokens` processed tokens (batch x seq) to `client`."""
+        now = time.monotonic() if now is None else now
+        dq = self._tokens.setdefault(client, collections.deque())
+        dq.append((now, max(0, int(tokens))))
+        self._prune(dq, now)
+
+    def _prune(self, dq: collections.deque, now: float) -> None:
+        while dq and now - dq[0][0] > self.window_s:
+            dq.popleft()
+
+    def token_rate(self, client: str, now: float | None = None) -> float:
+        """Tokens/s charged to `client` over the sliding window."""
+        now = time.monotonic() if now is None else now
+        dq = self._tokens.get(client)
+        if not dq:
+            return 0.0
+        self._prune(dq, now)
+        return sum(n for _, n in dq) / self.window_s
+
+    def fair_share_debt(self, client: str, now: float | None = None) -> float:
+        """How far past its equal-weight share of the window's tokens this
+        client is: (its fraction of all in-window tokens) - 1/n_active.
+        > 0 means over-share (shed first), <= 0 at-or-under share. A client
+        alone in the window is by construction at 0 debt — uncontended
+        traffic can never look greedy."""
+        now = time.monotonic() if now is None else now
+        rates = {}
+        for c in list(self._tokens):
+            r = self.token_rate(c, now)
+            if r > 0.0:
+                rates[c] = r
+        total = sum(rates.values())
+        if total <= 0.0:
+            return 0.0
+        # an unseen client counts as an extra active party: its share is
+        # what it WOULD be entitled to if admitted
+        n = len(rates) if client in rates else len(rates) + 1
+        return rates.get(client, 0.0) / total - 1.0 / n
+
+    def debts(self, now: float | None = None) -> dict[str, float]:
+        now = time.monotonic() if now is None else now
+        return {
+            c: round(self.fair_share_debt(c, now), 3)
+            for c in list(self._tokens)
+        }
+
+    # ------------------------------------------------------------- decisions
+    def admit_new(
+        self, client: str, queue_delay_ms: float, now: float | None = None
+    ) -> int | None:
+        """Admission decision for NEW work from `client` given the current
+        queue delay. Returns None to admit, or a retry_after_ms hint when
+        the work is shed."""
+        now = time.monotonic() if now is None else now
+        delay = float(queue_delay_ms)
+        if not math.isfinite(delay):
+            delay = 0.0
+        if delay < self.high_ms:
+            self.shedding = False
+            self.admitted_new += 1
+            return None
+        self.shedding = True
+        debt = self.fair_share_debt(client, now)
+        if debt <= 0.0 and delay < self.high_ms * self.hard_factor:
+            # at/under fair share: keep admitting until the hard watermark,
+            # so a heavy neighbor cannot push light clients out
+            self.admitted_new += 1
+            return None
+        # retry grows with overload severity and with how far over its
+        # share the client is — heavy clients wait longer (weighted fair)
+        retry = (
+            self.retry_ms
+            * (delay / max(self.high_ms, 1e-9))
+            * (1.0 + 4.0 * max(0.0, debt))
+        )
+        retry_ms = int(min(retry, _RETRY_CAP_MS))
+        self.shed_requests += 1
+        self._note_hist(retry_ms)
+        return retry_ms
+
+    def _note_hist(self, retry_ms: int) -> None:
+        for b in _HIST_BUCKETS:
+            if retry_ms <= b:
+                key = f"<={b}ms"
+                break
+        else:
+            key = f">{_HIST_BUCKETS[-1]}ms"
+        self.retry_after_hist[key] = self.retry_after_hist.get(key, 0) + 1
+
+    def stats(self) -> dict:
+        """Counters for _rpc_info / health --probe."""
+        return {
+            "shed_requests": self.shed_requests,
+            "shed_sessions": self.shed_sessions,
+            "admitted_new": self.admitted_new,
+            "retry_after_ms_hist": dict(self.retry_after_hist),
+            "client_debts": self.debts(),
+            "shedding": self.shedding,
+        }

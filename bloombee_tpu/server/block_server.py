@@ -46,7 +46,13 @@ from bloombee_tpu.server.compute_queue import (
 from bloombee_tpu.swarm.data import ServerInfo, ServerState
 from bloombee_tpu.utils import env
 from bloombee_tpu.wire.flow import FlowLimiter
-from bloombee_tpu.wire.rpc import Connection, RpcServer, Stream, connect
+from bloombee_tpu.wire.rpc import (
+    Connection,
+    OverloadedError,
+    RpcServer,
+    Stream,
+    connect,
+)
 from bloombee_tpu.wire.tensor_codec import name_for_dtype
 
 logger = logging.getLogger(__name__)
@@ -89,6 +95,14 @@ env.declare(
     "sender side of session-KV replication; each sweep holds one export "
     "+ one wire push at a time)",
 )
+env.declare(
+    "BBTPU_LOAD_ADVERT_S", float, 0.0,
+    "load-advert cadence: refresh and announce the ServerInfo.load "
+    "snapshot (queue waits, depth, batch width, pages free) this often; "
+    "the effective announce period becomes min(announce_period, this), so "
+    "load telemetry can be fresher than liveness announces (0 = piggyback "
+    "on every regular announce only)",
+)
 
 
 class _ChainError(RuntimeError):
@@ -118,12 +132,17 @@ class _BatchMember:
 class _Session:
     def __init__(self, session_id: str, handle, batch_size: int,
                  layers: tuple[int, int] | None = None,
-                 adapter: str | None = None):
+                 adapter: str | None = None,
+                 client_id: str | None = None):
         self.id = session_id
         self.handle = handle
         self.batch_size = batch_size
         self.layers = layers  # relative (l0, l1) within this server's span
         self.adapter = adapter  # per-request LoRA adapter name (or base)
+        # admission-control identity: the client's self-declared id (one
+        # per client process) or the session id when an old client sends
+        # none — fair-share accounting then degrades to per-session
+        self.client_id = client_id or session_id
         self.push_inbox: asyncio.Queue = asyncio.Queue()
         # chained decode_n control messages (the tail span's selected ids /
         # errors) land here directly from rpc_push — NOT via push_inbox,
@@ -252,6 +271,18 @@ class BlockServer:
         # sessions' decode steps run between chunks instead of stalling
         # behind the whole prompt (0 = monolithic prefill; None ->
         # BBTPU_PREFILL_CHUNK env)
+        admit: bool | None = None,  # overload admission control: past
+        # admit_high_ms of measured queue delay, shed NEW sessions/prefills
+        # with a retriable overloaded(retry_after_ms) instead of letting
+        # queue-time deadline aborts kill them; established sessions'
+        # decode steps are always admitted (None -> BBTPU_ADMIT env)
+        admit_high_ms: float | None = None,  # admission high watermark in
+        # ms of live queue delay (None -> BBTPU_ADMIT_HIGH_MS env)
+        load_advert_s: float | None = None,  # refresh/announce the
+        # ServerInfo.load snapshot this often; effective cadence is
+        # min(announce_period, load_advert_s) so load telemetry can be
+        # fresher than liveness announces (None -> BBTPU_LOAD_ADVERT_S
+        # env; 0 = every announce_period)
     ):
         self.model_dir = model_dir
         if weight_quant is None:
@@ -476,6 +507,20 @@ class BlockServer:
         self.prefill_chunk_tokens = 0
         self.decode_steps_interleaved = 0
         self._chunking_sessions = 0
+        # overload protection: the admission controller sheds NEW work
+        # past the high watermark (established streams are never routed
+        # through it); the load advert republishes live queue gauges
+        from bloombee_tpu.server.admission import AdmissionController
+
+        if admit is None:
+            admit = bool(env.get("BBTPU_ADMIT"))
+        self.admission = (
+            AdmissionController(high_ms=admit_high_ms) if admit else None
+        )
+        self.load_advert_s = (
+            float(env.get("BBTPU_LOAD_ADVERT_S"))
+            if load_advert_s is None else float(load_advert_s)
+        )
         # session-KV replication (fast failover): sealed pages this primary
         # shipped to standbys, and tokens recovering clients replayed into
         # us; the semaphore bounds concurrent replication sweeps so standby
@@ -853,8 +898,42 @@ class BlockServer:
         finally:
             self._rebalancing = False
 
+    def load_snapshot(self) -> dict:
+        """Live load gauges republished in every advert (ServerInfo.load)
+        and consumed by the client router's predicted-queue-delay term.
+        Wall-clock `ts` lets readers staleness-discount the whole dict."""
+        import time as _time
+
+        waits = self.compute.wait_stats_ms()
+        window_s = (
+            self.admission.window_s if self.admission is not None else 5.0
+        )
+        delay_ms = self.compute.current_delay_ms(window_s)
+        table = getattr(self.manager, "table", None)
+        pages_free = getattr(table, "free_pages", None)
+        return {
+            "ts": _time.time(),
+            "delay_ms": round(delay_ms, 3),
+            "queue_depth": self.compute.depth(),
+            "wait_ms": {"p50": waits["p50"], "p95": waits["p95"]},
+            "prefill_wait_ms": waits["prefill"],
+            "decode_wait_ms": waits["decode"],
+            "mean_batch_width": round(
+                self.batched_steps / self.batch_dispatches
+                if self.batch_dispatches else 0.0, 3,
+            ),
+            "chunk_streams": self._chunking_sessions,
+            "pages_free": int(pages_free) if pages_free is not None else None,
+            "active_sessions": len(self._sessions),
+            "shedding": bool(
+                self.admission is not None
+                and delay_ms >= self.admission.high_ms
+            ),
+        }
+
     def server_info(self) -> ServerInfo:
         return ServerInfo(
+            load=self.load_snapshot(),
             state=(
                 ServerState.DRAINING if self._draining
                 else ServerState.ONLINE
@@ -894,7 +973,14 @@ class BlockServer:
 
     async def _announce_loop(self) -> None:
         while True:
-            await asyncio.sleep(self.announce_period)
+            period = self.announce_period
+            if self.load_advert_s > 0:
+                # faster advert cadence so routing reacts to hot servers
+                # within the load window, not a liveness period later; the
+                # registry expiration stays announce_period * 2.5, so extra
+                # announces only ever REFRESH liveness, never shorten it
+                period = min(period, self.load_advert_s)
+            await asyncio.sleep(period)
             if self._rebalancing:
                 # mid-move: announcing the OLD span would overwrite the
                 # tombstone (registry merge is latest-write-wins) and keep
@@ -1004,6 +1090,13 @@ class BlockServer:
             "repl_pages_sent": self.repl_pages_sent,
             "repl_lag_pages": self._repl_lag(),
             "failover_replayed_tokens": self.failover_replayed_tokens,
+            # overload observability: shed/admit counters, retry_after
+            # histogram, and per-client fair-share debt (None with the
+            # admission controller off; the live load snapshot itself rides
+            # in via server_info().to_wire()'s "load" key below)
+            "admission": (
+                self.admission.stats() if self.admission is not None else None
+            ),
             # operator visibility into the decode_n fast paths: a client
             # falling back to per-step decoding is otherwise invisible.
             # decode_n: ANY single-span flavor (fused scan or host-driven
@@ -1209,6 +1302,24 @@ class BlockServer:
         batch = int(meta["batch_size"])
         max_length = int(meta["max_length"])
         adapter = meta.get("adapter")
+        client_id = str(meta.get("client_id") or session_id)
+        if self.admission is not None:
+            # admission check BEFORE allocating KV: a session open is new
+            # work by definition. Shedding here (structured, retriable)
+            # beats admitting a session whose steps would then rot in the
+            # queue until the client's deadline aborts them.
+            retry_ms = self.admission.admit_new(
+                client_id, self.compute.current_delay_ms(
+                    self.admission.window_s
+                ),
+            )
+            if retry_ms is not None:
+                self.admission.shed_sessions += 1
+                raise OverloadedError(
+                    "server overloaded: queue delay past admission high "
+                    "watermark; retry elsewhere",
+                    retry_after_ms=retry_ms,
+                )
         from bloombee_tpu.models.checkpoint import resolve_adapter
 
         resolve_adapter(self.adapter_factors, adapter)  # loud on unknown
@@ -1218,7 +1329,8 @@ class BlockServer:
         ) as handle:
             import time as _time
 
-            session = _Session(session_id, handle, batch, layers, adapter)
+            session = _Session(session_id, handle, batch, layers, adapter,
+                               client_id=client_id)
             session.opened_at = _time.monotonic()
             session.last_step_at = session.opened_at
             self._sessions[session_id] = session
@@ -1447,6 +1559,29 @@ class BlockServer:
             await self._run_decode_n(session, stream, meta, tensors)
             return
 
+        if self.admission is not None and session.n_steps == 0:
+            # in-stream shed for NEW work only: a session that has never
+            # completed a step is about to run its prefill — if overload
+            # began after its open was admitted, refuse it now with the
+            # typed retriable reply (mirrors session_lost) instead of
+            # queueing it. A session with n_steps > 0 is ESTABLISHED: its
+            # next decode step is always admitted, so live streams degrade
+            # gracefully rather than die.
+            retry_ms = self.admission.admit_new(
+                session.client_id, self.compute.current_delay_ms(
+                    self.admission.window_s
+                ),
+            )
+            if retry_ms is not None:
+                await stream.send({
+                    "step": meta.get("step"),
+                    "overloaded": True,
+                    "retry_after_ms": retry_ms,
+                    "reason": "server overloaded: new-session prefill shed "
+                    "past admission high watermark",
+                })
+                return
+
         # keep the sender's dtype (bf16 on the production wire); the executor
         # casts to compute dtype on device
         hidden = np.asarray(tensors[0])
@@ -1558,6 +1693,13 @@ class BlockServer:
         session.sum_tokens += int(hidden.shape[0]) * int(hidden.shape[1])
         session.sum_dispatch_ms += t_dispatch_ms
         session.sum_fetch_ms += t_fetch_ms
+        if self.admission is not None:
+            # fair-share accounting: charge processed tokens (batch x seq)
+            # to the owning client so heavy clients accrue debt
+            self.admission.note_tokens(
+                session.client_id,
+                int(hidden.shape[0]) * int(hidden.shape[1]),
+            )
         dump_dir = env.get("BBTPU_DUMP_ACTIVATIONS")
         if dump_dir:
             self._dump_activations(dump_dir, session, meta, hidden, out)
@@ -1779,6 +1921,10 @@ class BlockServer:
         session.sum_tokens += int(ids.shape[0]) * n
         session.sum_dispatch_ms += t_dispatch_ms
         session.sum_fetch_ms += t_fetch_ms
+        if self.admission is not None:
+            self.admission.note_tokens(
+                session.client_id, int(ids.shape[0]) * n
+            )
         await stream.send(
             {
                 "step": meta.get("step"),
@@ -1927,6 +2073,8 @@ class BlockServer:
         session.sum_tokens += b * n
         session.sum_dispatch_ms += t_dispatch_sum
         session.sum_fetch_ms += max(total_ms - t_dispatch_sum, 0.0)
+        if self.admission is not None:
+            self.admission.note_tokens(session.client_id, b * n)
         await stream.send(
             {
                 "step": meta.get("step"),

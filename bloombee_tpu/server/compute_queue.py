@@ -113,6 +113,9 @@ class ComputeQueue:
         )
         self._worker_task: asyncio.Task | None = None
         self.max_group = max(1, int(max_group))
+        # samples are (picked_up_at_monotonic, wait_s) so windowed readers
+        # (admission control, load adverts) can discard old load regimes
+        # instead of averaging over the whole 512-sample tail
         self._waits: collections.deque = collections.deque(
             maxlen=_WAIT_SAMPLES
         )
@@ -120,6 +123,11 @@ class ComputeQueue:
         # stall-free if DECODE queue-wait stays bounded while chunks flow —
         # a blended percentile would hide exactly that signal
         self._class_waits: dict[str, collections.deque] = {}
+        # last time the worker popped anything: while the queue is non-empty
+        # and nothing pops, (now - _last_pop_at) lower-bounds the wait the
+        # NEXT pop will report — the only live signal during a jam, when the
+        # sample deques go quiet precisely because nothing completes
+        self._last_pop_at: float = time.monotonic()
 
     def start(self) -> None:
         self._worker_task = asyncio.create_task(self._worker())
@@ -140,9 +148,9 @@ class ComputeQueue:
 
     @staticmethod
     def _percentiles(samples) -> dict:
-        if not samples:
+        xs = sorted(w for _, w in samples) if samples else []
+        if not xs:
             return {"p50": 0.0, "p95": 0.0}
-        xs = sorted(samples)
 
         def pct(p: float) -> float:
             return xs[min(len(xs) - 1, round(p * (len(xs) - 1)))] * 1000.0
@@ -158,6 +166,29 @@ class ComputeQueue:
         for cls in ("prefill", "decode"):
             out[cls] = self._percentiles(self._class_waits.get(cls))
         return out
+
+    def depth(self) -> int:
+        """Tasks currently waiting for the worker (excludes the one on the
+        compute thread right now)."""
+        return self._queue.qsize()
+
+    def current_delay_ms(
+        self, window_s: float = 5.0, cls: str | None = None
+    ) -> float:
+        """Best live estimate of the queueing delay a task submitted NOW
+        would see, in ms: max of the windowed p95 of recent waits and the
+        age of the current jam (time since the last pop, if anything is
+        queued). The second term is what makes this usable for admission
+        control — during a stall no samples arrive, so a percentile alone
+        reads zero exactly when the queue is at its worst."""
+        now = time.monotonic()
+        src = self._class_waits.get(cls) if cls is not None else self._waits
+        recent = [e for e in (src or ()) if now - e[0] <= window_s]
+        p95 = self._percentiles(recent)["p95"]
+        stall_ms = 0.0
+        if self._queue.qsize() > 0:
+            stall_ms = (now - self._last_pop_at) * 1000.0
+        return max(p95, stall_ms)
 
     async def submit(
         self,
@@ -215,6 +246,7 @@ class ComputeQueue:
         loop = asyncio.get_running_loop()
         while True:
             _, _, task = await self._queue.get()
+            self._last_pop_at = time.monotonic()
             try:
                 if isinstance(task, _GroupTask):
                     await self._run_group(loop, task)
@@ -321,15 +353,16 @@ class ComputeQueue:
         return taken
 
     def _note_wait(self, task) -> None:
-        wait = time.monotonic() - task.enqueued_at
-        self._waits.append(wait)
+        now = time.monotonic()
+        wait = now - task.enqueued_at
+        self._waits.append((now, wait))
         if task.task_class is not None:
             dq = self._class_waits.get(task.task_class)
             if dq is None:
                 dq = self._class_waits[task.task_class] = collections.deque(
                     maxlen=_WAIT_SAMPLES
                 )
-            dq.append(wait)
+            dq.append((now, wait))
 
     def _expired(self, task) -> bool:
         # checked at execution time, not submit time: a deep queue behind

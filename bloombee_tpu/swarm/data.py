@@ -55,6 +55,17 @@ class ServerInfo:
     # selection requires it; old peers default to False via from_wire's
     # unknown-field filtering, so mixed swarms just never replicate.
     kv_repl: bool = False
+    # live load snapshot for load-aware routing: sliding-window gauges the
+    # server republishes every advert. Keys (all optional — adverts are
+    # untrusted wire input, consumers must sanitize every field):
+    #   ts (writer wall clock), delay_ms (server's own live queue-delay
+    #   estimate), queue_depth, wait_ms/{p50,p95},
+    #   prefill_wait_ms/decode_wait_ms (same shape, per class),
+    #   mean_batch_width, chunk_streams, pages_free, active_sessions,
+    #   shedding (admission controller past its high watermark).
+    # Old peers drop the whole field via from_wire unknown-field
+    # filtering; old adverts leave it None (routing then adds no load term).
+    load: dict | None = None
 
     def to_wire(self) -> dict:
         d = dataclasses.asdict(self)

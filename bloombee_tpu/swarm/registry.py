@@ -298,11 +298,18 @@ def _records_to_infos(
     """Raw (value|tombstone, stored_at) maps -> ModuleInfo list."""
     out = []
     for i, sub in zip(blocks, raw):
-        servers = {
-            sid: ServerInfo.from_wire(v)
-            for sid, (v, _t) in sub.items()
-            if v is not None  # drop tombstones
-        }
+        servers = {}
+        for sid, (v, t) in sub.items():
+            if v is None:  # drop tombstones
+                continue
+            info = ServerInfo.from_wire(v)
+            # advert freshness for load-aware routing: stored_at is stamped
+            # by the WRITER (same clock as the load snapshot's own ts), so
+            # it's the staleness fallback when an advert carries a load
+            # dict without a usable ts. Non-wire attribute on purpose —
+            # to_wire()/asdict never re-publish it.
+            info.advert_stored_at = t
+            servers[sid] = info
         out.append(ModuleInfo(uid=f"{model_uid}.{i}", servers=servers))
     return out
 

@@ -41,6 +41,43 @@ class ConnectionClosed(RpcError):
     pass
 
 
+class OverloadedError(RpcError):
+    """Structured retriable shed: the peer is healthy but past its
+    admission high-watermark, so it refused NEW work instead of letting it
+    rot in the queue until the deadline aborts it. Carries the server's
+    suggested retry delay; clients treat this as reroute-then-backoff (a
+    short overload penalty, never a fault ban)."""
+
+    def __init__(self, msg: str = "server overloaded",
+                 retry_after_ms: int | None = None):
+        super().__init__(msg)
+        self.retry_after_ms = (
+            int(retry_after_ms) if retry_after_ms is not None else None
+        )
+
+
+def error_to_meta(e: Exception) -> dict:
+    """Serialize a handler failure into an err-frame meta. Overload sheds
+    keep their structure (code + retry hint) across the wire; everything
+    else degrades to the legacy message string, which old peers parse
+    unchanged."""
+    meta = {"error": f"{type(e).__name__}: {e}"}
+    if isinstance(e, OverloadedError):
+        meta["code"] = "overloaded"
+        if e.retry_after_ms is not None:
+            meta["retry_after_ms"] = int(e.retry_after_ms)
+    return meta
+
+
+def error_from_meta(meta: dict) -> RpcError:
+    """Inverse of error_to_meta; unknown codes fall back to plain RpcError
+    so a newer peer's error classes never break an older client."""
+    msg = meta.get("error", "remote error")
+    if meta.get("code") == "overloaded":
+        return OverloadedError(msg, retry_after_ms=meta.get("retry_after_ms"))
+    return RpcError(msg)
+
+
 def _encode_frame(header: dict, blobs: list[bytes]) -> bytes:
     header = dict(header)
     header["bl"] = [len(b) for b in blobs]
@@ -326,10 +363,10 @@ class Connection:
         elif t == "err":
             fut = self._pending.get(rid)
             if fut is not None and not fut.done():
-                fut.set_exception(RpcError(header.get("meta", {}).get("error", "remote error")))
+                fut.set_exception(error_from_meta(header.get("meta", {})))
             stream = self._streams.get(rid)
             if stream is not None:
-                stream._push_inbound(RpcError(header.get("meta", {}).get("error", "remote error")))
+                stream._push_inbound(error_from_meta(header.get("meta", {})))
         else:
             logger.warning("unknown frame type %r", t)
 
@@ -357,7 +394,7 @@ class Connection:
             logger.debug("unary handler %s failed: %s", method, e)
             if not self.is_closing():
                 await self._send(
-                    {"t": "err", "id": rid, "meta": {"error": f"{type(e).__name__}: {e}"}},
+                    {"t": "err", "id": rid, "meta": error_to_meta(e)},
                     [],
                 )
 
@@ -384,12 +421,19 @@ class Connection:
             return
         try:
             await handler(stream)
+        except OverloadedError as e:
+            # expected shed under load, not a server fault: no stack trace
+            logger.info("stream handler %s shed: %s", method, e)
+            if not self.is_closing():
+                await self._send(
+                    {"t": "err", "id": stream.id, "meta": error_to_meta(e)},
+                    [],
+                )
         except Exception as e:
             logger.exception("stream handler %s failed: %s", method, e)
             if not self.is_closing():
                 await self._send(
-                    {"t": "err", "id": stream.id,
-                     "meta": {"error": f"{type(e).__name__}: {e}"}},
+                    {"t": "err", "id": stream.id, "meta": error_to_meta(e)},
                     [],
                 )
         finally:

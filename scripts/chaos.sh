@@ -1,10 +1,13 @@
 #!/usr/bin/env bash
 # Chaos gate: replay the chaos-marked suite under a fixed seed matrix of
 # ambient wire faults (the BBTPU_CHAOS_* env plan). Each entry is
-# "SEED:DELAY_P" — mild delay-only ambient chaos, so the per-test seeded
-# FaultPlans stay the dominant fault source while connections opened
-# before a test installs its plan still see injected jitter. Fixed seeds
-# keep every run replayable bit-for-bit (wire/faults.py contract).
+# "SEED:DELAY_P:ADMIT" — mild delay-only ambient chaos, so the per-test
+# seeded FaultPlans stay the dominant fault source while connections
+# opened before a test installs its plan still see injected jitter; the
+# ADMIT flag additionally turns on server admission control
+# (BBTPU_ADMIT, low high-watermark) so the overload scenario exercises
+# shed-and-reroute recovery paths under the same ambient jitter. Fixed
+# seeds keep every run replayable bit-for-bit (wire/faults.py contract).
 # Exits 0 when pytest is unavailable (mirrors scripts/lint.sh).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -14,16 +17,17 @@ if ! python -c "import pytest" >/dev/null 2>&1; then
     exit 0
 fi
 
-MATRIX=("11:0.05" "23:0.1")
+MATRIX=("11:0.05:0" "23:0.1:0" "31:0.05:1")
 for entry in "${MATRIX[@]}"; do
-    seed="${entry%%:*}"
-    delay_p="${entry##*:}"
-    echo "chaos: seed=${seed} delay_p=${delay_p}" >&2
+    IFS=: read -r seed delay_p admit <<<"${entry}"
+    echo "chaos: seed=${seed} delay_p=${delay_p} admit=${admit}" >&2
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     BBTPU_CHAOS=1 \
     BBTPU_CHAOS_SEED="${seed}" \
     BBTPU_CHAOS_DELAY_P="${delay_p}" \
     BBTPU_CHAOS_DELAY_S=0.02 \
+    BBTPU_ADMIT="${admit}" \
+    BBTPU_ADMIT_HIGH_MS=400 \
     python -m pytest tests/ -q -m chaos \
         -p no:cacheprovider -p no:xdist -p no:randomly "$@"
 done

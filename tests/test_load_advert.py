@@ -1,0 +1,243 @@
+"""Live load adverts: ServerInfo wire compatibility across mixed-version
+swarms, the compute queue's live delay signal, and the end-to-end advert
+path (BlockServer -> registry -> client manager).
+
+The mixed-version tests pin the from_wire unknown-field-filtering contract
+in BOTH directions: an old peer's advert (no `load`) must parse on a new
+client, and a new peer's advert (with `load` and future fields) must parse
+on an old client — otherwise rolling a swarm upgrade would partition it.
+"""
+
+import asyncio
+import dataclasses
+import time
+
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bloombee_tpu.server.compute_queue import ComputeQueue
+from bloombee_tpu.swarm.data import ServerInfo, ServerState
+
+
+# ---------------------------------------------------------- wire compat
+def _old_server_info_cls():
+    """A replica of ServerInfo as it looked BEFORE the `load` field (and
+    before any future field), with the same from_wire filtering — stands
+    in for an old peer's parser in the new->old direction."""
+
+    @dataclasses.dataclass
+    class OldServerInfo:
+        state: ServerState = ServerState.ONLINE
+        host: str = ""
+        port: int = 0
+        version: str = "0.1.0"
+        throughput: float = 1.0
+        start_block: int | None = None
+        end_block: int | None = None
+
+        @classmethod
+        def from_wire(cls, d):
+            d = dict(d)
+            d["state"] = ServerState(d.get("state", 2))
+            known = {f.name for f in dataclasses.fields(cls)}
+            return cls(**{k: v for k, v in d.items() if k in known})
+
+    return OldServerInfo
+
+
+def test_old_advert_parses_on_new_client():
+    """old -> new: an advert with no `load` key (and with an unknown field
+    from some OTHER future version) constructs cleanly; load stays None so
+    routing adds no load term."""
+    wire = {
+        "state": 2, "host": "10.0.0.9", "port": 7801, "throughput": 3.0,
+        "start_block": 0, "end_block": 4,
+        "some_future_field": {"x": 1},  # must be dropped, not crash
+    }
+    info = ServerInfo.from_wire(wire)
+    assert info.state is ServerState.ONLINE
+    assert info.host == "10.0.0.9" and info.port == 7801
+    assert info.load is None
+
+
+def test_new_advert_parses_on_old_peer():
+    """new -> old: a fully-populated new advert (load dict included) is
+    filtered down to the old peer's known fields without error."""
+    new = ServerInfo(
+        host="10.0.0.2", port=7802, throughput=5.0,
+        start_block=0, end_block=8,
+        load={"ts": time.time(), "delay_ms": 120.0, "queue_depth": 3,
+              "shedding": True},
+    )
+    old_cls = _old_server_info_cls()
+    old = old_cls.from_wire(new.to_wire())
+    assert old.host == "10.0.0.2" and old.port == 7802
+    assert not hasattr(old, "load")
+
+
+def test_load_round_trips_between_new_peers():
+    load = {
+        "ts": 123.0, "delay_ms": 42.5, "queue_depth": 2,
+        "wait_ms": {"p50": 1.0, "p95": 9.0}, "mean_batch_width": 1.5,
+        "chunk_streams": 0, "pages_free": 17, "active_sessions": 3,
+        "shedding": False,
+    }
+    info = ServerInfo(host="h", port=1, load=load)
+    back = ServerInfo.from_wire(info.to_wire())
+    assert back.load == load
+
+
+# ------------------------------------------------- live queue-delay signal
+def test_current_delay_ms_idle_queue_is_zero():
+    async def run():
+        q = ComputeQueue()
+        q.start()
+        try:
+            assert q.depth() == 0
+            assert q.current_delay_ms() == 0.0
+        finally:
+            await q.stop()
+
+    asyncio.run(run())
+
+
+def test_current_delay_ms_sees_live_jam_and_recent_waits():
+    async def run():
+        import threading
+
+        from bloombee_tpu.server.compute_queue import PRIORITY_INFERENCE
+
+        q = ComputeQueue()
+        q.start()
+        try:
+            gate = threading.Event()
+            jam = asyncio.create_task(
+                q.submit(PRIORITY_INFERENCE, gate.wait, 5.0)
+            )
+            await asyncio.sleep(0.05)  # the jam is on the worker thread
+            waiter = asyncio.create_task(
+                q.submit(PRIORITY_INFERENCE, lambda: None)
+            )
+            await asyncio.sleep(0.15)
+            # the queued task has recorded NO wait sample yet — the live
+            # signal must still see the jam via the stall term, and depth
+            # must count the waiter
+            assert q.depth() >= 1
+            assert q.current_delay_ms() >= 100.0
+            gate.set()
+            await asyncio.gather(jam, waiter)
+            # after the pop, the recorded wait sample keeps the signal warm
+            assert q.current_delay_ms() >= 100.0
+            # ...but only within the window: old samples age out
+            assert q.current_delay_ms(window_s=1e-9) == 0.0
+        finally:
+            await q.stop()
+
+    asyncio.run(run())
+
+
+# -------------------------------------------------------- end-to-end advert
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, num_hidden_layers=3, vocab_size=128,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = tmp_path_factory.mktemp("tiny_llama_load")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def test_load_advert_reaches_client_manager(tiny_model_dir):
+    """A running server's announce publishes the load snapshot; the client
+    manager's swarm view exposes it (plus the registry's writer-stamped
+    staleness fallback) for the routing cost term."""
+    from bloombee_tpu.client.sequence_manager import (
+        RemoteSequenceManager,
+        predicted_queue_delay_s,
+    )
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        server = BlockServer(
+            model_uid="tiny", start=0, end=3, model_dir=tiny_model_dir,
+            registry=rc(), compute_dtype=jnp.float32, num_pages=16,
+            page_size=4, announce_period=0.2, load_advert_s=0.1,
+        )
+        await server.start()
+        try:
+            snap = server.load_snapshot()
+            for key in ("ts", "delay_ms", "queue_depth", "wait_ms",
+                        "mean_batch_width", "chunk_streams", "pages_free",
+                        "active_sessions", "shedding"):
+                assert key in snap, key
+            assert snap["pages_free"] == 16
+            assert snap["active_sessions"] == 0
+
+            await asyncio.sleep(0.5)
+            manager = RemoteSequenceManager(rc(), "tiny", 3)
+            await manager.update(force=True)
+            info = manager.spans[server.server_id].server_info
+            assert isinstance(info.load, dict)
+            assert info.load["pages_free"] == 16
+            # registry stamped its own receive time as staleness fallback
+            assert getattr(info, "advert_stored_at", None) is not None
+            # idle server: the predicted delay term is (near) zero, so the
+            # advert does not repel traffic from a cold swarm
+            assert predicted_queue_delay_s(info) < 0.1
+        finally:
+            await server.stop()
+            await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_load_advert_cadence_overrides_announce_period(tiny_model_dir):
+    """load_advert_s faster than announce_period re-publishes the snapshot
+    at the faster cadence (staleness window stays announce-based, so the
+    extra announces only refresh the load view)."""
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        server = BlockServer(
+            model_uid="tiny", start=0, end=3, model_dir=tiny_model_dir,
+            registry=rc(), compute_dtype=jnp.float32, num_pages=16,
+            page_size=4, announce_period=30.0, load_advert_s=0.1,
+        )
+        await server.start()
+        try:
+            infos = await rc().get_module_infos("tiny", range(3))
+            ts0 = infos[0].servers[server.server_id].load["ts"]
+            await asyncio.sleep(0.5)
+            infos = await rc().get_module_infos("tiny", range(3))
+            ts1 = infos[0].servers[server.server_id].load["ts"]
+            # with announce_period=30 alone the snapshot could not have
+            # refreshed inside half a second
+            assert ts1 > ts0
+        finally:
+            await server.stop()
+            await reg.stop()
+
+    asyncio.run(run())

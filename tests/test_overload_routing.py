@@ -1,0 +1,219 @@
+"""Load-aware routing robustness: the predicted-queue-delay edge term must
+keep Dijkstra valid under ARBITRARY advert garbage (NaN, negatives, wrong
+types, hostile values), stay monotone in reported load (an advert can only
+repel traffic from its own server, never capture traffic for it), and
+decay with staleness. Plus the overload penalty class: shorter than fault
+bans, retry_after-floored, cleared by success, and respected by standby
+selection.
+
+Pure routing-layer tests (registry=None, spans injected) — no servers, no
+jax compute.
+"""
+
+import math
+import random
+import time
+
+from bloombee_tpu.client.sequence_manager import (
+    LOAD_DELAY_CAP_S,
+    LOAD_STALE_S,
+    RemoteSequenceManager,
+    predicted_queue_delay_s,
+)
+from bloombee_tpu.swarm.data import RemoteSpanInfo, ServerInfo
+
+
+def _span(peer_id, start, end, **info_kw):
+    info_kw.setdefault("host", "127.0.0.1")
+    info_kw.setdefault("port", 7000 + hash(peer_id) % 100)
+    info_kw.setdefault("throughput", 10.0)
+    info_kw.setdefault("inference_rps", 10.0)
+    return RemoteSpanInfo(
+        peer_id, start, end,
+        ServerInfo(start_block=start, end_block=end, **info_kw),
+    )
+
+
+def _manager(num_blocks=2, **kw):
+    kw.setdefault("overload_timeout", 0.2)
+    kw.setdefault("overload_max", 1.0)
+    kw.setdefault("rng", random.Random(0))
+    return RemoteSequenceManager(None, "uid", num_blocks, **kw)
+
+
+# --------------------------------------------------- cost-term properties
+GARBAGE_LOADS = [
+    None,
+    "not a dict",
+    42,
+    {},
+    {"delay_ms": float("nan")},
+    {"delay_ms": float("inf")},
+    {"delay_ms": -1e12},
+    {"delay_ms": "elephant"},
+    {"delay_ms": 1e300, "queue_depth": 1e300},
+    {"queue_depth": float("nan"), "wait_ms": "nope"},
+    {"wait_ms": {"p95": float("inf"), "p50": None}},
+    {"decode_wait_ms": {"p95": -5.0}},
+    {"ts": float("nan"), "delay_ms": 500.0},
+    {"ts": "yesterday", "delay_ms": 500.0},
+    {"ts": -1e18, "delay_ms": 500.0},
+    {"ts": 1e18, "delay_ms": 500.0},  # advert from the future
+    {"shedding": "maybe", "delay_ms": {}},
+    {"delay_ms": [1, 2, 3], "queue_depth": {"a": 1}},
+]
+
+
+def test_predicted_delay_finite_bounded_for_any_garbage():
+    """No advert value may produce a negative, NaN, infinite, or
+    above-cap cost term — the Dijkstra validity invariant."""
+    now = time.time()
+    for load in GARBAGE_LOADS:
+        info = ServerInfo(load=load)
+        d = predicted_queue_delay_s(info, now=now)
+        assert math.isfinite(d), load
+        assert 0.0 <= d <= LOAD_DELAY_CAP_S, (load, d)
+
+
+def test_predicted_delay_monotone_in_load():
+    """More reported load never lowers the cost term, for each signal the
+    term reads — so a server cannot advertise its way into MORE traffic."""
+    now = time.time()
+
+    def term(**load):
+        load.setdefault("ts", now)
+        return predicted_queue_delay_s(ServerInfo(load=load), now=now)
+
+    for key in ("delay_ms", "queue_depth"):
+        prev = -1.0
+        for v in (0, 1, 10, 100, 1000, 10000, 1e9):
+            cur = term(**{key: v})
+            assert cur >= prev, (key, v)
+            prev = cur
+    prev = -1.0
+    for p95 in (0, 5, 50, 500, 5000):
+        cur = term(wait_ms={"p95": p95})
+        assert cur >= prev
+        prev = cur
+    assert term(delay_ms=100.0, shedding=True) > term(delay_ms=100.0)
+    # the floor IS the no-advert baseline: garbage collapses to it
+    assert term() == predicted_queue_delay_s(ServerInfo(load=None))
+
+
+def test_predicted_delay_staleness_decay():
+    now = time.time()
+    fresh = ServerInfo(load={"ts": now, "delay_ms": 2000.0})
+    mid = ServerInfo(load={"ts": now - LOAD_STALE_S / 2, "delay_ms": 2000.0})
+    stale = ServerInfo(load={"ts": now - 2 * LOAD_STALE_S, "delay_ms": 2000.0})
+    d_fresh = predicted_queue_delay_s(fresh, now=now)
+    d_mid = predicted_queue_delay_s(mid, now=now)
+    d_stale = predicted_queue_delay_s(stale, now=now)
+    assert d_fresh > d_mid > d_stale == 0.0
+    # registry fallback stamp is honored when the advert has no usable ts
+    info = ServerInfo(load={"delay_ms": 2000.0, "ts": "garbage"})
+    info.advert_stored_at = now - 2 * LOAD_STALE_S
+    assert predicted_queue_delay_s(info, now=now) == 0.0
+
+
+def test_hostile_advert_cannot_capture_traffic():
+    """A server advertising impossibly-good load (negative delay, NaN) gets
+    exactly the no-advert baseline cost — it cannot undercut an honest
+    idle server; and its own hostile-HIGH advert only repels itself."""
+    m = _manager()
+    honest = _span("honest", 0, 2)
+    for load in GARBAGE_LOADS:
+        liar = _span("liar", 0, 2, load=load)
+        assert (
+            m._compute_cost(liar, 2, None)
+            >= m._compute_cost(honest, 2, None) - 1e-12
+        ), load
+    # an honestly-hot server loses the route to the idle one
+    hot = _span("hot", 0, 2,
+                load={"ts": time.time(), "delay_ms": 3000.0})
+    m.spans = {"hot": hot, "idle": _span("idle", 0, 2)}
+    for _ in range(5):
+        assert [s.peer_id for s in m.make_sequence()] == ["idle"]
+
+
+def test_load_aware_off_ignores_adverts():
+    m = _manager(load_aware=False)
+    hot = _span("hot", 0, 2, load={"ts": time.time(), "delay_ms": 9e9})
+    assert m._compute_cost(hot, 2, None) == m._compute_cost(
+        _span("idle", 0, 2), 2, None
+    )
+
+
+# ------------------------------------------------- overload penalty class
+def test_overload_penalty_excludes_then_readmits():
+    m = _manager(overload_timeout=0.05, overload_max=0.1)
+    m.spans = {"a": _span("a", 0, 2), "b": _span("b", 0, 2)}
+    m.note_peer_overloaded("a")
+    route = m.make_sequence()
+    assert [s.peer_id for s in route] == ["b"]
+    time.sleep(0.15)
+    # expired: the peer is routable again (half-open probe)
+    now = time.monotonic()
+    assert not m._ban_excludes("a", now)
+
+
+def test_overload_is_shorter_class_than_fault_ban():
+    """Same strike count: the overload backoff must cap far below the
+    fault-ban cap, and a shed must never touch the fault-ban map."""
+    m = _manager(ban_timeout=15.0, ban_max=120.0,
+                 overload_timeout=2.0, overload_max=15.0)
+    for _ in range(10):
+        m.note_peer_overloaded("a")
+    assert "a" not in m._bans
+    assert m._hot["a"].banned_until - time.monotonic() <= 15.0 * 1.25 + 0.01
+    m2 = _manager(ban_timeout=15.0, ban_max=120.0)
+    for _ in range(10):
+        m2.ban_peer("a")
+    fault_left = m2._bans["a"].banned_until - time.monotonic()
+    hot_left = m._hot["a"].banned_until - time.monotonic()
+    assert hot_left < fault_left
+
+
+def test_retry_after_hint_floors_backoff():
+    m = _manager(overload_timeout=0.01, overload_max=60.0)
+    m.note_peer_overloaded("a", retry_after_s=5.0)
+    left = m._hot["a"].banned_until - time.monotonic()
+    assert left >= 5.0 * 0.75 - 0.01  # hint floor, with jitter
+
+
+def test_success_clears_overload_history():
+    m = _manager()
+    m.note_peer_overloaded("a")
+    m.note_peer_ok("a")
+    assert "a" not in m._hot
+    assert not m._ban_excludes("a", time.monotonic())
+
+
+def test_pick_standby_avoids_hot_peers():
+    m = _manager()
+    primary = _span("primary", 0, 2, kv_repl=True, page_size=4)
+    cool = _span("cool", 0, 2, kv_repl=True, page_size=4,
+                 inference_rps=1.0, throughput=1.0)
+    fast_but_hot = _span("hot", 0, 2, kv_repl=True, page_size=4,
+                         inference_rps=100.0, throughput=100.0)
+    m.spans = {s.peer_id: s for s in (primary, cool, fast_but_hot)}
+    # without overload state the faster standby wins
+    assert m.pick_standby(primary).peer_id == "hot"
+    m.note_peer_overloaded("hot")
+    assert m.pick_standby(primary).peer_id == "cool"
+    # when EVERY candidate is hot, degrade to the best hot one rather
+    # than losing replication entirely
+    m.note_peer_overloaded("cool")
+    assert m.pick_standby(primary) is not None
+
+
+def test_pick_standby_discounts_advertised_load():
+    m = _manager()
+    primary = _span("primary", 0, 2, kv_repl=True, page_size=4)
+    busy = _span("busy", 0, 2, kv_repl=True, page_size=4,
+                 inference_rps=10.0, throughput=10.0,
+                 load={"ts": time.time(), "delay_ms": 5000.0})
+    idle = _span("idle", 0, 2, kv_repl=True, page_size=4,
+                 inference_rps=9.0, throughput=9.0)
+    m.spans = {s.peer_id: s for s in (primary, busy, idle)}
+    # near-equal throughput: the advertised 5s queue pushes `busy` below
+    assert m.pick_standby(primary).peer_id == "idle"
