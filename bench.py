@@ -127,6 +127,28 @@ def _emit_json_locked():
         out["prefix_hit_tokens"] = int(pfx.get("hit_tokens", 0))
         out["prefix_hit_rate"] = round(pfx.get("hit_rate", 0.0), 3)
         out["prefix_warm_speedup"] = round(pfx.get("speedup", 0.0), 2)
+    rec = RESULTS.get("reconnect")
+    if rec:
+        # session leases + reconnect-resume: recovery stall + replayed
+        # tokens when the client's connection is severed mid-decode, with
+        # resume on (re-attach the lease-parked session, retransmit one
+        # step, zero prompt replay) vs off (full history replay)
+        out["reconnect_stall_resume_ms"] = round(
+            rec.get("stall_resume_ms", 0.0), 1
+        )
+        out["reconnect_stall_replay_ms"] = round(
+            rec.get("stall_replay_ms", 0.0), 1
+        )
+        out["reconnect_replayed_tokens_resume"] = int(
+            rec.get("replayed_resume", 0)
+        )
+        out["reconnect_replayed_tokens_full"] = int(
+            rec.get("replayed_full", 0)
+        )
+        out["reconnect_steps_deduped"] = int(rec.get("steps_deduped", 0))
+        out["reconnect_sessions_resumed"] = int(
+            rec.get("sessions_resumed", 0)
+        )
     fo = RESULTS.get("failover")
     if fo:
         # standby-KV replication: recovery stall + replayed tokens when a
@@ -539,6 +561,18 @@ def main():
         phase("failover", f"failed: {e!r}"[:200])
         RESULTS.setdefault("degraded", f"failover phase failed: {e!r}")
         log(f"failover phase FAILED: {e!r}")
+
+    # ---- reconnect phase: sever the client's connection mid-decode and
+    # measure the recovery stall + replayed tokens with reconnect-resume
+    # on (re-attach the lease-parked session, retransmit ONE step under
+    # its original id) vs off (full history replay onto a fresh session)
+    try:
+        phase("reconnect", "started")
+        run_reconnect(spec, params)
+    except Exception as e:  # noqa: BLE001
+        phase("reconnect", f"failed: {e!r}"[:200])
+        RESULTS.setdefault("degraded", f"reconnect phase failed: {e!r}")
+        log(f"reconnect phase FAILED: {e!r}")
 
     # ---- interference phase: decode TBT (time-between-tokens) for N
     # sessions while a long prompt prefills concurrently on the same
@@ -1344,6 +1378,105 @@ def run_failover(spec, params) -> None:
     log(
         f"failover: stall {repl['stall_ms']:.1f} ms replaying "
         f"{repl['replayed']} tokens (replication on) vs "
+        f"{full['stall_ms']:.1f} ms replaying {full['replayed']} tokens "
+        f"(full replay)"
+    )
+
+
+def run_reconnect(spec, params) -> None:
+    """Reconnect-resume phase: ONE server with session leases on; a session
+    prefills and decodes half its budget, then its connection is severed
+    (transport abort — the wire equivalent of a NAT timeout / partition
+    heal). With resume on, the client re-attaches the lease-parked session
+    on a fresh stream and retransmits the interrupted step under its
+    original id (the server answers from its recorded reply if it already
+    applied it) — zero prompt tokens replayed. With resume off, the client
+    rebuilds a fresh session and replays the whole history. Reports both
+    stalls, replayed-token counts, and the server's resume/dedup
+    counters."""
+    import asyncio
+
+    from bloombee_tpu.client.session import InferenceSession
+    from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    span_layers = spec.num_hidden_layers
+    PAGE = 16
+    PROMPT, DECODE = 4 * PAGE, 24
+    VOCAB_EFF = min(1024, spec.vocab_size)
+
+    async def one_reconnect(resume: bool) -> dict:
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        server = BlockServer(
+            model_uid="bench_rec", start=0, end=span_layers, params=params,
+            spec=spec, registry=rc(), num_pages=256, page_size=PAGE,
+            max_batch=1, session_lease_s=30.0,
+        )
+        await server.start()
+        manager = RemoteSequenceManager(rc(), "bench_rec", span_layers)
+        rng = np.random.default_rng(19)
+        embed_table = (
+            rng.standard_normal((VOCAB_EFF, spec.hidden_size)) * 0.02
+        ).astype(np.float32)
+
+        async def one_token(s):
+            nid = rng.integers(0, VOCAB_EFF, size=(1, 1))
+            await s.step(embed_table[nid], ids=nid)
+
+        try:
+            s = InferenceSession(
+                manager, max_length=PROMPT + DECODE + 4, batch_size=1,
+                resume=resume,
+            )
+            async with s:
+                ids = rng.integers(0, VOCAB_EFF, size=(1, PROMPT))
+                await s.step(embed_table[ids], ids=ids)
+                for _ in range(DECODE // 2):
+                    await one_token(s)
+                # sever the wire under the session: every span conn dies
+                # with no FIN handshake, like a partition healing into RST
+                for sp in s._spans:
+                    sp.conn.abort("bench: injected partition")
+                t0 = time.time()
+                await one_token(s)  # first post-partition step -> recovery
+                stall_ms = (time.time() - t0) * 1000.0
+                for _ in range(DECODE // 2 - 1):
+                    await one_token(s)
+                return {
+                    "stall_ms": stall_ms,
+                    "replayed": int(s.failover_replayed_tokens),
+                    "resumed_streams": int(s.resumed_streams),
+                    "steps_deduped": int(server.steps_deduped),
+                    "sessions_resumed": int(server.sessions_resumed),
+                }
+        finally:
+            for stop in (server.stop, reg.stop):
+                try:
+                    await asyncio.wait_for(stop(), timeout=30.0)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    res = asyncio.run(one_reconnect(resume=True))
+    full = asyncio.run(one_reconnect(resume=False))
+    RESULTS["reconnect"] = {
+        "stall_resume_ms": res["stall_ms"],
+        "stall_replay_ms": full["stall_ms"],
+        "replayed_resume": res["replayed"],
+        "replayed_full": full["replayed"],
+        "steps_deduped": res["steps_deduped"],
+        "sessions_resumed": res["sessions_resumed"],
+    }
+    phase("reconnect", "ok")
+    log(
+        f"reconnect: stall {res['stall_ms']:.1f} ms replaying "
+        f"{res['replayed']} tokens (resume: {res['sessions_resumed']} "
+        f"resumed, {res['steps_deduped']} deduped) vs "
         f"{full['stall_ms']:.1f} ms replaying {full['replayed']} tokens "
         f"(full replay)"
     )

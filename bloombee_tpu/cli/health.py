@@ -98,6 +98,33 @@ def main(argv=None):
                         line += "  " + " ".join(
                             f"{k}={v}" for k, v in sorted(sched.items())
                         )
+                    # session lease counters: are leases reaping abandoned
+                    # sessions, are clients resuming instead of replaying,
+                    # and is keepalive traffic flowing on idle conns
+                    lease = {
+                        k: probe[k]
+                        for k in (
+                            "sessions_reaped",
+                            "sessions_resumed",
+                            "steps_deduped",
+                            "keepalives_sent",
+                            "pushes_dropped",
+                        )
+                        if probe.get(k)
+                    }
+                    if lease:
+                        line += "  " + " ".join(
+                            f"{k}={v}" for k, v in sorted(lease.items())
+                        )
+                    # live session ages: a large oldest-idle with leases
+                    # off (session_lease_s=0) is exactly the wedged-session
+                    # leak this server would never clean up
+                    if probe.get("sessions_parked"):
+                        line += f"  sessions_parked={probe['sessions_parked']}"
+                    for k in ("session_oldest_s", "session_oldest_idle_s"):
+                        v = probe.get(k)
+                        if v:
+                            line += f"  {k}={v:.1f}"
                     waits = probe.get("queue_wait_ms") or {}
                     for cls in ("prefill", "decode"):
                         w = waits.get(cls) or {}

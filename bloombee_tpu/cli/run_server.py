@@ -136,6 +136,22 @@ def main(argv=None):
                         help="queue-delay high watermark in ms before the "
                              "admission controller starts shedding (default "
                              "follows BBTPU_ADMIT_HIGH_MS)")
+    parser.add_argument("--session-lease-s", type=float, default=None,
+                        help="session lease: a session whose client goes "
+                             "silent (no step, no keepalive) this long is "
+                             "reaped — its KV pages become evictable cached "
+                             "prefix-pool entries, then free. Disconnected "
+                             "clients may reconnect-resume a parked session "
+                             "within the lease, token-identical and with "
+                             "zero prompt replay (0 disables; default "
+                             "follows BBTPU_SESSION_LEASE_S)")
+    parser.add_argument("--keepalive-s", type=float, default=None,
+                        help="wire keepalive interval: ping idle "
+                             "connections, declare them dead after ~2.5x "
+                             "silence, so half-open TCP (partition, silent "
+                             "peer death) is detected instead of hanging "
+                             "(0 disables; default follows "
+                             "BBTPU_KEEPALIVE_S)")
     parser.add_argument("--load-advert-s", type=float, default=None,
                         help="republish the live load snapshot at this "
                              "cadence (seconds) when faster than "
@@ -219,6 +235,8 @@ def main(argv=None):
             admit=args.admit,
             admit_high_ms=args.admit_high_ms,
             load_advert_s=args.load_advert_s,
+            session_lease_s=args.session_lease_s,
+            keepalive_s=args.keepalive_s,
         )
         await server.start()
         if args.warmup_batches:

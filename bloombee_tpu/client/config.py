@@ -77,3 +77,20 @@ class ClientConfig:
     # fair-share identity reported to servers' admission controllers; None
     # uses one id per client process so extra sessions can't dodge fairness
     client_id: str | None = None
+    # reconnect-resume: after a stream failure, try to re-attach each
+    # span's lease-parked session (resume: session_id on a fresh stream)
+    # and retransmit the failed step under its ORIGINAL step id — servers
+    # that already applied it answer from the recorded reply (at-most-once)
+    # so the generation continues token-identical with ZERO prompt replay.
+    # Declined resumes (lease expired, leases off, KV evicted) fall back to
+    # the ordinary standby/full-replay recovery. None -> BBTPU_RESUME env
+    resume: bool | None = None
+    # how long one span's resume handshake may take before the client gives
+    # up on the cheap path and full-replays (deliberately shorter than
+    # step_timeout: resume races the lease clock)
+    resume_timeout: float = 10.0
+    # wire keepalive interval for the client side of every span connection
+    # (ping on idle, declare dead after ~2.5x silence) so a partitioned
+    # server is detected without waiting out step_timeout; None ->
+    # BBTPU_KEEPALIVE_S env, 0 disables
+    keepalive_s: float | None = None

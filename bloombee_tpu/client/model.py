@@ -199,6 +199,9 @@ class DistributedModelForCausalLM:
             repl_every=cfg.kv_repl_every,
             client_id=cfg.client_id,
             overload_retries=cfg.overload_retries,
+            resume=cfg.resume,
+            resume_timeout=cfg.resume_timeout,
+            keepalive_s=cfg.keepalive_s,
         )
 
     # --------------------------------------------------------------- generate

@@ -46,6 +46,15 @@ env.declare(
     "covering the same span, so failover replays at most one interval "
     "plus the unsealed tail (0 = replication off)",
 )
+env.declare(
+    "BBTPU_RESUME", bool, True,
+    "reconnect-resume: after a stream failure, try to re-attach each "
+    "span's lease-parked session (resume: session_id) and retransmit the "
+    "failed step under its original id — at-most-once server-side, zero "
+    "prompt replay — before falling back to full-replay recovery. Safe "
+    "against servers with leases off: they decline and recovery proceeds "
+    "as before",
+)
 
 # the first no-embed_fn decode_n session in the process warns loudly; later
 # sessions demote to DEBUG (a bench tail spawning many raw sessions would
@@ -126,6 +135,14 @@ class InferenceSession:
         # rides out (backoff + reroute) before failing hard — a separate,
         # more generous budget than max_retries because a shed is the
         # server WORKING AS DESIGNED under load, not a fault
+        resume: bool | None = None,  # reconnect-resume after a stream
+        # failure: re-attach each span's lease-parked session and
+        # retransmit the failed step under its original id (at-most-once
+        # server-side, zero prompt replay); None -> BBTPU_RESUME env
+        resume_timeout: float = 10.0,  # per-span resume handshake budget
+        # before giving up on the cheap path (the lease clock is running)
+        keepalive_s: float | None = None,  # client-side wire keepalive for
+        # span connections (None -> BBTPU_KEEPALIVE_S env; 0 disables)
     ):
         self.manager = manager
         self.adapter = adapter
@@ -137,6 +154,18 @@ class InferenceSession:
         self.client_id = client_id or _PROCESS_CLIENT_ID
         self.overload_retries = max(0, int(overload_retries))
         self.embed_fn = embed_fn
+        self.resume = (
+            bool(env.get("BBTPU_RESUME")) if resume is None else bool(resume)
+        )
+        self.resume_timeout = float(resume_timeout)
+        self.keepalive_s = keepalive_s
+        # reconnect-resume observability: streams re-attached without
+        # replay, resumes the servers declined (fell back to recovery),
+        # and the (step_id, prefix_skip) of the last transmitted step so a
+        # post-resume retry retransmits it bit-identical under the SAME id
+        self.resumed_streams = 0
+        self.resume_declines = 0
+        self._last_sent: tuple[int, int | None] | None = None
         self.prefix_cache = (
             env.get("BBTPU_PREFIX_CACHE") if prefix_cache is None
             else bool(prefix_cache)
@@ -211,7 +240,10 @@ class InferenceSession:
 
     async def _open_span(self, span: RemoteSpanInfo) -> _SpanSession:
         session_id = f"sess-{uuid.uuid4().hex[:12]}"
-        conn = await connect(span.server_info.host, span.server_info.port)
+        conn = await connect(
+            span.server_info.host, span.server_info.port,
+            keepalive_s=self.keepalive_s,
+        )
         stream = await conn.open_stream(
             "rpc_inference",
             {
@@ -448,6 +480,7 @@ class InferenceSession:
         (or (output, keep) for pruned tree steps)."""
         attempt = 0
         overload_waits = 0
+        resume_step = None  # (step_id, skip): retransmit after a resume
         while True:
             try:
                 if self._needs_rebuild:
@@ -457,14 +490,25 @@ class InferenceSession:
                     return await self._step_pruned(
                         hidden, tree_mask, depths, prune, accept_per_span
                     )
-                # shared-prefix fast path: on the session's FIRST committed
-                # prefill, probe the chain's prefix pools and ship only the
-                # uncached suffix (the servers' KV for the skipped positions
-                # is adopted from pooled pages). The returned output covers
-                # only the suffix — callers consume the last position, which
-                # is always kept (the probe caps the skip below the prompt).
-                send_hidden, skip = hidden, None
-                if (
+                send_hidden, skip, step_id = hidden, None, None
+                if resume_step is not None:
+                    # retransmit the exact failed step: same id (servers
+                    # that applied it dedup instead of re-applying), same
+                    # prefix skip (identical suffix bytes) — and no fresh
+                    # probe, which would both waste a round trip and bump
+                    # the server's last-applied step past the retransmit
+                    step_id, skip = resume_step
+                    resume_step = None
+                    if skip:
+                        send_hidden = hidden[:, skip:]
+                elif (
+                    # shared-prefix fast path: on the session's FIRST
+                    # committed prefill, probe the chain's prefix pools and
+                    # ship only the uncached suffix (the servers' KV for the
+                    # skipped positions is adopted from pooled pages). The
+                    # returned output covers only the suffix — callers
+                    # consume the last position, which is always kept (the
+                    # probe caps the skip below the prompt).
                     self.prefix_cache
                     and commit
                     and tree_mask is None
@@ -479,7 +523,7 @@ class InferenceSession:
                         send_hidden = hidden[:, skip:]
                 out = await self._step_once(
                     send_hidden, commit, tree_mask, depths, accept,
-                    commit_lens, prefix_skip=skip,
+                    commit_lens, prefix_skip=skip, step_id=step_id,
                 )
                 if commit and tree_mask is None:
                     if ids is not None and self.embed_fn is not None:
@@ -517,6 +561,25 @@ class InferenceSession:
                 attempt += 1
                 if attempt > self.max_retries:
                     raise
+                if (
+                    self.resume
+                    and self._last_sent is not None
+                    and prune is None
+                    and accept_per_span is None
+                ):
+                    # cheap path first: re-attach the lease-parked sessions
+                    # on fresh streams and retransmit the failed step under
+                    # its original id — spans that already applied it answer
+                    # from the recorded reply, so no KV is rebuilt and no
+                    # prompt token is replayed
+                    last = self._last_sent
+                    if await self._try_resume():
+                        resume_step = last
+                        logger.info(
+                            "step failed (%s); resumed session, "
+                            "retransmitting step %d", e, last[0],
+                        )
+                        continue
                 logger.warning(
                     "step failed (%s); re-routing (attempt %d)", e, attempt
                 )
@@ -622,14 +685,19 @@ class InferenceSession:
 
     async def _step_once(
         self, hidden, commit, tree_mask, depths=None, accept=None,
-        commit_lens=None, prefix_skip=None,
+        commit_lens=None, prefix_skip=None, step_id=None,
     ):
         if not self._spans:
             # a failed recovery left no open chain; surface as a retryable
             # wire error so the caller's retry loop attempts recovery again
             raise RpcError("session chain is closed (recovery pending)")
-        step_id = self._step_counter
-        self._step_counter += 1
+        if step_id is None:
+            step_id = self._step_counter
+            self._step_counter += 1
+        # remembered for reconnect-resume: a retransmit after a resumed
+        # stream must reuse this exact id (the server's at-most-once dedup
+        # keys on it) and the same prefix_skip (same suffix bytes)
+        self._last_sent = (step_id, prefix_skip)
         meta_base = {
             "step": step_id,
             "commit": commit,
@@ -851,14 +919,17 @@ class InferenceSession:
         ids = np.asarray(ids).reshape(-1).astype(np.int32)
         attempt = 0
         overload_waits = 0
+        resume_step = None  # step_id to retransmit after a resume
         while True:
             try:
                 if self._needs_rebuild:
                     await self._recover()
                     self._needs_rebuild = False
                     self._check_decode_n_route()
+                step_id, resume_step = resume_step, None
                 toks = await self._decode_n_once(
-                    ids, n, eos_token_id, finished, head_dtype
+                    ids, n, eos_token_id, finished, head_dtype,
+                    step_id=step_id,
                 )
             except OverloadedError as e:
                 # retriable shed (see step()): separate budget, honor the
@@ -885,6 +956,21 @@ class InferenceSession:
                 attempt += 1
                 if attempt > self.max_retries:
                     raise
+                if self.resume and self._last_sent is not None:
+                    # cheap path first (see step()): re-attach the parked
+                    # sessions and retransmit the chunk under its original
+                    # id; a coordinator that already finished it replies
+                    # the recorded [B, n] tokens (at-most-once). A chunk
+                    # that died mid-commit leaves the server kv_dirty, so
+                    # its park is refused and this decline is immediate.
+                    last = self._last_sent
+                    if await self._try_resume():
+                        resume_step = last[0]
+                        logger.info(
+                            "decode_n failed (%s); resumed session, "
+                            "retransmitting step %d", e, last[0],
+                        )
+                        continue
                 logger.warning(
                     "decode_n failed (%s); re-routing (attempt %d)",
                     e, attempt,
@@ -927,12 +1013,17 @@ class InferenceSession:
             )
 
     async def _decode_n_once(
-        self, ids, n, eos_token_id, finished, head_dtype=None
+        self, ids, n, eos_token_id, finished, head_dtype=None, step_id=None
     ) -> np.ndarray:
         if not self._spans:
             raise RpcError("session chain is closed (recovery pending)")
-        step_id = self._step_counter
-        self._step_counter += 1
+        if step_id is None:
+            step_id = self._step_counter
+            self._step_counter += 1
+        # remembered for reconnect-resume: a retransmit after a resumed
+        # stream must reuse this exact id so a coordinator that already
+        # finished the chunk answers from its recorded reply
+        self._last_sent = (step_id, None)
         meta = {
             "step": step_id,
             "decode_n": int(n),
@@ -1071,6 +1162,70 @@ class InferenceSession:
             self._id_rows[i].extend(int(t) for t in row)
 
     # -------------------------------------------------------------- recovery
+    async def _try_resume(self) -> bool:
+        """Cheap half of recovery: reopen each span with `resume:
+        session_id` so the server re-attaches our lease-parked session to
+        the fresh stream — KV intact, nothing to replay. All-or-nothing
+        across spans: any decline (lease expired, leases off, parked pages
+        evicted, old server) abandons the whole attempt and the caller
+        falls back to the ordinary standby/full-replay path. On success
+        the caller retransmits the failed step under its ORIGINAL id;
+        spans that already applied it answer from their recorded reply
+        (at-most-once), the rest compute it fresh."""
+        if not self.resume or not self._spans:
+            return False
+        old = self._spans
+        fresh: list[_SpanSession] = []
+        ok = True
+        reason = None
+        for s in old:
+            try:
+                conn = await connect(
+                    s.span.server_info.host, s.span.server_info.port,
+                    keepalive_s=self.keepalive_s,
+                )
+                stream = await conn.open_stream(
+                    "rpc_inference",
+                    {
+                        "resume": s.session_id,
+                        # session_id rides along so the wire trace stays
+                        # self-describing; resume-aware servers key off
+                        # "resume" alone
+                        "session_id": s.session_id,
+                        "client_id": self.client_id,
+                    },
+                )
+                fresh.append(_SpanSession(s.span, conn, stream, s.session_id))
+                item = await asyncio.wait_for(
+                    stream.recv(), self.resume_timeout
+                )
+                resp_meta = item[0] if item is not None else {}
+                if not resp_meta.get("resumed"):
+                    ok = False
+                    reason = resp_meta.get("reason", "stream closed")
+                    break
+            except (RpcError, OSError, asyncio.TimeoutError) as e:
+                ok = False
+                reason = str(e) or type(e).__name__
+                break
+        if not ok:
+            self.resume_declines += 1
+            logger.info("session resume declined (%s); falling back to "
+                        "full recovery", reason)
+            for sp in fresh:
+                await sp.close()
+            return False
+        # the dead streams' conns linger half-open on our side too: abort
+        # them so nothing keeps pinging a connection we just superseded
+        for sp in old:
+            try:
+                sp.conn.abort("superseded by resume")
+            except Exception:
+                pass
+        self._spans = fresh
+        self.resumed_streams += len(fresh)
+        return True
+
     async def _recover(self) -> None:
         """Rebuild the entire chain and replay history
         (v1 of reference `_update_sequence`: suffix-only rebuild is an

@@ -251,6 +251,14 @@ class CacheManager:
         self._seq_counter = CacheManager._global_seq_counter
         self._handle_counter = CacheManager._global_handle_counter
         self._parked: dict[int, _Parked] = {}
+        # session-lease parking (wire half-open / client-death domain):
+        # seq_id -> (per-page pool keys, committed length, arena epoch at
+        # park time). Distinct from _parked (host d2h tiering) — the pages
+        # stay device-resident as refcount-0 cached pool entries
+        self._lease_parked: dict[int, tuple[list[str], int, int]] = {}
+        # handles whose token reservation was returned at lease-park time
+        # (allocate()'s exit must not subtract it a second time)
+        self._lease_released: set[int] = set()
         # d2h copies of parked KV run here so parking never stalls the
         # compute thread (the copy engine half of the reference's async
         # offload, mcm.py:972-1335); 2 workers keep host-link order sane
@@ -345,8 +353,17 @@ class CacheManager:
                     self._seq_epoch.pop(sid, None)
                     self._adopted.pop(sid, None)
                     self._live_seqs.discard(sid)
+                    entry = self._lease_parked.pop(sid, None)
+                    if entry is not None and hasattr(
+                        self.table, "purge_parked"
+                    ):
+                        self.table.purge_parked(entry[0])
             async with cond:
-                self._reserved_tokens -= need
+                if handle.handle_id in self._lease_released:
+                    # the reservation already went back at lease-park time
+                    self._lease_released.discard(handle.handle_id)
+                else:
+                    self._reserved_tokens -= need
                 cond.notify_all()
 
     # ----------------------------------------------------------- device plans
@@ -669,6 +686,91 @@ class CacheManager:
             if st.hashes is not None and len(chain) < len(st.hashes):
                 continue
             self.table.set_seq_hashes(sid, chain)
+
+    # ------------------------------------------------------ session leases
+    def _handle_need(self, handle: "CacheHandle") -> int:
+        """The token reservation allocate() charged for this handle
+        (page-granular, same formula)."""
+        per_seq = -(-handle.max_length // self.page_size) * self.page_size
+        return handle.batch_size * per_seq
+
+    async def lease_park(self, handle: "CacheHandle") -> None:
+        """Park a stream-dead session for the lease window.
+
+        Speculative tokens roll back, then every sequence's pages are
+        handed to the prefix pool as refcount-0 *cached* entries (the
+        install_cached trick the replication standbys use): immediately
+        evictable under allocation pressure — a parked session can never
+        OOM the server — yet device-resident for an exact zero-recompute
+        resume while memory lasts. Tables without a prefix pool fall back
+        to host-tier parking (same resume contract, a d2h/h2d copy more).
+        The session's token reservation is returned to the admission
+        budget for the duration of the park."""
+        with self._lock:
+            for sid in handle.seq_ids:
+                if sid in self._parked or not self.table.has_seq(sid):
+                    continue  # already host-parked: the copy survives as-is
+                if sid in self._lease_parked:
+                    continue
+                self.table.rollback(sid)
+                # an unsettled probe adoption parks as plain committed
+                # pages (their hashes are real — resume re-pins them)
+                self._adopted.pop(sid, None)
+                if hasattr(self.table, "park_seq_cached"):
+                    keys, l_acc = self.table.park_seq_cached(sid)
+                    self._lease_parked[sid] = (keys, l_acc, self.arena_epoch)
+                elif self.table.seq(sid).l_seq > 0:
+                    self.park_sequence(sid)
+            self._lease_released.add(handle.handle_id)
+        cond = self._condition()
+        async with cond:
+            self._reserved_tokens -= self._handle_need(handle)
+            cond.notify_all()
+
+    async def lease_resume(self, handle: "CacheHandle") -> bool:
+        """Re-pin a lease-parked session on reconnect. All-or-nothing:
+        True means every sequence is back exactly as parked (same pages,
+        same committed lengths — zero recompute); False means at least one
+        page was evicted (or the arena rebuilt) and the caller must treat
+        the session as lost (full-replay fallback, then reclaim)."""
+        cond = self._condition()
+        async with cond:
+            if handle.handle_id in self._lease_released:
+                # re-acquire the reservation. This may transiently push
+                # reserved past admit_limit — acceptable: the pages backing
+                # the resume were evictable all along, so this cannot OOM,
+                # and admission pressure re-equalizes as sessions close
+                self._reserved_tokens += self._handle_need(handle)
+                self._lease_released.discard(handle.handle_id)
+        with self._lock:
+            if not self.epoch_valid(handle):
+                return False
+            for sid in handle.seq_ids:
+                entry = self._lease_parked.get(sid)
+                if entry is None:
+                    continue  # host-parked fallback: next step unparks it
+                keys, l_acc, epoch = entry
+                if epoch != self.arena_epoch:
+                    return False
+                if not self.table.unpark_seq_cached(sid, keys, l_acc):
+                    return False
+                del self._lease_parked[sid]
+            return True
+
+    @_locked
+    def lease_reclaim(self, handle: "CacheHandle") -> None:
+        """Final reclaim of a reaped (or unresumable) session: purge its
+        synthetic park entries so those pages return to the free list now
+        instead of lingering as unreachable cached entries. Real-hash
+        pages stay pooled — they still serve the prefix cache. The rest of
+        the teardown (drop_seq, reservation) happens at allocate() exit."""
+        for sid in handle.seq_ids:
+            entry = self._lease_parked.pop(sid, None)
+            if entry is not None and hasattr(self.table, "purge_parked"):
+                self.table.purge_parked(entry[0])
+
+    def has_lease_parked(self, handle: "CacheHandle") -> bool:
+        return any(sid in self._lease_parked for sid in handle.seq_ids)
 
     # ------------------------------------------------------- host tiering
     @_locked

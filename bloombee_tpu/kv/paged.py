@@ -430,6 +430,82 @@ class PagedKVTable:
         state.l_seq = keep_tokens
         self._trim(state)
 
+    # ------------------------------------------------------- session parking
+    def park_seq_cached(self, seq_id: int) -> tuple[list[str], int]:
+        """Hand every page of `seq_id` to the pool as refcount-0 cached
+        entries (session-lease park: wire/lease layer, not host d2h).
+
+        Pages whose content is already pool-published keep their real hash;
+        the rest get a synthetic "~parked:" identity so they too land in
+        the cached LRU — immediately evictable under allocation pressure
+        (a parked session can never OOM the server) yet resident for a
+        cheap exact resume while memory lasts. Returns (per-page keys,
+        committed length) — everything `unpark_seq_cached` needs."""
+        state = self._seqs[seq_id]
+        keys: list[str] = []
+        l_acc = state.l_acc
+        for i, page in enumerate(state.pages):
+            h = self._page_hash.get(page)
+            if h is None:
+                h = f"~parked:{seq_id}:{i}:{page}"
+                self._pool[h] = page
+                self._page_hash[page] = h
+            keys.append(h)
+            self._release_page(page)
+        state.pages = []
+        state.l_acc = 0
+        state.l_seq = 0
+        state.published = 0
+        state.hashes = None
+        return keys, l_acc
+
+    def unpark_seq_cached(
+        self, seq_id: int, keys: list[str], l_acc: int
+    ) -> bool:
+        """Re-pin a cached-parked sequence: all-or-nothing. If any page was
+        evicted (or the pool invalidated by an arena rebuild) the resume is
+        impossible and the caller falls back to full replay. On success the
+        sequence is exactly as it was at park time — same pages, same
+        committed length, zero recompute."""
+        state = self._seqs[seq_id]
+        if state.pages or state.l_seq or state.l_acc:
+            raise ValueError("unpark_seq_cached target must be empty")
+        pages: list[int] = []
+        for h in keys:
+            page = self._pool.get(h)
+            if page is None:
+                return False  # evicted — nothing pinned yet, nothing leaks
+            pages.append(page)
+        for h, page in zip(keys, pages):
+            self._ref[page] += 1
+            self._lru.pop(page, None)
+            if h.startswith("~parked:"):
+                # the synthetic identity served its purpose; a private page
+                # must not stay adoptable under a hash nobody can match
+                self._unpublish(page)
+        state.pages = pages
+        state.l_acc = l_acc
+        state.l_seq = l_acc
+        return True
+
+    def purge_parked(self, keys: list[str]) -> int:
+        """Final reclaim of a reaped session's synthetic park entries:
+        still-cached "~parked:" pages drop straight to the free list (their
+        content is unreachable — no prefix chain ever hashes to them).
+        Real-hash pages stay cached; they remain useful to the prefix
+        cache. Returns pages freed."""
+        freed = 0
+        for h in keys:
+            if not h.startswith("~parked:"):
+                continue
+            page = self._pool.get(h)
+            if page is not None and self._ref[page] == 0:
+                self._lru.pop(page, None)
+                self._unpublish(page)
+                self._free.append(page)
+                freed += 1
+        return freed
+
     def take_pending_copies(self) -> list[tuple[int, int]]:
         """Drain queued copy-on-write (src_page, dst_page) pairs; the
         caller applies the device copies before the write that triggered

@@ -105,6 +105,16 @@ class NativePagedKVTable:
     def free_tokens(self) -> int:
         return self.free_pages * self.page_size
 
+    def counts(self) -> dict:
+        """Page census, kv/paged.PagedKVTable.counts() shape. The native
+        table has no prefix pool, so cached is always 0."""
+        free = self.free_pages
+        return {
+            "free": free,
+            "referenced": self.num_pages - free,
+            "cached": 0,
+        }
+
     def has_seq(self, seq_id: int) -> bool:
         return bool(_check(self._lib.pt_has_seq(self._h, seq_id), "has_seq"))
 

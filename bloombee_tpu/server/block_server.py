@@ -48,7 +48,9 @@ from bloombee_tpu.utils import env
 from bloombee_tpu.wire.flow import FlowLimiter
 from bloombee_tpu.wire.rpc import (
     Connection,
+    ConnectionClosed,
     OverloadedError,
+    RpcError,
     RpcServer,
     Stream,
     connect,
@@ -102,6 +104,18 @@ env.declare(
     "the effective announce period becomes min(announce_period, this), so "
     "load telemetry can be fresher than liveness announces (0 = piggyback "
     "on every regular announce only)",
+)
+env.declare(
+    "BBTPU_SESSION_LEASE_S", float, 0.0,
+    "session lease: a session whose client stream died (or went silent past "
+    "this long with keepalives off) is PARKED — its KV pages are handed to "
+    "the prefix pool as evictable refcount-0 cached entries, so a wedged or "
+    "partitioned client can never pin memory — and stays resumable "
+    "(resume: session_id on a fresh stream) for one more lease period "
+    "before final reclaim. 0 disables leases: a dead stream frees the "
+    "session immediately (seed behavior). Pair with BBTPU_KEEPALIVE_S so "
+    "half-open streams are detected promptly; a lease alone only fences a "
+    "session after a full silent lease period",
 )
 
 
@@ -169,6 +183,29 @@ class _Session:
         self.repl_chains: list[list[str]] | None = None
         self.repl_sent: list[int] | None = None
         self.repl_lock = asyncio.Lock()
+        # session lease / reconnect-resume state. The stream-opening RPC
+        # handler OWNS the KV pages (allocate context) and survives stream
+        # death: it parks, then waits on resume_waiter for either a resume
+        # handler (which hands over its fresh stream) or the lease reaper.
+        self.parked = False
+        self.reaped = False  # lease expired / resume impossible
+        self.lease_deadline = 0.0  # monotonic; meaningful while parked
+        self.cur_stream = None  # stream the session loop is serving now
+        self.resume_waiter: asyncio.Event | None = None
+        self.resume_stream = None  # set by the resume handler before wake
+        self.detach_event: asyncio.Event | None = None  # releases the
+        # resume handler whose stream the session loop currently serves
+        # fencing: bumped per adopted stream so anything captured against
+        # an older stream can be recognized as stale
+        self.stream_epoch = 0
+        # at-most-once step application: replies are recorded (keyed
+        # (step, mb)) BEFORE first delivery, so a step retried after a
+        # lost ack resends the recorded reply instead of re-applying KV
+        self.last_step_id = -1
+        self.applied_steps: dict[tuple[int, int], tuple[dict, list]] = {}
+        # a stepped decode_n chain died after committing KV the client was
+        # never told about: resuming would desync — force full replay
+        self.kv_dirty = False
 
 
 class _PeerPool:
@@ -283,6 +320,16 @@ class BlockServer:
         # min(announce_period, load_advert_s) so load telemetry can be
         # fresher than liveness announces (None -> BBTPU_LOAD_ADVERT_S
         # env; 0 = every announce_period)
+        session_lease_s: float | None = None,  # session lifecycle
+        # hardening: a session whose stream died is PARKED (pages become
+        # evictable cached pool entries) and resumable for this long
+        # before final reclaim; also the silence bound past which the
+        # reaper fences a live-but-wedged client (None ->
+        # BBTPU_SESSION_LEASE_S env; 0 disables)
+        keepalive_s: float | None = None,  # wire keepalive interval for
+        # accepted connections so half-open clients (partition, no
+        # FIN/RST) are detected instead of hanging recv() forever
+        # (None -> BBTPU_KEEPALIVE_S env; 0 disables)
     ):
         self.model_dir = model_dir
         if weight_quant is None:
@@ -530,6 +577,20 @@ class BlockServer:
         self._repl_sem = asyncio.Semaphore(
             max(1, env.get("BBTPU_REPL_INFLIGHT"))
         )
+        # session lifecycle hardening (leases + reconnect-resume): parked
+        # sessions reclaimed by the lease reaper, parked sessions
+        # re-attached by a reconnecting client, retried steps answered
+        # from the recorded reply instead of re-applied, and push items
+        # that teardown would otherwise silently discard
+        self.session_lease_s = (
+            float(env.get("BBTPU_SESSION_LEASE_S"))
+            if session_lease_s is None else float(session_lease_s)
+        )
+        self.sessions_reaped = 0
+        self.sessions_resumed = 0
+        self.steps_deduped = 0
+        self.pushes_dropped = 0
+        self._reaper_task: asyncio.Task | None = None
         self._kv_quant = kv_quant
         self._num_pages = num_pages
         self._adapter_dirs = adapter_dirs
@@ -545,6 +606,7 @@ class BlockServer:
             push_handlers={"rpc_push": self._rpc_push},
             host=host,
             port=port,
+            keepalive_s=keepalive_s,
         )
 
     # ---------------------------------------------------------------- lifecycle
@@ -555,6 +617,8 @@ class BlockServer:
     async def start(self) -> None:
         await self.rpc.start()
         self.compute.start()
+        if self.session_lease_s > 0:
+            self._reaper_task = asyncio.create_task(self._lease_reaper_loop())
         if self.registry is not None:
             await self._announce(ServerState.ONLINE)
             self._announce_task = asyncio.create_task(self._announce_loop())
@@ -627,7 +691,23 @@ class BlockServer:
                     "replication flush outlived the drain window; standbys "
                     "hold a partial backlog"
                 )
+        # parked sessions have no live client to finish: force-expire their
+        # leases NOW so the drain waits only on streams that can still make
+        # progress (a wedged session must never eat the whole drain window)
+        reaped = 0
+        for s in list(self._sessions.values()):
+            if s.parked and not s.reaped:
+                s.reaped = True
+                if s.resume_waiter is not None:
+                    s.resume_waiter.set()
+                reaped += 1
+        if reaped:
+            logger.info(
+                "drain force-expired %d parked session lease(s)", reaped
+            )
         while self._sessions and _time.monotonic() < deadline:
+            # sessions parking DURING the drain are refused (the park path
+            # checks _draining), so only live streams remain to wait on
             await asyncio.sleep(0.1)
         if self._sessions:
             logger.warning(
@@ -638,7 +718,7 @@ class BlockServer:
 
     async def stop(self) -> None:
         for task in (self._supervisor_task, self._warmup_task,
-                     self._throughput_task):
+                     self._throughput_task, self._reaper_task):
             if task is not None:
                 task.cancel()
         if self._announce_task is not None:
@@ -925,6 +1005,11 @@ class BlockServer:
             "chunk_streams": self._chunking_sessions,
             "pages_free": int(pages_free) if pages_free is not None else None,
             "active_sessions": len(self._sessions),
+            # parked sessions hold no pinned pages (their KV sits in the
+            # pool as evictable cached entries) — routers can discount them
+            "parked_sessions": sum(
+                1 for s in self._sessions.values() if s.parked
+            ),
             "shedding": bool(
                 self.admission is not None
                 and delay_ms >= self.admission.high_ms
@@ -1059,6 +1144,18 @@ class BlockServer:
             # drain flag (also visible as state=DRAINING in server_info)
             "deadlines_expired": self.deadlines_expired,
             "draining": self._draining,
+            # session lifecycle observability (leases/keepalives/resume):
+            # leases reaped, parked sessions re-attached, retried steps
+            # answered from the recorded reply, keepalive pings sent on
+            # accepted conns, pushed items rescued at loop teardown, and
+            # the live session age/idle/parked gauges
+            "sessions_reaped": self.sessions_reaped,
+            "sessions_resumed": self.sessions_resumed,
+            "steps_deduped": self.steps_deduped,
+            "keepalives_sent": self.rpc.keepalives_sent,
+            "pushes_dropped": self.pushes_dropped,
+            "session_lease_s": self.session_lease_s,
+            **self._session_ages(),
             # continuous-batching observability: how often concurrent
             # sessions' decode steps shared one span dispatch, and how long
             # steps sat in the compute queue (ms percentiles)
@@ -1298,6 +1395,12 @@ class BlockServer:
             # client racing a stale swarm view can still arrive — refuse
             # before allocating KV it could never finish using
             raise RuntimeError("server is draining; open a session elsewhere")
+        if meta.get("resume") is not None:
+            # reconnect-resume: re-attach a parked session instead of
+            # allocating anything — this handler only hands its fresh
+            # stream to the surviving page-owning handler
+            await self._rpc_resume(stream, str(meta["resume"]))
+            return
         session_id = meta["session_id"]
         batch = int(meta["batch_size"])
         max_length = int(meta["max_length"])
@@ -1335,10 +1438,45 @@ class BlockServer:
             session.last_step_at = session.opened_at
             self._sessions[session_id] = session
             self._drain_pending_pushes(session)
+            cur_stream = stream
             try:
-                await self._session_loop(session, stream)
+                while True:
+                    session.cur_stream = cur_stream
+                    try:
+                        await self._session_loop(session, cur_stream)
+                        break  # client half-closed: done
+                    except (ConnectionClosed, OSError, RpcError) as e:
+                        # the stream died under the session. With leases on
+                        # (and KV not run ahead of the client's history),
+                        # park and wait for a reconnect instead of freeing
+                        if (
+                            self.session_lease_s <= 0
+                            or self._draining
+                            or session.kv_dirty
+                        ):
+                            raise
+                        cur_stream = await self._park_until_resumed(
+                            session, e
+                        )
+                        if cur_stream is None:
+                            break  # lease expired; pages reclaimed below
             finally:
                 self._sessions.pop(session_id, None)
+                session.parked = False
+                # release the resume handler carrying the current stream
+                # (it returns once we are done with its stream)
+                if session.detach_event is not None:
+                    session.detach_event.set()
+                    session.detach_event = None
+                if cur_stream is not stream:
+                    # the session ended on a RESUMED stream: its client is
+                    # live and reading — half-close so it sees end-of-
+                    # stream instead of hanging (the original stream's
+                    # teardown runs in our caller, against a dead conn)
+                    try:
+                        await cur_stream.close()
+                    except Exception:
+                        pass
                 if session.n_steps:
                     wall = _time.monotonic() - session.opened_at
                     logger.info(
@@ -1364,6 +1502,212 @@ class BlockServer:
         if (start, end) == (self.start_block, self.end_block):
             return None
         return (start - self.start_block, end - self.start_block)
+
+    # ------------------------------------------- session leases & resume
+    async def _park_until_resumed(
+        self, session: _Session, cause: Exception
+    ) -> Stream | None:
+        """The session's stream died but its lease keeps it alive: drain
+        in-flight work, hand the KV pages to the prefix pool as evictable
+        cached entries (a parked session can never pin memory — under
+        pressure its pages are simply evicted and the resume degrades to
+        full replay), then sleep until a resume handler delivers a fresh
+        stream or the reaper expires the lease. Returns the new stream, or
+        None once the session is reclaimed."""
+        import time as _time
+
+        # fence the dead stream: nothing may still be writing KV when the
+        # pages change owner (same ordering as _session_loop teardown)
+        if session.step_tasks:
+            await asyncio.gather(*session.step_tasks, return_exceptions=True)
+        if session.detach_event is not None:
+            # the stream that just died was itself a resumed one — let its
+            # carrier handler go
+            session.detach_event.set()
+            session.detach_event = None
+        session.cur_stream = None
+        session.resume_stream = None
+        session.resume_waiter = asyncio.Event()
+        session.lease_deadline = _time.monotonic() + self.session_lease_s
+        session.parked = True
+        await self.manager.lease_park(session.handle)
+        logger.info(
+            "session %s parked after stream death (%s: %s); resumable for "
+            "%.1fs", session.id, type(cause).__name__, cause,
+            self.session_lease_s,
+        )
+        await session.resume_waiter.wait()
+        session.parked = False
+        if session.reaped or session.resume_stream is None:
+            self.manager.lease_reclaim(session.handle)
+            self.sessions_reaped += 1
+            logger.info(
+                "session %s lease expired while parked; KV reclaimed",
+                session.id,
+            )
+            return None
+        stream = session.resume_stream
+        session.resume_stream = None
+        session.lease_deadline = 0.0
+        return stream
+
+    async def _rpc_resume(self, stream: Stream, session_id: str) -> None:
+        """Resume half of reconnect-resume: re-attach a parked session to
+        this fresh stream. On success the PARKED handler (which owns the
+        pages) serves the stream; this handler just holds the stream's RPC
+        frame open until the session lets go of it. Declines (resumed:
+        False) instead of erroring so the client cleanly falls back to the
+        standby/full-replay path."""
+        import time as _time
+
+        session = self._sessions.get(session_id)
+        reason = None
+        if session is None:
+            reason = "unknown session (lease expired or never parked here)"
+        elif session.kv_dirty:
+            reason = "session KV ran ahead of acked history; replay"
+        elif not session.parked:
+            # the old stream looks alive from here (half-open not yet
+            # detected): the client knows better — fence it and wait
+            # briefly for the owner to park
+            old = session.cur_stream
+            if old is not None and old.conn is not stream.conn:
+                old.conn.abort("superseded by session resume")
+            for _ in range(100):
+                if session.parked or session_id not in self._sessions:
+                    break
+                await asyncio.sleep(0.05)
+            if not session.parked:
+                reason = "session is still attached to a live stream"
+        if reason is None and (
+            session.reaped or _time.monotonic() >= session.lease_deadline
+        ):
+            reason = "session lease expired"
+        if reason is None and not await self.manager.lease_resume(
+            session.handle
+        ):
+            # parked pages were evicted under pressure (or the arena was
+            # rebuilt): the copy is gone — expire the lease so the parked
+            # handler reclaims instead of waiting out the clock
+            reason = "parked KV no longer intact; replay"
+            session.reaped = True
+            session.resume_waiter.set()
+        if reason is not None:
+            logger.info(
+                "refusing resume of session %s: %s", session_id, reason
+            )
+            await stream.send({"resumed": False, "reason": reason})
+            return
+        session.stream_epoch += 1
+        detach = asyncio.Event()
+        session.detach_event = detach
+        session.resume_stream = stream
+        self.sessions_resumed += 1
+        logger.info(
+            "session %s resumed on a fresh stream (epoch %d, last applied "
+            "step %d)", session_id, session.stream_epoch,
+            session.last_step_id,
+        )
+        # the ack carries the last APPLIED step id so the client
+        # retransmits exactly its unacked tail (any retransmit of an
+        # applied step dedups server-side anyway — belt and braces)
+        await stream.send(
+            {
+                "resumed": True,
+                "last_step": session.last_step_id,
+                "epoch": session.stream_epoch,
+            }
+        )
+        session.resume_waiter.set()
+        await detach.wait()
+
+    async def _lease_reaper_loop(self) -> None:
+        """Background sweeper: expire parked sessions whose lease ran out,
+        and fence live sessions whose client has been silent past the
+        lease (belt and braces under keepalives; the only detector when
+        keepalives are off). A fenced stream fails into the ordinary park
+        path, so even this late detection hands the pages to the pool
+        rather than freeing them under a client that might still return."""
+        import time as _time
+
+        interval = max(0.05, self.session_lease_s / 4)
+        while True:
+            await asyncio.sleep(interval)
+            now = _time.monotonic()
+            for session in list(self._sessions.values()):
+                if session.parked:
+                    if now >= session.lease_deadline and not session.reaped:
+                        session.reaped = True
+                        if session.resume_waiter is not None:
+                            session.resume_waiter.set()
+                    continue
+                stream = session.cur_stream
+                conn = stream.conn if stream is not None else None
+                # the lease renews on any applied step AND on any inbound
+                # frame (keepalive pongs included): only a truly silent
+                # client expires
+                renewed = max(
+                    session.last_step_at,
+                    conn.last_recv if conn is not None else 0.0,
+                )
+                if conn is not None and now - renewed >= self.session_lease_s:
+                    logger.warning(
+                        "session %s silent for %.1fs (lease %.1fs): "
+                        "fencing its stream", session.id, now - renewed,
+                        self.session_lease_s,
+                    )
+                    conn.abort("session lease expired (silent client)")
+
+    def _session_ages(self) -> dict:
+        """Operator gauges for rpc_info: how old and how idle the live
+        sessions are, and how many sit parked awaiting a resume."""
+        import time as _time
+
+        now = _time.monotonic()
+        ages = [now - s.opened_at for s in self._sessions.values()]
+        idles = [now - s.last_step_at for s in self._sessions.values()]
+        return {
+            "sessions_parked": sum(
+                1 for s in self._sessions.values() if s.parked
+            ),
+            "session_oldest_s": round(max(ages), 3) if ages else 0.0,
+            "session_oldest_idle_s": round(max(idles), 3) if idles else 0.0,
+        }
+
+    def _dedup_step(self, session: _Session, meta: dict):
+        """At-most-once: a step already applied (recorded reply) must not
+        re-apply KV when the client retries it after a lost ack. Returns
+        the recorded (resp_meta, tensors) to resend, or None for fresh
+        work. Only consulted with leases on — without resume there are no
+        retransmits to dedup."""
+        step = meta.get("step")
+        if self.session_lease_s <= 0 or step is None:
+            return None
+        step = int(step)
+        if step < session.last_step_id:
+            # long-superseded retransmit; the recorded replies are gone but
+            # the client has also long since acted on newer steps — ack it
+            return {"step": step, "ack": True, "deduped": True}, []
+        return session.applied_steps.get((step, int(meta.get("mb") or 0)))
+
+    def _record_reply(
+        self, session: _Session, meta: dict, resp: dict, tensors: list
+    ) -> None:
+        """Record a step's reply BEFORE first delivery (the KV mutation is
+        already applied by now): if the ack is lost to a dying stream, the
+        client's post-resume retransmit gets this exact reply back instead
+        of a second application. Only the latest step's replies are kept —
+        the client's window never retries older ones."""
+        step = meta.get("step")
+        if self.session_lease_s <= 0 or step is None:
+            return
+        step = int(step)
+        if step > session.last_step_id:
+            session.last_step_id = step
+            session.applied_steps.clear()
+        session.applied_steps[(step, int(meta.get("mb") or 0))] = (
+            resp, tensors,
+        )
 
     async def _session_loop(self, session: _Session, stream: Stream) -> None:
         """Race client-stream items against pushed items
@@ -1392,7 +1736,24 @@ class BlockServer:
                     push_next = asyncio.ensure_future(session.push_inbox.get())
         finally:
             stream_next.cancel()
-            push_next.cancel()
+            if push_next.done() and not push_next.cancelled():
+                # the race was lost at teardown: push_inbox.get() completed
+                # with an item nobody consumed. Cancelling would silently
+                # drop a pushed micro-batch chunk — requeue it instead so a
+                # parked session's resume (or the pending-push buffer path)
+                # still sees it, and count it for operators
+                try:
+                    session.push_inbox.put_nowait(push_next.result())
+                    self.pushes_dropped += 1  # requeued, but the loop ended
+                    logger.info(
+                        "session %s teardown requeued an unconsumed pushed "
+                        "item (%d total across sessions)", session.id,
+                        self.pushes_dropped,
+                    )
+                except Exception:
+                    pass
+            else:
+                push_next.cancel()
             # drain in-flight chunk tasks BEFORE the allocate context frees
             # the session's pages: a still-running dispatch must not write
             # KV into pages a new session may reuse
@@ -1495,6 +1856,15 @@ class BlockServer:
             # would desync the client's strictly-ordered step stream).
             self._note_kv_repl(session, repl)
             return
+        cached = self._dedup_step(session, meta)
+        if cached is not None:
+            # at-most-once: this step was already applied and its reply
+            # recorded before the stream died — resend the identical reply
+            # instead of mutating KV a second time
+            self.steps_deduped += 1
+            resp, out_t = cached
+            await stream.send({**resp, "deduped": True}, out_t)
+            return
         # client deadline budget: "deadline_s" is RELATIVE remaining time
         # (never an absolute timestamp — clocks differ across machines);
         # convert to a local monotonic cutoff at arrival
@@ -1523,9 +1893,9 @@ class BlockServer:
             # chain-wide skip on its prefill. Pure host-side table work —
             # no reason to wait behind the compute queue.
             matched = self.manager.adopt_prefix(session.handle, probe)
-            await stream.send(
-                {"step": meta.get("step"), "prefix_matched": matched}
-            )
+            resp = {"step": meta.get("step"), "prefix_matched": matched}
+            self._record_reply(session, meta, resp, [])
+            await stream.send(resp)
             return
         # speculative accept from the previous round: compact surviving KV
         # rows onto the committed prefix before this step's compute
@@ -1553,7 +1923,11 @@ class BlockServer:
                     return
                 raise
         if meta.get("accept_only"):
-            await stream.send({"step": meta.get("step"), "ack": True})
+            # the accept above compacted KV: record before delivery so a
+            # retried accept after a lost ack never compacts twice
+            resp = {"step": meta.get("step"), "ack": True}
+            self._record_reply(session, meta, resp, [])
+            await stream.send(resp)
             return
         if meta.get("decode_n"):
             await self._run_decode_n(session, stream, meta, tensors)
@@ -1765,13 +2139,15 @@ class BlockServer:
             async with self.peers.limiter(nxt["host"], nxt["port"]).slot():
                 await conn.push("rpc_push", push_meta, push_tensors)
             # ack our own client stream so it can detect this hop succeeded
-            await stream.send(
-                {"step": meta.get("step"), "ack": True, **timing_meta}
-            )
+            # (recorded AFTER the downstream push: a resume-retried step
+            # must re-push only if the push itself never happened)
+            resp = {"step": meta.get("step"), "ack": True, **timing_meta}
+            self._record_reply(session, meta, resp, [])
+            await stream.send(resp)
         elif reply == "ack":
-            await stream.send(
-                {"step": meta.get("step"), "ack": True, **timing_meta}
-            )
+            resp = {"step": meta.get("step"), "ack": True, **timing_meta}
+            self._record_reply(session, meta, resp, [])
+            await stream.send(resp)
         else:
             resp = {"step": meta.get("step"), **timing_meta}
             for key in ("mb", "rows"):
@@ -1779,6 +2155,9 @@ class BlockServer:
                     resp[key] = meta[key]
             if keep is not None:
                 resp["keep"] = keep.tolist()
+            # record-then-send: the KV commit already happened at dispatch,
+            # so this reply is the step's only at-most-once fence
+            self._record_reply(session, meta, resp, [out])
             await stream.send(resp, [out])
 
     async def _run_decode_n(
@@ -1925,15 +2304,17 @@ class BlockServer:
             self.admission.note_tokens(
                 session.client_id, int(ids.shape[0]) * n
             )
-        await stream.send(
-            {
-                "step": meta.get("step"),
-                "t_compute_ms": t_dispatch_ms + t_fetch_ms,
-                "t_dispatch_ms": t_dispatch_ms,
-                "t_fetch_ms": t_fetch_ms,
-            },
-            [toks],
-        )
+        resp = {
+            "step": meta.get("step"),
+            "t_compute_ms": t_dispatch_ms + t_fetch_ms,
+            "t_dispatch_ms": t_dispatch_ms,
+            "t_fetch_ms": t_fetch_ms,
+        }
+        # the fused loop committed n KV slots per row: record before
+        # delivery so a post-resume retry resends these exact tokens
+        # instead of decoding (and committing) n more
+        self._record_reply(session, meta, resp, [toks])
+        await stream.send(resp, [toks])
 
     async def _run_decode_n_stepped(
         self, session: _Session, stream: Stream, meta: dict, tensors: list,
@@ -2045,9 +2426,16 @@ class BlockServer:
                 toks[:, i] = nxt
                 ids = nxt.astype(np.int64)
         except Exception as e:
+            # committed KV the client was never told about makes a parked
+            # resume unsound (token histories would diverge): if the dirty
+            # decline below cannot be delivered, the park path sees
+            # kv_dirty and falls back to full replay. Delivering it
+            # clears the flag — the client then rebuilds explicitly.
+            session.kv_dirty = committed > 0
             if await self._maybe_reply_session_lost(
                 session, stream, meta, e
             ):
+                session.kv_dirty = False
                 return
             logger.warning(
                 "chained decode_n failed after %d/%d committed steps: %s",
@@ -2067,6 +2455,9 @@ class BlockServer:
                     "transient": not getattr(e, "permanent", False),
                 }
             )
+            # the decline reached the client: it rebuilds-and-replays, so
+            # the ragged KV no longer blocks a later park
+            session.kv_dirty = False
             return
         total_ms = (_time.perf_counter() - t_start) * 1000.0
         session.n_steps += n
@@ -2075,15 +2466,14 @@ class BlockServer:
         session.sum_fetch_ms += max(total_ms - t_dispatch_sum, 0.0)
         if self.admission is not None:
             self.admission.note_tokens(session.client_id, b * n)
-        await stream.send(
-            {
-                "step": meta.get("step"),
-                "t_compute_ms": total_ms,
-                "t_dispatch_ms": t_dispatch_sum,
-                "t_fetch_ms": max(total_ms - t_dispatch_sum, 0.0),
-            },
-            [toks],
-        )
+        resp = {
+            "step": meta.get("step"),
+            "t_compute_ms": total_ms,
+            "t_dispatch_ms": t_dispatch_sum,
+            "t_fetch_ms": max(total_ms - t_dispatch_sum, 0.0),
+        }
+        self._record_reply(session, meta, resp, [toks])
+        await stream.send(resp, [toks])
 
     async def _push_hop(
         self, route: list, chain: dict, step, head_dtype, out,

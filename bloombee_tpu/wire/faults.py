@@ -17,6 +17,11 @@ Actions:
 - ``stall``  — on read: swallow the frame and never deliver it (wedged peer);
   on send: sleep until the connection dies (stalled writer)
 - ``drop``   — on read: silently discard the frame (lost packet)
+- ``partition`` — once triggered, blackhole the connection in BOTH
+  directions forever: every later send is silently discarded before the
+  wire and every later read is swallowed, with no FIN/RST ever delivered.
+  Unlike per-frame ``stall``/``drop`` the connection *stays* dead — the
+  half-open TCP case only keepalives (wire/rpc.py) can detect.
 
 Probabilistic chaos uses the plan's seeded RNG so a failing soak run can be
 reproduced from its seed alone. Env knobs (``BBTPU_CHAOS_*``) build a
@@ -62,6 +67,11 @@ env.declare(
     "BBTPU_CHAOS_STALL_P", float, 0.0,
     "per-frame probability of swallowing a received frame (wedged peer)",
 )
+env.declare(
+    "BBTPU_CHAOS_PARTITION_P", float, 0.0,
+    "per-frame probability of partitioning the connection: a permanent "
+    "both-direction blackhole with no FIN/RST (detected only by keepalives)",
+)
 
 
 class InjectedFault(ConnectionResetError):
@@ -77,7 +87,7 @@ class FaultRule:
     following ``count - 1`` matches (count=0 -> every match from nth on)."""
 
     site: str  # "send" | "read"
-    action: str  # "delay" | "reset" | "close" | "stall" | "drop"
+    action: str  # "delay" | "reset" | "close" | "stall" | "drop" | "partition"
     method: str | None = None  # frame's "m" (rpc method) or "t" (frame type)
     port: int | None = None  # remote peer port (targets one server)
     nth: int = 1
@@ -131,22 +141,34 @@ class FaultPlan:
                 return rule
         return None
 
-    async def on_send(self, conn, header: dict) -> None:
+    async def on_send(self, conn, header: dict) -> str | None:
         """Consulted by Connection._send before the frame hits the wire.
-        May sleep, or raise InjectedFault after aborting the transport."""
+        May sleep, raise InjectedFault after aborting the transport, or
+        return "drop" to silently discard the frame (partition)."""
+        if getattr(conn, "_bbtpu_partitioned", False):
+            return "drop"
         rule = self._pick("send", conn.peer, header)
         if rule is None:
-            return
+            return None
         self.log.append(("send", rule.action, dict(header)))
+        if rule.action == "partition":
+            self._partition(conn)
+            return "drop"
         await self._apply(conn, rule, header)
+        return None
 
     async def on_read(self, conn, header: dict) -> str | None:
         """Consulted by Connection._read_loop after decoding a frame and
         before dispatch. Returns "drop" to swallow the frame."""
+        if getattr(conn, "_bbtpu_partitioned", False):
+            return "drop"
         rule = self._pick("read", conn.peer, header)
         if rule is None:
             return None
         self.log.append(("read", rule.action, dict(header)))
+        if rule.action == "partition":
+            self._partition(conn)
+            return "drop"
         if rule.action == "delay":
             await asyncio.sleep(rule.delay_s)
             return None
@@ -179,6 +201,16 @@ class FaultPlan:
             raise InjectedFault(f"injected connection {rule.action}")
 
     @staticmethod
+    def _partition(conn) -> None:
+        """Mark the connection blackholed: the flag lives on the Connection
+        (not the plan) so one marking silences both directions as observed
+        by BOTH endpoints — our sends never reach the wire's effects and
+        every arriving frame is swallowed before dispatch. No FIN/RST is
+        ever generated; only a keepalive timeout can notice."""
+        logger.info("chaos: partitioning connection to %s", conn.peer)
+        conn._bbtpu_partitioned = True
+
+    @staticmethod
     async def _kill(conn, abort: bool) -> None:
         try:
             if abort:
@@ -209,6 +241,11 @@ class FaultPlan:
         stall_p = env.get("BBTPU_CHAOS_STALL_P")
         if stall_p > 0:
             plan.add(FaultRule(site="read", action="stall", prob=stall_p))
+        partition_p = env.get("BBTPU_CHAOS_PARTITION_P")
+        if partition_p > 0:
+            plan.add(FaultRule(
+                site="send", action="partition", prob=partition_p,
+            ))
         return plan
 
 

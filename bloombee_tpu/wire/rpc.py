@@ -17,11 +17,13 @@ import asyncio
 import itertools
 import logging
 import struct
+import time
 from typing import Awaitable, Callable
 
 import msgpack
 import numpy as np
 
+from bloombee_tpu.utils import env
 from bloombee_tpu.wire import faults
 from bloombee_tpu.wire.tensor_codec import (
     deserialize_tensors,
@@ -31,6 +33,14 @@ from bloombee_tpu.wire.tensor_codec import (
 logger = logging.getLogger(__name__)
 
 MAX_FRAME = 1 << 31  # 2 GiB
+
+env.declare(
+    "BBTPU_KEEPALIVE_S", float, 0.0,
+    "keepalive interval: idle connections exchange ping/pong frames so a "
+    "half-open TCP peer (partition without FIN/RST) is detected instead of "
+    "hanging forever in recv(); a connection silent past ~2.5x the interval "
+    "is declared dead. 0 disables keepalives (seed behavior)",
+)
 
 
 class RpcError(RuntimeError):
@@ -155,6 +165,7 @@ class Connection:
         stream_handlers: dict[str, StreamHandler] | None = None,
         push_handlers: dict[str, PushHandler] | None = None,
         peer: tuple[str, int] | None = None,
+        keepalive_s: float | None = None,
     ):
         self.reader = reader
         self.writer = writer
@@ -173,6 +184,16 @@ class Connection:
         self._reader_task: asyncio.Task | None = None
         self._closed = asyncio.Event()
         self.on_close: Callable[["Connection"], None] | None = None
+        # keepalive state: last_recv only advances on frames that survive
+        # fault injection, so an injected partition looks exactly as silent
+        # as a real half-open peer
+        self.keepalive_s = (
+            env.get("BBTPU_KEEPALIVE_S") if keepalive_s is None
+            else keepalive_s
+        )
+        self.last_recv = time.monotonic()
+        self.keepalives_sent = 0
+        self._keepalive_task: asyncio.Task | None = None
 
     @staticmethod
     def _peername(writer: asyncio.StreamWriter) -> tuple[str, int] | None:
@@ -185,6 +206,8 @@ class Connection:
     # ------------------------------------------------------------------ setup
     def start(self) -> None:
         self._reader_task = asyncio.create_task(self._read_loop())
+        if self.keepalive_s and self.keepalive_s > 0:
+            self._keepalive_task = asyncio.create_task(self._keepalive_loop())
 
     def is_closing(self) -> bool:
         return self._closed.is_set() or self.writer.is_closing()
@@ -193,6 +216,8 @@ class Connection:
         self._closed.set()
         if self._reader_task is not None:
             self._reader_task.cancel()
+        if self._keepalive_task is not None:
+            self._keepalive_task.cancel()
         for t in list(self._tasks):
             t.cancel()
         try:
@@ -209,6 +234,21 @@ class Connection:
         self._pending.clear()
         for s in self._streams.values():
             s._push_inbound(exc)
+
+    def abort(self, reason: str = "connection aborted") -> None:
+        """Fail every pending call/stream locally and kill the transport
+        with no FIN handshake. Used to fence a peer we have decided is gone
+        (keepalive timeout, superseded by a session resume, expired lease):
+        everyone blocked on this connection unwedges NOW instead of
+        whenever TCP notices."""
+        self._fail_all(ConnectionClosed(reason))
+        self._closed.set()
+        try:
+            transport = self.writer.transport
+            if transport is not None:
+                transport.abort()
+        except Exception:
+            pass
         self._streams.clear()
 
     # -------------------------------------------------------------- client API
@@ -276,13 +316,44 @@ class Connection:
     # --------------------------------------------------------------- internals
     async def _send(self, header: dict, blobs: list[bytes]) -> None:
         if self.fault_plan is not None:
-            # may sleep (delayed frame) or raise after killing the
-            # transport (injected reset / mid-stream close / stalled write)
-            await self.fault_plan.on_send(self, header)
+            # may sleep (delayed frame), raise after killing the transport
+            # (injected reset / mid-stream close / stalled write), or ask
+            # for a silent discard (injected partition blackhole)
+            if await self.fault_plan.on_send(self, header) == "drop":
+                return
         frame = _encode_frame(header, blobs)
         async with self._send_lock:
             self.writer.write(frame)
             await self.writer.drain()
+
+    async def _keepalive_loop(self) -> None:
+        """Ping on idle, declare the peer dead when silent too long.
+
+        A half-open connection (peer partitioned without FIN/RST) never
+        errors recv() — this loop is the only thing that unwedges it: after
+        ~2.5 intervals with no inbound frame the transport is aborted and
+        every pending call/stream fails with ConnectionClosed, exactly like
+        a real disconnect (retry paths must not special-case it)."""
+        interval = self.keepalive_s
+        try:
+            while not self._closed.is_set():
+                await asyncio.sleep(interval / 2)
+                idle = time.monotonic() - self.last_recv
+                if idle >= 2.5 * interval:
+                    logger.warning(
+                        "keepalive timeout after %.2fs silence from %s",
+                        idle, self.peer,
+                    )
+                    self.abort("keepalive timeout")
+                    break
+                if idle >= interval / 2:
+                    try:
+                        await self._send({"t": "ping", "id": 0}, [])
+                        self.keepalives_sent += 1
+                    except Exception:
+                        pass  # the read loop will surface the real error
+        except asyncio.CancelledError:
+            pass
 
     async def _read_loop(self) -> None:
         try:
@@ -302,6 +373,7 @@ class Connection:
                     act = await self.fault_plan.on_read(self, header)
                     if act == "drop":
                         continue  # injected stall/loss: frame never arrives
+                self.last_recv = time.monotonic()
                 self._dispatch(header, blobs)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
@@ -311,6 +383,8 @@ class Connection:
             logger.exception("rpc read loop error: %s", e)
         finally:
             self._closed.set()
+            if self._keepalive_task is not None:
+                self._keepalive_task.cancel()
             self._fail_all(ConnectionClosed("peer disconnected"))
             # close our side of the transport too: asyncio.Server.wait_closed
             # blocks until every accepted connection's transport is closed
@@ -367,6 +441,12 @@ class Connection:
             stream = self._streams.get(rid)
             if stream is not None:
                 stream._push_inbound(error_from_meta(header.get("meta", {})))
+        elif t == "ping":
+            # keepalive probe: answer even when we have no keepalive loop of
+            # our own, so a one-sided rollout still detects half-open links
+            self._spawn(self._send_pong())
+        elif t == "pong":
+            pass  # liveness already recorded by the read loop
         else:
             logger.warning("unknown frame type %r", t)
 
@@ -374,6 +454,13 @@ class Connection:
         task = asyncio.create_task(coro)
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
+
+    async def _send_pong(self) -> None:
+        try:
+            if not self.is_closing():
+                await self._send({"t": "pong", "id": 0}, [])
+        except Exception:
+            pass  # a dying transport surfaces through the read loop
 
     async def _handle_unary(self, header: dict, blobs: list[bytes]) -> None:
         rid = header["id"]
@@ -450,14 +537,25 @@ class RpcServer:
         push_handlers: dict[str, PushHandler] | None = None,
         host: str = "0.0.0.0",
         port: int = 0,
+        keepalive_s: float | None = None,
     ):
         self.unary_handlers = unary_handlers or {}
         self.stream_handlers = stream_handlers or {}
         self.push_handlers = push_handlers or {}
         self.host = host
         self.port = port
+        self.keepalive_s = keepalive_s
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[Connection] = set()
+        # cumulative pings from already-closed connections; live ones are
+        # summed on demand (keepalives_sent property)
+        self._keepalives_closed = 0
+
+    @property
+    def keepalives_sent(self) -> int:
+        return self._keepalives_closed + sum(
+            c.keepalives_sent for c in self._conns
+        )
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -471,10 +569,16 @@ class RpcServer:
         conn = Connection(
             reader, writer,
             self.unary_handlers, self.stream_handlers, self.push_handlers,
+            keepalive_s=self.keepalive_s,
         )
-        conn.on_close = self._conns.discard
+        conn.on_close = self._on_conn_close
         self._conns.add(conn)
         conn.start()
+
+    def _on_conn_close(self, conn: Connection) -> None:
+        if conn in self._conns:
+            self._keepalives_closed += conn.keepalives_sent
+        self._conns.discard(conn)
 
     async def stop(self) -> None:
         for c in list(self._conns):
@@ -490,11 +594,12 @@ async def connect(
     unary_handlers: dict[str, UnaryHandler] | None = None,
     stream_handlers: dict[str, StreamHandler] | None = None,
     push_handlers: dict[str, PushHandler] | None = None,
+    keepalive_s: float | None = None,
 ) -> Connection:
     reader, writer = await asyncio.open_connection(host, port)
     conn = Connection(
         reader, writer, unary_handlers, stream_handlers, push_handlers,
-        peer=(host, port),
+        peer=(host, port), keepalive_s=keepalive_s,
     )
     conn.start()
     return conn

@@ -1,13 +1,17 @@
 #!/usr/bin/env bash
 # Chaos gate: replay the chaos-marked suite under a fixed seed matrix of
 # ambient wire faults (the BBTPU_CHAOS_* env plan). Each entry is
-# "SEED:DELAY_P:ADMIT" — mild delay-only ambient chaos, so the per-test
-# seeded FaultPlans stay the dominant fault source while connections
-# opened before a test installs its plan still see injected jitter; the
-# ADMIT flag additionally turns on server admission control
+# "SEED:DELAY_P:ADMIT:PARTITION_P" — mild delay-only ambient chaos, so
+# the per-test seeded FaultPlans stay the dominant fault source while
+# connections opened before a test installs its plan still see injected
+# jitter; the ADMIT flag additionally turns on server admission control
 # (BBTPU_ADMIT, low high-watermark) so the overload scenario exercises
-# shed-and-reroute recovery paths under the same ambient jitter. Fixed
-# seeds keep every run replayable bit-for-bit (wire/faults.py contract).
+# shed-and-reroute recovery paths under the same ambient jitter; a
+# nonzero PARTITION_P silently blackholes connections mid-flight (no
+# FIN/RST), so keepalive half-open detection plus lease park/resume are
+# what keep the suite green (keepalive is forced small for that entry).
+# Fixed seeds keep every run replayable bit-for-bit (wire/faults.py
+# contract).
 # Exits 0 when pytest is unavailable (mirrors scripts/lint.sh).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -17,15 +21,26 @@ if ! python -c "import pytest" >/dev/null 2>&1; then
     exit 0
 fi
 
-MATRIX=("11:0.05:0" "23:0.1:0" "31:0.05:1")
+MATRIX=("11:0.05:0:0" "23:0.1:0:0" "31:0.05:1:0" "43:0.02:0:0.02")
 for entry in "${MATRIX[@]}"; do
-    IFS=: read -r seed delay_p admit <<<"${entry}"
-    echo "chaos: seed=${seed} delay_p=${delay_p} admit=${admit}" >&2
+    IFS=: read -r seed delay_p admit partition_p <<<"${entry}"
+    partition_p="${partition_p:-0}"
+    # partitioned conns go silent instead of erroring: a small keepalive
+    # turns the blackhole into a prompt local abort so lease park/resume
+    # (not a step_timeout expiry) is the recovery path under test
+    keepalive_s=0
+    if [ "${partition_p}" != "0" ]; then
+        keepalive_s=0.5
+    fi
+    echo "chaos: seed=${seed} delay_p=${delay_p} admit=${admit}" \
+         "partition_p=${partition_p}" >&2
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     BBTPU_CHAOS=1 \
     BBTPU_CHAOS_SEED="${seed}" \
     BBTPU_CHAOS_DELAY_P="${delay_p}" \
     BBTPU_CHAOS_DELAY_S=0.02 \
+    BBTPU_CHAOS_PARTITION_P="${partition_p}" \
+    BBTPU_KEEPALIVE_S="${keepalive_s}" \
     BBTPU_ADMIT="${admit}" \
     BBTPU_ADMIT_HIGH_MS=400 \
     python -m pytest tests/ -q -m chaos \

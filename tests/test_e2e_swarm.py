@@ -249,6 +249,63 @@ def test_failover_rereoute_and_replay(tiny_model_dir):
     asyncio.run(run())
 
 
+def test_abandoned_client_does_not_leak_pages(tiny_model_dir):
+    """Session-leak gate: a client that vanishes mid-generation without
+    closing (no FIN — its conns just go silent) must not pin KV pages
+    forever. With leases + keepalives on, pages_free returns to the
+    pre-session level within roughly one lease period."""
+    model_dir, _, config = tiny_model_dir
+
+    async def run():
+        from bloombee_tpu.wire.faults import FaultPlan
+
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s1 = _server(
+            model_dir, rc(), 0, 3, session_lease_s=1.0, keepalive_s=0.2,
+        )
+        await s1.start()
+        free0 = s1.manager.table.free_pages
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", use_push=False
+        )
+        input_ids = np.arange(8)[None, :] % config.vocab_size
+        session = model.inference_session(24, 1)
+        await session.__aenter__()
+        await model.generate(input_ids, max_new_tokens=3, session=session)
+        assert s1.manager.table.free_pages < free0
+        # the client is abandoned: blackhole its conns (a conn consults
+        # the fault plan it captured at creation, so arm them directly)
+        # and never call __aexit__
+        for sp in session._spans:
+            sp.conn.fault_plan = FaultPlan()
+            sp.conn._bbtpu_partitioned = True
+
+        deadline = asyncio.get_event_loop().time() + 6.0
+        while asyncio.get_event_loop().time() < deadline:
+            if (
+                s1.manager.table.free_pages >= free0
+                and not s1._sessions
+            ):
+                break
+            await asyncio.sleep(0.1)
+        assert s1.manager.table.free_pages >= free0, (
+            s1.manager.table.free_pages, free0,
+        )
+        assert not s1._sessions
+        assert s1.sessions_reaped == 1
+
+        await s1.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
 def test_feature_combo_int4_microbatch_push(tiny_model_dir):
     """Cross-feature interaction: int4 KV arena + within-stage micro-batching
     + push-mode pipelining in one 2-server chain — generation stays coherent

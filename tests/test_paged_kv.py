@@ -295,6 +295,123 @@ def test_prefix_cache_fuzz_page_accounting():
         }, (trial, c)
 
 
+def test_lease_park_fuzz_page_accounting():
+    """Randomized park/unpark/purge/evict sequences — the session-lease
+    cached-park lifecycle. After EVERY op free + referenced + cached ==
+    num_pages (a double-free or a leaked page breaks the sum), a failed
+    unpark pins nothing, purging twice frees nothing twice, and final
+    teardown returns every page exactly once."""
+    from bloombee_tpu.kv.prefix import page_hash_chain
+
+    rng = np.random.default_rng(7)
+    for trial in range(15):
+        num_pages = int(rng.integers(6, 16))
+        page_size = int(rng.integers(2, 5))
+        table = PagedKVTable(num_pages, page_size)
+        live: list[int] = []
+        parked: dict[int, tuple[list[str], int]] = {}
+        next_sid = 0
+
+        def check(op, table=table, live=live, parked=parked,
+                  num_pages=num_pages, trial=trial):
+            c = table.counts()
+            assert (
+                c["free"] + c["referenced"] + c["cached"] == num_pages
+            ), (trial, op, c)
+            for s in live:
+                for p in table.seq(s).pages:
+                    assert table._ref[p] > 0, (trial, op, p)
+            # a parked sequence pins nothing: its pages are all pool-side
+            for s in parked:
+                assert not table.seq(s).pages, (trial, op, s)
+
+        for _ in range(300):
+            op = str(rng.choice(
+                ["add", "write", "write", "park", "unpark", "purge",
+                 "pressure", "drop"]
+            ))
+            if op == "add" or not (live or parked):
+                table.add_seq(next_sid)
+                if rng.integers(0, 2):
+                    prompt = rng.integers(
+                        0, 50, size=int(rng.integers(1, 4)) * page_size
+                    ).tolist()
+                    table.set_seq_hashes(
+                        next_sid, page_hash_chain(prompt, page_size)
+                    )
+                live.append(next_sid)
+                next_sid += 1
+            elif op == "write" and live:
+                sid = int(rng.choice(live))
+                n = int(rng.integers(1, 2 * page_size))
+                try:
+                    table.assign_write_slots(
+                        sid, n, commit=bool(rng.integers(0, 2))
+                    )
+                except (OutOfPages, ValueError):
+                    pass
+            elif op == "park" and live:
+                sid = int(rng.choice(live))
+                # the lease layer rolls speculative tokens back first
+                table.rollback(sid)
+                parked[sid] = table.park_seq_cached(sid)
+                live.remove(sid)
+            elif op == "unpark" and parked:
+                sid = int(rng.choice(list(parked)))
+                keys, l_acc = parked[sid]
+                before = table.counts()
+                if table.unpark_seq_cached(sid, keys, l_acc):
+                    del parked[sid]
+                    live.append(sid)
+                    assert table.seq(sid).l_acc == l_acc, (trial, sid)
+                else:
+                    # all-or-nothing: a failed resume pinned NOTHING
+                    assert table.counts() == before, (trial, sid)
+                    table.purge_parked(keys)
+                    table.drop_seq(sid)
+                    del parked[sid]
+            elif op == "purge" and parked:
+                # lease reap: synthetic entries free exactly once, and a
+                # second purge of the same keys is a no-op
+                sid = int(rng.choice(list(parked)))
+                keys, _ = parked.pop(sid)
+                table.purge_parked(keys)
+                assert table.purge_parked(keys) == 0, (trial, sid)
+                table.drop_seq(sid)
+            elif op == "pressure":
+                # allocation pressure: a large write may evict parked
+                # (cached, refcount-0) pages — they must never OOM the
+                # table while genuinely-free pages could satisfy a write
+                table.add_seq(next_sid)
+                try:
+                    table.assign_write_slots(
+                        next_sid,
+                        int(rng.integers(1, num_pages + 1)) * page_size,
+                        commit=True,
+                    )
+                except OutOfPages:
+                    pass
+                table.drop_seq(next_sid)
+                next_sid += 1
+            elif op == "drop" and live:
+                sid = int(rng.choice(live))
+                table.drop_seq(sid)
+                live.remove(sid)
+            check(op)
+
+        # teardown: reap the parked, drop the live — nothing may leak
+        for sid in list(live):
+            table.drop_seq(sid)
+        for sid, (keys, _) in list(parked.items()):
+            table.purge_parked(keys)
+            table.drop_seq(sid)
+        assert table.counts()["referenced"] == 0, (trial, table.counts())
+        table.invalidate_pool()
+        assert table.counts() == {
+            "free": num_pages, "referenced": 0, "cached": 0,
+        }, (trial, table.counts())
+
+
 def test_prefix_adopt_cow_and_eviction():
     """Directed coverage of the sharing lifecycle: publish -> adopt
     (refcount pin) -> copy-on-write on divergence -> LRU eviction under
