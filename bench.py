@@ -182,6 +182,39 @@ def _emit_json_locked():
         out["interference_decode_steps_interleaved"] = int(
             ch.get("decode_steps_interleaved", 0)
         )
+        # mixed-batch dispatch: decodes fused INTO the prefill chunk's
+        # device step — fewer dispatches per generated token than the
+        # interleaved-but-separate chunked schedule
+        mx = itf.get("mixed") or {}
+        out["dispatches_per_token"] = round(
+            ch.get("dispatches_per_token", 0.0), 4
+        )
+        out["dispatches_per_token_mixed"] = round(
+            mx.get("dispatches_per_token", 0.0), 4
+        )
+        out["dispatches_per_token_reduction"] = round(
+            itf.get("dispatches_per_token_reduction", 0.0), 2
+        )
+        out["mixed_dispatches"] = int(mx.get("mixed_dispatches", 0))
+        out["mixed_batch_mean_width"] = round(
+            mx.get("mixed_tokens", 0)
+            / max(mx.get("mixed_dispatches", 0), 1),
+            2,
+        )
+        out["tbt_p95_mixed_ms"] = round(mx.get("tbt_p95_ms", 0.0), 1)
+    msb = RESULTS.get("multisession_batched")
+    if msb:
+        # continuous batching: aggregate throughput + how wide the merged
+        # decode dispatches actually ran, and the dispatch amortization
+        out["batched_agg_equiv_tok_per_s"] = round(
+            msb.get("agg_equiv_tok_per_s", 0.0), 1
+        )
+        out["batched_mean_width"] = round(
+            msb.get("mean_batch_width", 0.0), 2
+        )
+        out["batched_dispatches_per_token"] = round(
+            msb.get("dispatches_per_token", 0.0), 4
+        )
     ovl = RESULTS.get("overload")
     if ovl:
         # overload protection: with admission + load-aware routing ON the
@@ -940,7 +973,7 @@ def run_interference(spec, params, smoke: bool) -> None:
     PROMPT = 2 * PAGE  # the decoders' own short prompts
     VOCAB_EFF = min(1024, spec.vocab_size)
 
-    async def one_mode(chunk: int) -> dict:
+    async def one_mode(chunk: int, mixed: bool = False) -> dict:
         reg = RegistryServer(host="127.0.0.1")
         await reg.start()
 
@@ -951,7 +984,7 @@ def run_interference(spec, params, smoke: bool) -> None:
             model_uid="bench_itf", start=0, end=span_layers, params=params,
             spec=spec, registry=rc(),
             num_pages=max(256, 2 * (LONG // PAGE) + 64), page_size=PAGE,
-            max_batch=N_DEC, prefill_chunk=chunk,
+            max_batch=N_DEC, prefill_chunk=chunk, mixed_batch=mixed,
         )
         await server.start()
         manager = RemoteSequenceManager(rc(), "bench_itf", span_layers)
@@ -1028,6 +1061,11 @@ def run_interference(spec, params, smoke: bool) -> None:
                 "prefill_chunks": server.prefill_chunks,
                 "decode_steps_interleaved": server.decode_steps_interleaved,
                 "decode_wait_p95_ms": waits["decode"]["p95"],
+                "dispatches_per_token": (
+                    server.step_dispatches / max(server.step_tokens, 1)
+                ),
+                "mixed_dispatches": server.mixed_dispatches,
+                "mixed_tokens": server.mixed_tokens,
             }
         finally:
             for s in decs:
@@ -1043,13 +1081,22 @@ def run_interference(spec, params, smoke: bool) -> None:
 
     chunked = asyncio.run(one_mode(CHUNK))
     mono = asyncio.run(one_mode(0))
+    # third mode: chunked prefill + mixed-batch dispatch (ISSUE 8) — the
+    # waiting decode steps ride inside the prefill chunk's dispatch, so
+    # dispatches_per_token drops below the interleaved-but-separate value
+    mixed = asyncio.run(one_mode(CHUNK, mixed=True))
     RESULTS["interference"] = {
         "chunked": chunked,
         "monolithic": mono,
+        "mixed": mixed,
         "chunk": CHUNK,
         "long_tokens": LONG,
         "tbt_p95_speedup": (
             mono["tbt_p95_ms"] / max(chunked["tbt_p95_ms"], 1e-9)
+        ),
+        "dispatches_per_token_reduction": (
+            chunked["dispatches_per_token"]
+            / max(mixed["dispatches_per_token"], 1e-9)
         ),
     }
     phase("interference", "ok")
@@ -1062,6 +1109,14 @@ def run_interference(spec, params, smoke: bool) -> None:
         f"p50 {mono['tbt_p50_ms']:.1f} / p95 {mono['tbt_p95_ms']:.1f} ms "
         f"over {mono['decode_steps']} steps; chunked prefill ttft "
         f"{chunked['ttft_ms']:.0f} ms vs {mono['ttft_ms']:.0f} ms"
+    )
+    log(
+        f"mixed-batch dispatch: {mixed['dispatches_per_token']:.4f} "
+        f"dispatches/token ({mixed['mixed_dispatches']} fused dispatches, "
+        f"{mixed['mixed_tokens']} tokens) vs chunked "
+        f"{chunked['dispatches_per_token']:.4f} — "
+        f"{RESULTS['interference']['dispatches_per_token_reduction']:.2f}x "
+        f"fewer; mixed TBT p95 {mixed['tbt_p95_ms']:.1f} ms"
     )
 
 
@@ -1786,6 +1841,12 @@ def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
                         "batched_steps": server_cb.batched_steps,
                         "batch_dispatches": server_cb.batch_dispatches,
                         "batch_solo_steps": server_cb.batch_solo_steps,
+                        "dispatches_per_token": (
+                            server_cb.step_dispatches
+                            / max(server_cb.step_tokens, 1)
+                        ),
+                        "mixed_dispatches": server_cb.mixed_dispatches,
+                        "mixed_tokens": server_cb.mixed_tokens,
                         "queue_wait_ms": server_cb.compute.wait_stats_ms(),
                     }
                     log(

@@ -98,6 +98,24 @@ def main(argv=None):
                         line += "  " + " ".join(
                             f"{k}={v}" for k, v in sorted(sched.items())
                         )
+                    # mixed-batch dispatch counters: are decodes actually
+                    # fusing into prefill-chunk device steps, and what the
+                    # per-token dispatch amortization works out to
+                    mixed = {
+                        k: probe[k]
+                        for k in (
+                            "mixed_dispatches",
+                            "mixed_tokens",
+                        )
+                        if probe.get(k)
+                    }
+                    if mixed:
+                        line += "  " + " ".join(
+                            f"{k}={v}" for k, v in sorted(mixed.items())
+                        )
+                        dpt = probe.get("dispatches_per_token")
+                        if dpt:
+                            line += f"  dispatches_per_token={dpt:.3f}"
                     # session lease counters: are leases reaping abandoned
                     # sessions, are clients resuming instead of replaying,
                     # and is keepalive traffic flowing on idle conns

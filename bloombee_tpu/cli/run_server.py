@@ -60,6 +60,13 @@ def main(argv=None):
                              "concurrent sessions' single-token decode "
                              "steps into one span dispatch (1 disables; "
                              "gather window via BBTPU_BATCH_WINDOW_MS)")
+    parser.add_argument("--mixed-batch", action="store_true", default=None,
+                        help="mixed-batch dispatch: fuse a prefill chunk "
+                             "and compatible queued decode steps into ONE "
+                             "ragged span dispatch (Sarathi-Serve fused "
+                             "iterations) instead of a dispatch each; "
+                             "needs --prefill-chunk to produce chunks. "
+                             "Default follows BBTPU_MIXED_BATCH")
     parser.add_argument("--prefill-chunk", type=int, default=None,
                         help="stall-free scheduling: split prefills into "
                              "chunks of at most this many tokens, each its "
@@ -214,6 +221,7 @@ def main(argv=None):
             num_pages=args.num_pages, page_size=args.page_size,
             compute_dtype=dtype, max_chunk_tokens=args.max_chunk_tokens,
             max_batch=args.max_batch,
+            mixed_batch=args.mixed_batch,
             prefill_chunk=args.prefill_chunk,
             announce_period=args.announce_period,
             adapter_dirs=args.adapter_dirs,

@@ -409,6 +409,59 @@ class CacheManager:
         self._apply_pending_copies()
         return slots
 
+    @_locked
+    def write_slots_ragged(
+        self, handle: CacheHandle, counts: list[int], commit: bool = False
+    ) -> np.ndarray:
+        """write_slots with a PER-SEQUENCE token count: [sum(counts)] flat
+        slots, sequence-major in handle.seq_ids order (matching the ragged
+        mixed-batch packing, where decode members contribute 1 token and
+        the prefill-chunk member contributes its whole chunk).
+
+        Same atomicity contract as write_slots: availability is pre-checked
+        across all members so a mid-group OutOfPages cannot leave earlier
+        members claiming tokens that were never written.
+        """
+        if len(counts) != len(handle.seq_ids):
+            raise ValueError(
+                f"{len(counts)} counts for {len(handle.seq_ids)} sequences"
+            )
+        table = self.table
+        need = 0
+        for sid, n in zip(handle.seq_ids, counts):
+            st = table.seq(sid)
+            need += max(
+                0,
+                -(-(st.l_seq + int(n)) // self.page_size) - st.num_pages,
+            )
+        if need > table.free_pages and self.reclaimer is not None:
+            self.reclaimer(need - table.free_pages, set(handle.seq_ids))
+        if need > table.free_pages:
+            from bloombee_tpu.kv.paged import OutOfPages
+
+            raise OutOfPages(
+                f"ragged write needs {need} pages, only "
+                f"{table.free_pages} free"
+            )
+        slots = np.concatenate(
+            [
+                table.assign_write_slots(sid, int(n), commit=commit)
+                for sid, n in zip(handle.seq_ids, counts)
+            ]
+        )
+        self._apply_pending_copies()
+        return slots
+
+    @_locked
+    def truncate_speculative(
+        self, handle: CacheHandle, lengths: list[int]
+    ) -> None:
+        """Partial rollback to a pre-dispatch l_seq snapshot: undoes one
+        failed dispatch's speculative writes without discarding earlier
+        still-speculative tokens (mid-stream prefill chunks)."""
+        for sid, length in zip(handle.seq_ids, lengths):
+            self.table.truncate_speculative(sid, int(length))
+
     def page_table(self, handle: CacheHandle, max_pages: int) -> np.ndarray:
         return self.table.page_table(handle.seq_ids, max_pages)
 

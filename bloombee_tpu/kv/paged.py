@@ -289,6 +289,21 @@ class PagedKVTable:
         state.l_seq = state.l_acc
         self._trim(state)
 
+    def truncate_speculative(self, seq_id: int, length: int) -> None:
+        """Partial rollback: drop speculative tokens past `length` but keep
+        the ones below it. A failed dispatch stacked atop EARLIER
+        still-speculative tokens (a mid-stream prefill chunk in a mixed
+        batch) must undo only its own writes — a full rollback() would
+        discard the earlier chunks too."""
+        state = self._seqs[seq_id]
+        if not state.l_acc <= length <= state.l_seq:
+            raise ValueError(
+                f"truncate length {length} outside "
+                f"[{state.l_acc}, {state.l_seq}]"
+            )
+        state.l_seq = length
+        self._trim(state)
+
     def reset_seq(self, seq_id: int) -> None:
         """Drop ALL tokens (committed included) and free the pages, keeping
         the sequence registered — the parking primitive."""

@@ -170,6 +170,12 @@ class NativePagedKVTable:
     def rollback(self, seq_id: int) -> None:
         _check(self._lib.pt_rollback(self._h, seq_id), "rollback")
 
+    def truncate_speculative(self, seq_id: int, length: int) -> None:
+        rc = self._lib.pt_truncate_speculative(self._h, seq_id, length)
+        if rc == -3:
+            raise ValueError(f"truncate length {length} out of range")
+        _check(rc, "truncate_speculative")
+
     def reset_seq(self, seq_id: int) -> None:
         _check(self._lib.pt_reset_seq(self._h, seq_id), "reset_seq")
 

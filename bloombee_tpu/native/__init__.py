@@ -81,6 +81,7 @@ def paged_table_lib():
             "pt_commit": ([i64, i64, i64], i64),
             "pt_accept": ([i64, i64, i64], i64),
             "pt_rollback": ([i64, i64], i64),
+            "pt_truncate_speculative": ([i64, i64, i64], i64),
             "pt_reset_seq": ([i64, i64], i64),
             "pt_restore_committed": ([i64, i64, i64], i64),
             "pt_page_row": ([i64, i64, i32p, i64], i64),

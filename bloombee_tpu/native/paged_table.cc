@@ -184,6 +184,21 @@ int64_t pt_rollback(int64_t h, int64_t sid) {
   return 0;
 }
 
+// Partial rollback: drop speculative tokens past `length`, keeping earlier
+// still-speculative ones (a failed dispatch stacked atop uncommitted
+// prefill chunks must undo only its own writes).
+int64_t pt_truncate_speculative(int64_t h, int64_t sid, int64_t length) {
+  Table* t = get(h);
+  if (!t) return -4;
+  auto it = t->seqs.find(sid);
+  if (it == t->seqs.end()) return -1;
+  Seq& s = it->second;
+  if (length < s.l_acc || length > s.l_seq) return -3;
+  s.l_seq = length;
+  trim(*t, s);
+  return 0;
+}
+
 // Writes the page list (padded positions untouched); returns page count or
 // error.
 int64_t pt_page_row(int64_t h, int64_t sid, int32_t* out, int64_t max_pages) {

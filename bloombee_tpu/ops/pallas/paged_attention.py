@@ -21,14 +21,17 @@ matmul; rows belonging to a different KV-head group are masked off in the
 logits. Decode attention is HBM-bandwidth-bound — the x Hkv extra FLOPs are
 noise, and the bytes read are exactly one pass over the context.
 
-Scope: three kernels share the online-softmax page-streaming machinery.
+Scope: four kernels share the online-softmax page-streaming machinery.
 `paged_decode_attention` covers single-token decode (T=1; per-sequence
 lengths masked per page, sliding windows in-kernel with whole-page skips);
 `paged_decode_attention_int4` is its in-VMEM-dequant variant for
 int4-quantized arenas; `paged_chunk_attention` covers T>1 steps —
 tree-verify steps (the [T, T] tree mask applied in-kernel) and short
-multi-token chunks below flash's T>=128 domain. ALiBi, logit soft-caps,
-and tree+window combinations take the dense path (the executor checks
+multi-token chunks below flash's T>=128 domain; `paged_ragged_attention`
+covers mixed-batch steps (N decode rows plus one prefill-chunk row-group
+packed raggedly, per-row owning sequence and position) in one grid launch
+over the cross-session page-table view. ALiBi, logit soft-caps, and
+tree+window combinations take the dense path (the executor checks
 eligibility host-side, like the flash prefill kernel).
 """
 
@@ -600,3 +603,178 @@ def paged_decode_attention(
         q, kp, vp,
     )
     return out
+
+
+def _ragged_kernel(
+    pt_ref,  # [B, NP] i32 scalar prefetch: logical page j of seq b
+    lens_ref,  # [B] i32 scalar prefetch (lens INCLUDE each seq's new tokens)
+    win_ref,  # [1] i32 scalar prefetch: sliding window (0 = full attention)
+    seq_ref,  # [rq, 1] i32: owning sequence of each query row (>= B = pad)
+    pos_ref,  # [rq, 1] i32: context position of each query row
+    q_ref,  # [rq, hd] — ALL members' query rows, token-major then head
+    k_ref,  # [page_size * Hkv, hd] — current physical page, ALL kv heads
+    v_ref,
+    o_ref,  # [rq, hd]
+    m_scr,  # [rq, 1] f32
+    l_scr,  # [rq, 1] f32
+    acc_scr,  # [rq, hd] f32
+    *,
+    scale: float,
+    page_size: int,
+    n_pages: int,
+    n_seqs: int,
+    hkv: int,
+    g: int,
+):
+    """Ragged mixed-batch variant of _chunk_kernel: ONE launch covers every
+    member of a mixed group (N single-token decode rows + one multi-token
+    prefill-chunk row-group). The grid walks (sequence, page); every grid
+    step attends ALL rq query rows against sequence b's page j and masks
+    rows owned by a different sequence (their online-softmax state passes
+    through untouched: p = 0, corr = 1, exactly the masked-page contract of
+    _online_softmax_body). Ownership and causality are per ROW — seq_ref /
+    pos_ref replace _chunk_kernel's block-uniform (length, t_real) — so
+    T=1 and T=chunk members coexist in one [rq, hd] block.
+
+    Scratch persists across the WHOLE grid (init at the first step,
+    finalize at the last), not per sequence: that is what lets one q block
+    serve B sequences. The x B masked FLOPs are the price of fusing the
+    dispatches; the HBM bytes stay one pass over every member's pages —
+    the same bytes B separate kernel calls would read. No windowed
+    page-skip here (the skip bound is per row, not per block); dead pages
+    still predicate off their compute via page_live."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    h = hkv * g
+    rows = page_size * hkv
+    rq = q_ref.shape[0]
+
+    @pl.when((b == 0) & (j == 0))
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = lens_ref[b]
+    win = win_ref[0]
+    rk = jax.lax.broadcasted_iota(jnp.int32, (rq, rows), 1)
+    rqi = jax.lax.broadcasted_iota(jnp.int32, (rq, rows), 0)
+    pos = j * page_size + rk // hkv  # key position
+    own = (rk % hkv) == ((rqi % h) // g)
+    seq = seq_ref[...]  # [rq, 1] — broadcasts over key rows
+    qpos = pos_ref[...]
+    page_live = j * page_size < length
+
+    @pl.when(page_live)
+    def _update():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [rq, rows]
+        mask = own & (pos < length) & (seq == b) & (pos <= qpos)
+        mask &= (win <= 0) | (pos > qpos - win)
+        logits = jnp.where(mask, logits, NEG)
+        m = m_scr[...]
+        m_new = jnp.maximum(m, logits.max(axis=1, keepdims=True))
+        p = jnp.exp(logits - m_new) * mask.astype(jnp.float32)
+        corr = jnp.exp(m - m_new)
+        l_scr[...] = l_scr[...] * corr + p.sum(axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when((b == n_seqs - 1) & (j == n_pages - 1))
+    def _finalize():
+        # rows owned by no live sequence (bucket padding: seq >= B) never
+        # accumulate and divide by eps into zeros, dropped by the caller
+        o_ref[...] = (
+            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "scale", "interpret"),
+)
+def paged_ragged_attention(
+    q: jax.Array,  # [R, H, hd] — ragged token rows across ALL members
+    k_slab: jax.Array,  # [S_tot, Hkv, hd] — the paged arena, one layer
+    v_slab: jax.Array,
+    page_table: jax.Array,  # [B, NP] i32 physical page ids (padding = 0)
+    lens: jax.Array,  # [B] i32 context lengths (incl. each seq's new tokens)
+    q_seq: jax.Array,  # [R] i32 owning sequence per token (>= B = padding)
+    q_pos: jax.Array,  # [R] i32 context position per token
+    page_size: int,
+    scale: float | None = None,
+    interpret: bool = False,
+    window=0,  # traced i32 scalar; 0 = full attention (per-layer in scan)
+) -> jax.Array:  # [R, H, hd]
+    """Paged attention over a ragged mixed batch: R tokens spread unevenly
+    across B sequences (decode members contribute 1 row, the prefill-chunk
+    member contributes its chunk), all in ONE grid launch. Token row i
+    belongs to sequence q_seq[i] at context position q_pos[i]; padding rows
+    (q_seq >= B) emit zeros. VMEM budget: caller gates on R*H rows (the
+    executor allows <= 2048, mirroring paged_chunk_attention)."""
+    r, h, hd = q.shape
+    s_tot, hkv = k_slab.shape[0], k_slab.shape[1]
+    if h % hkv:
+        raise ValueError(f"H={h} must be a multiple of Hkv={hkv}")
+    if s_tot % page_size:
+        raise ValueError(f"arena slots {s_tot} % page_size {page_size}")
+    g = h // hkv
+    b = page_table.shape[0]
+    n_pages = page_table.shape[1]
+    if scale is None:
+        scale = hd**-0.5
+    rows = page_size * hkv
+    rq = r * h
+
+    kp = k_slab.reshape(-1, rows, hd)
+    vp = v_slab.reshape(-1, rows, hd)
+    q2 = q.reshape(rq, hd)
+    # per-ROW ownership/position: each token's values repeated per head
+    seq_rows = jnp.repeat(q_seq.astype(jnp.int32), h).reshape(rq, 1)
+    pos_rows = jnp.repeat(q_pos.astype(jnp.int32), h).reshape(rq, 1)
+
+    def kv_index(bi, j, pt, ln, wn):
+        return (pt[bi, j], 0, 0)
+
+    def const_index(bi, j, pt, ln, wn):
+        return (0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, n_pages),
+        in_specs=[
+            pl.BlockSpec((rq, 1), const_index),
+            pl.BlockSpec((rq, 1), const_index),
+            pl.BlockSpec((rq, hd), const_index),
+            pl.BlockSpec((None, rows, hd), kv_index),
+            pl.BlockSpec((None, rows, hd), kv_index),
+        ],
+        out_specs=pl.BlockSpec((rq, hd), const_index),
+        scratch_shapes=[
+            pltpu.VMEM((rq, 1), jnp.float32),
+            pltpu.VMEM((rq, 1), jnp.float32),
+            pltpu.VMEM((rq, hd), jnp.float32),
+        ],
+    )
+    win_arr = jnp.asarray(window, jnp.int32).reshape(1)
+    out = pl.pallas_call(
+        functools.partial(
+            _ragged_kernel, scale=scale, page_size=page_size,
+            n_pages=n_pages, n_seqs=b, hkv=hkv, g=g,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rq, hd), q.dtype),
+        interpret=interpret,
+    )(
+        page_table.astype(jnp.int32), lens.astype(jnp.int32), win_arr,
+        seq_rows, pos_rows, q2, kp, vp,
+    )
+    return out.reshape(r, h, hd)

@@ -23,8 +23,10 @@ from bloombee_tpu.kv.cache_manager import CacheHandle, CacheManager
 from bloombee_tpu.models.spec import ModelSpec
 from bloombee_tpu.runtime.step import (
     pack_plan,
+    pack_ragged_plan,
     pack_step_payload,
     span_step_packed,
+    span_step_ragged,
 )
 from bloombee_tpu.utils import env
 
@@ -484,6 +486,172 @@ class SpanExecutor:
             adapter=adapter,
         )
         return out, combined
+
+    def mixed_unsupported(self) -> str | None:
+        """Why this executor can't run ragged mixed-batch dispatches; None
+        when it can. These configs have their own step machinery (offload
+        layer chain, hetero span, sharded span, decode-only top-k) that the
+        ragged path doesn't replicate — the server falls back to separate
+        dispatches, byte-for-byte the mixed-off behavior."""
+        if self.mesh is not None:
+            return "tensor-parallel mesh"
+        if self.host_layers:
+            return "weight offload"
+        if self.spec.heterogeneous:
+            return "heterogeneous span"
+        if self.attn_sparsity < 1.0:
+            return "sparse (top-k) attention"
+        return None
+
+    def mixed_group(
+        self,
+        handles: list[CacheHandle],
+        hiddens: list[np.ndarray],  # per-member [b_i, t_i, D], same dtype
+        layers: tuple[int, int] | None = None,
+        adapter: str | None = None,
+    ):
+        """Ragged generalization of decode_group: members contribute
+        DIFFERENT token counts (N single-token decodes plus one multi-token
+        prefill chunk) and all of them run as ONE span dispatch — the
+        Sarathi-Serve fused iteration. Tokens pack row-major into one pow2
+        bucket [1, R, D]; per-token (q_seq, q_pos) carry the member
+        structure into the ragged kernel (dense attend_ragged for
+        kernel-ineligible configs: ALiBi, soft-caps, quantized arenas).
+
+        KV writes are SPECULATIVE for every member: the caller commits
+        decode handles (and the chunk's on its last chunk) only after the
+        dispatch succeeds, and on failure rolls decodes back /
+        truncate_speculative's the chunk to its pre-dispatch length before
+        replaying members solo.
+
+        Returns (out, combined_handle): `out` is the lazy [R, D] device
+        result in member-major token order (slice rows per member, fetch
+        off-queue)."""
+        reason = self.mixed_unsupported()
+        if reason is not None:
+            raise ValueError(f"mixed_group unsupported: {reason}")
+        spec = self.spec
+        from bloombee_tpu.models.checkpoint import resolve_adapter
+
+        lora = resolve_adapter(self.adapters, adapter)
+        combined = self.manager.combine_handles(handles)
+        self.manager.ensure_resident(combined)
+
+        d = spec.hidden_size
+        counts: list[int] = []
+        row_blocks = []
+        for hid in hiddens:
+            b_i, t_i, d_i = hid.shape
+            assert d_i == d
+            counts.extend([t_i] * b_i)
+            row_blocks.append(hid.reshape(b_i * t_i, d))
+        n_seqs = len(counts)
+        r = sum(counts)
+
+        starts = self.manager.context_lens(combined)  # [B] before write
+        slots = self.manager.write_slots_ragged(
+            combined, counts, commit=False
+        )  # [R]
+        total_lens = self.manager.context_lens(combined)  # [B] after write
+
+        rb = next_pow2(r)
+        sb = next_pow2(n_seqs)
+        arena_tokens = self.manager.capacity_tokens
+        pages_needed = int(
+            max(-(-int(l) // self.page_size) for l in total_lens)
+        )
+        pb = min(
+            next_pow2(max(pages_needed, 1), floor=4),
+            arena_tokens // self.page_size,
+        )
+        oob = arena_tokens  # out-of-bounds slot => dropped write
+
+        h_pad = np.zeros((1, rb, d), dtype=self.transfer_dtype)
+        h_pad[0, :r] = np.concatenate(row_blocks, axis=0).astype(
+            self.transfer_dtype
+        )
+        slots_pad = np.full((rb,), oob, dtype=np.int32)
+        slots_pad[:r] = slots
+        positions = np.zeros((1, rb), dtype=np.int32)
+        # padding rows own no sequence (q_seq >= B): fully masked in the
+        # kernel, sliced away with the pad rows
+        q_seq = np.full((rb,), sb, dtype=np.int32)
+        off = 0
+        for s_i, n in enumerate(counts):
+            positions[0, off : off + n] = starts[s_i] + np.arange(
+                n, dtype=np.int32
+            )
+            q_seq[off : off + n] = s_i
+            off += n
+        pt_pad = np.zeros((sb, pb), dtype=np.int32)
+        pt_pad[:n_seqs] = self.manager.page_table(combined, pb)
+        lens_pad = np.zeros((sb,), dtype=np.int32)
+        lens_pad[:n_seqs] = total_lens
+        num_layers = self.manager.num_layers
+        layer_active = np.ones((num_layers,), dtype=np.int32)
+        if layers is not None:
+            layer_active[:] = 0
+            layer_active[layers[0] : layers[1]] = 1
+        plan = pack_ragged_plan(
+            slots_pad, pt_pad, positions, lens_pad, q_seq, layer_active
+        )
+
+        # ragged-kernel eligibility mirrors _step's chunk gate: dense
+        # arena, [R*H, hd] VMEM budget, contexts past the paged crossover.
+        # Ineligible configs run attend_ragged — still ONE dispatch.
+        use_kernel = bool(
+            not getattr(self, "_paged_broken", False)
+            and self.manager.quant is None
+            and rb * spec.num_attention_heads <= 2048
+            and pb * self.page_size >= env.get("BBTPU_PAGED_MIN_CONTEXT")
+            and not spec.alibi
+            and not spec.attn_logit_softcap
+            and env.get("BBTPU_PAGED_ATTENTION")
+            and (
+                jax.default_backend() == "tpu"
+                or env.get("BBTPU_PAGED_INTERPRET")
+            )
+        )
+
+        payload_dev = jnp.asarray(pack_step_payload(h_pad, plan))
+        arena = self.manager.arena
+
+        def _run(use_kernel_now: bool):
+            return span_step_ragged(
+                self.params,
+                arena["k"],
+                arena["v"],
+                payload_dev,
+                lora,
+                spec=spec,
+                r=rb,
+                n_seqs=sb,
+                page_size=self.page_size,
+                max_pages=pb,
+                windows=self.windows,
+                use_kernel=use_kernel_now,
+            )
+
+        try:
+            out, new_k, new_v = _run(use_kernel)
+        except Exception:
+            # same self-heal contract as _step: retry on the dense ragged
+            # path only if the donated arena buffers are still alive
+            if self._arena_consumed(arena):
+                self._rebuild_after_failure("mixed ragged step")
+                raise
+            if not use_kernel:
+                raise
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "paged ragged kernel failed; retrying on the dense "
+                "ragged path"
+            )
+            out, new_k, new_v = _run(False)
+            self._paged_broken = True
+        self.manager.arena = {"k": new_k, "v": new_v}
+        return out[0, :r], combined
 
     def fetch(self, out) -> np.ndarray:
         """Materialize a fetch=False result on host in the wire dtype

@@ -280,6 +280,139 @@ def layer_body(
     )
 
 
+def attend_ragged(
+    spec: ModelSpec,
+    q: jax.Array,  # [R, H, hd] — ragged token rows across ALL members
+    k_ctx: jax.Array,  # [B, S, Hkv, hd] — every member's gathered context
+    v_ctx: jax.Array,
+    q_pos: jax.Array,  # [R] context position per token
+    q_seq: jax.Array,  # [R] owning sequence per token (>= B = padding)
+    total_lens: jax.Array,  # [B]
+    window,  # traced int32 scalar; 0 = full attention
+) -> jax.Array:  # [R, H, hd]
+    """Dense fallback for the ragged mixed-batch step: every token row
+    attends the full [B, S] cross-session context and masks everything it
+    doesn't own. Handles the kernel-ineligible configs (ALiBi, logit
+    soft-cap, quantized arenas via gather_pages dequant) so those models
+    still get the single fused dispatch. The x B masked logits columns are
+    the fallback's price; padding rows (q_seq >= B) are fully masked and
+    softmax to garbage that the executor slices away."""
+    r, h, hd = q.shape
+    b, s = k_ctx.shape[:2]
+    key_pos = jnp.arange(s, dtype=jnp.int32)[None, None, :]  # [1, 1, S]
+    seq_ids = jnp.arange(b, dtype=jnp.int32)[None, :, None]  # [1, B, 1]
+    qp = q_pos[:, None, None]  # [R, 1, 1]
+    mask = (
+        (q_seq[:, None, None] == seq_ids)
+        & (key_pos < total_lens[None, :, None])
+        & (key_pos <= qp)
+    )
+    mask &= (window <= 0) | (key_pos > (qp - window))
+
+    n_rep = h // k_ctx.shape[2]
+    k_r = repeat_kv(k_ctx, n_rep)  # [B, S, H, hd]
+    v_r = repeat_kv(v_ctx, n_rep)
+    scale = attn_scale(spec)
+    logits = jnp.einsum("rhd,bshd->rhbs", q, k_r).astype(jnp.float32) * scale
+    if spec.attn_logit_softcap:
+        logits = (
+            jnp.tanh(logits / spec.attn_logit_softcap)
+            * spec.attn_logit_softcap
+        )
+    if spec.alibi:
+        slopes = jnp.asarray(alibi_slopes(spec.num_attention_heads))
+        logits = logits + (
+            slopes[None, :, None, None] * key_pos[None].astype(jnp.float32)
+        )
+    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    # softmax over the FLATTENED cross-session key axis: each row's mask
+    # confines its probability mass to its own sequence's keys
+    probs = jax.nn.softmax(
+        logits.reshape(r, h, b * s), axis=-1
+    ).astype(q.dtype)
+    return jnp.einsum("rhs,shd->rhd", probs, v_r.reshape(b * s, h, hd))
+
+
+def layer_body_ragged(
+    spec: ModelSpec,
+    page_size: int,
+    hidden: jax.Array,  # [1, R, D] — every member's tokens, ragged-packed
+    params: dict,  # one layer's params
+    k_slab: jax.Array,  # [S_tot, Hkv, hd]
+    v_slab: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    slots: jax.Array,  # [R] (padding rows scatter out-of-bounds and drop)
+    page_table: jax.Array,  # [B, NP]
+    q_positions: jax.Array,  # [1, R]
+    total_lens: jax.Array,  # [B]
+    q_seq: jax.Array,  # [R] owning sequence per token
+    window,  # traced per-layer scalar
+    use_kernel: bool = False,  # static: ragged Pallas kernel vs dense
+    lora: dict | None = None,
+):
+    """layer_body for the ragged mixed-batch step: one [1, R, D] row-major
+    pack of N decode tokens plus one prefill chunk's tokens. Projections,
+    rotary, and the arena scatter are position-wise, so they need no
+    per-member structure — only attention does, and it gets it from
+    (q_seq, q_positions) per row instead of layer_body's block-uniform
+    (B, T)."""
+    _, r, d = hidden.shape
+    h_heads, kv_heads, hd = (
+        spec.num_attention_heads,
+        spec.num_key_value_heads,
+        spec.head_dim,
+    )
+    x = _norm(hidden, params, "input_layernorm", spec)
+    q = _proj(x, params, "q_proj", lora).reshape(1, r, h_heads, hd)
+    k = _proj(x, params, "k_proj", lora).reshape(1, r, kv_heads, hd)
+    if spec.k_eq_v:
+        v = k
+    else:
+        v = _proj(x, params, "v_proj", lora).reshape(1, r, kv_heads, hd)
+    if spec.qk_norm:
+        q = rms_norm(q, params["q_norm"], spec.rms_norm_eps)
+        k = rms_norm(k, params["k_norm"], spec.rms_norm_eps)
+    if not spec.alibi:
+        q, k = apply_rotary(q, k, cos, sin)
+
+    k_slab, v_slab = arena_write(
+        k_slab, v_slab, slots,
+        k.reshape(r, kv_heads, hd), v.reshape(r, kv_heads, hd),
+    )
+    from bloombee_tpu.kv.quant import QuantSlab
+
+    if use_kernel and not isinstance(k_slab, QuantSlab):
+        from bloombee_tpu.ops.pallas.paged_attention import (
+            paged_ragged_attention,
+        )
+
+        attn = paged_ragged_attention(
+            q[0], k_slab, v_slab, page_table, total_lens,
+            q_seq, q_positions[0],
+            page_size=page_size, scale=attn_scale(spec),
+            interpret=jax.default_backend() != "tpu",
+            window=window,
+        )[None]
+    else:
+        k_ctx = gather_pages(
+            k_slab, page_table, page_size
+        ).astype(hidden.dtype)
+        v_ctx = gather_pages(
+            v_slab, page_table, page_size
+        ).astype(hidden.dtype)
+        attn = attend_ragged(
+            spec, q[0], k_ctx, v_ctx, q_positions[0], q_seq, total_lens,
+            window,
+        )[None]
+    attn_out = _proj(
+        attn.reshape(1, r, h_heads * hd), params, "o_proj", lora
+    )
+    return _finish_layer(
+        spec, params, hidden, x, attn_out, k_slab, v_slab, lora
+    )
+
+
 def dense_unsupported(spec: ModelSpec) -> str | None:
     """Why a family can't run the cache-returning DENSE block forward
     (drafter path); None when it can. These are attend-injection limits:

@@ -25,7 +25,7 @@ from jax import lax
 
 from bloombee_tpu.models.spec import ModelSpec
 from bloombee_tpu.ops.rotary import rotary_cos_sin
-from bloombee_tpu.runtime.layer_body import layer_body
+from bloombee_tpu.runtime.layer_body import layer_body, layer_body_ragged
 
 
 def unpack_plan(plan: jax.Array, b: int, t: int, max_pages: int, num_layers: int):
@@ -250,6 +250,124 @@ span_step = functools.partial(
     ),
     donate_argnames=("arena_k", "arena_v"),
 )(span_step_impl)
+
+
+def unpack_ragged_plan(
+    plan: jax.Array, r: int, n_seqs: int, max_pages: int, num_layers: int
+):
+    """unpack_plan for the ragged mixed-batch step: token-axis vectors are
+    [R] (one entry per ragged token row) and sequence-axis vectors are
+    [n_seqs], tied together by q_seq — [slots(R) | page_table(B*max_pages)
+    | positions(R) | total_lens(B) | q_seq(R) | layer_active(L)]."""
+    o1 = r
+    o2 = o1 + n_seqs * max_pages
+    o3 = o2 + r
+    o4 = o3 + n_seqs
+    o5 = o4 + r
+    slots = plan[:o1]
+    page_table = plan[o1:o2].reshape(n_seqs, max_pages)
+    q_positions = plan[o2:o3].reshape(1, r)
+    total_lens = plan[o3:o4]
+    q_seq = plan[o4:o5]
+    layer_active = plan[o5 : o5 + num_layers]
+    return slots, page_table, q_positions, total_lens, q_seq, layer_active
+
+
+def pack_ragged_plan(
+    slots, page_table, q_positions, total_lens, q_seq, layer_active
+):
+    import numpy as np
+
+    return np.concatenate(
+        [
+            np.ravel(slots).astype(np.int32),
+            np.ravel(page_table).astype(np.int32),
+            np.ravel(q_positions).astype(np.int32),
+            np.ravel(total_lens).astype(np.int32),
+            np.ravel(q_seq).astype(np.int32),
+            np.ravel(layer_active).astype(np.int32),
+        ]
+    )
+
+
+def span_step_ragged_impl(
+    stacked_params: dict,
+    arena_k: jax.Array,  # [L, S_tot, Hkv, hd] (donated)
+    arena_v: jax.Array,
+    payload: jax.Array,  # uint16 (bf16 compute) or uint32 (f32 compute)
+    lora: dict | None = None,
+    *,
+    spec: ModelSpec,
+    r: int,  # ragged token bucket (pow2-padded sum of member tokens)
+    n_seqs: int,  # sequence bucket (pow2-padded member sequence count)
+    page_size: int,
+    max_pages: int,
+    windows: tuple | None = None,
+    use_kernel: bool = False,
+):
+    """The ragged mixed-batch span step: N single-token decode members plus
+    one prefill-chunk member packed into ONE [1, R, D] dispatch (the
+    Sarathi-Serve fused iteration). Rides pack_step_payload as a b=1, t=R
+    hidden; per-row (q_seq, q_positions) carry the member structure the
+    block shapes no longer do. No tree masks, prompts, or offload-resident
+    splits here — those step types stay on their dedicated paths (the
+    executor gates eligibility host-side)."""
+    hidden, plan = unpack_step_payload(payload, 1, r, spec.hidden_size)
+    num_layers = arena_k.shape[0]
+    slots, page_table, q_positions, total_lens, q_seq, layer_active = (
+        unpack_ragged_plan(plan, r, n_seqs, max_pages, num_layers)
+    )
+    cos, sin = rotary_cos_sin(q_positions, spec.head_dim, spec.rope_theta)
+    cos = cos.astype(hidden.dtype)
+    sin = sin.astype(hidden.dtype)
+    if spec.rope_local_theta and spec.rope_local_theta != spec.rope_theta:
+        cos_loc, sin_loc = rotary_cos_sin(
+            q_positions, spec.head_dim, spec.rope_local_theta
+        )
+        cos_loc = cos_loc.astype(hidden.dtype)
+        sin_loc = sin_loc.astype(hidden.dtype)
+    else:
+        cos_loc, sin_loc = cos, sin
+
+    windows_arr = jnp.asarray(
+        windows if windows is not None else (0,) * num_layers, jnp.int32
+    )
+    xs = (stacked_params, arena_k, arena_v, layer_active, windows_arr)
+    if lora is not None:
+        xs = xs + (lora,)
+
+    def body(h, xs):
+        params_l, k_l, v_l, active, window_l = xs[:5]
+        lora_l = xs[5] if lora is not None else None
+        use_local = window_l > 0
+        cos_l = jnp.where(use_local, cos_loc, cos)
+        sin_l = jnp.where(use_local, sin_loc, sin)
+
+        def run(h, k_l, v_l):
+            return layer_body_ragged(
+                spec, page_size, h, params_l, k_l, v_l, cos_l, sin_l,
+                slots, page_table, q_positions, total_lens, q_seq,
+                window_l, use_kernel=use_kernel, lora=lora_l,
+            )
+
+        def skip(h, k_l, v_l):
+            return h, k_l, v_l
+
+        h, k_l, v_l = lax.cond(active > 0, run, skip, h, k_l, v_l)
+        return h, (k_l, v_l)
+
+    hidden, (arena_k, arena_v) = lax.scan(body, hidden, xs)
+    return hidden, arena_k, arena_v
+
+
+span_step_ragged = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "spec", "r", "n_seqs", "page_size", "max_pages", "windows",
+        "use_kernel",
+    ),
+    donate_argnames=("arena_k", "arena_v"),
+)(span_step_ragged_impl)
 
 
 def layer_step_impl(

@@ -117,6 +117,14 @@ env.declare(
     "half-open streams are detected promptly; a lease alone only fences a "
     "session after a full silent lease period",
 )
+env.declare(
+    "BBTPU_MIXED_BATCH", bool, False,
+    "mixed-batch dispatch (Sarathi-Serve fused iterations): let a popped "
+    "prefill chunk absorb compatible queued single-token decode steps "
+    "(and vice versa) into ONE ragged span dispatch, so a mid-stream "
+    "prefill no longer costs decodes a whole dispatch each. Off = the "
+    "decode-only batcher and per-chunk prefill tasks, byte-for-byte",
+)
 
 
 class _ChainError(RuntimeError):
@@ -141,6 +149,21 @@ class _BatchMember:
     session: "_Session"
     handle: object
     hidden: np.ndarray  # [b, 1, D] in the wire dtype
+
+
+@dataclasses.dataclass
+class _ChunkMember:
+    """One prefill chunk inside a MIXED dispatch (--mixed-batch): the
+    multi-token member that rides a ragged span step alongside other
+    sessions' single-token decodes. `first`/`last` carry the chunk
+    stream's settle/commit duties into whichever dispatch runs it."""
+
+    session: "_Session"
+    handle: object
+    hidden: np.ndarray  # [b, t, D] in the wire dtype
+    first: bool
+    last: bool
+    prefix_skip: object = None
 
 
 class _Session:
@@ -206,6 +229,12 @@ class _Session:
         # a stepped decode_n chain died after committing KV the client was
         # never told about: resuming would desync — force full replay
         self.kv_dirty = False
+        # prefix-cache adoption is SETTLED once a step has trimmed the
+        # adopted prefix to the client's declared skip. Until then the
+        # session must step solo (the settle mutates the table); after,
+        # it batches like any other session instead of being carved out
+        # of merged dispatches for the rest of its life
+        self.adoption_settled = False
 
 
 class _PeerPool:
@@ -330,6 +359,13 @@ class BlockServer:
         # accepted connections so half-open clients (partition, no
         # FIN/RST) are detected instead of hanging recv() forever
         # (None -> BBTPU_KEEPALIVE_S env; 0 disables)
+        mixed_batch: bool | None = None,  # fuse a prefill chunk and
+        # compatible queued decode steps into ONE ragged span dispatch
+        # (Sarathi-Serve fused iterations) instead of a dispatch each;
+        # falls back to separate dispatches on configs the ragged step
+        # doesn't cover (TP mesh, weight offload, hetero spans, top-k
+        # attention). None -> BBTPU_MIXED_BATCH env; off = current
+        # decode-only batching, byte-for-byte
     ):
         self.model_dir = model_dir
         if weight_quant is None:
@@ -502,7 +538,24 @@ class BlockServer:
         # XLA compile on a middle/tail span)
         self.chain_step_timeout = 120.0
         self.max_batch = max(1, int(max_batch))
-        self.compute = ComputeQueue(max_group=self.max_batch)
+        if mixed_batch is None:
+            mixed_batch = bool(env.get("BBTPU_MIXED_BATCH"))
+        if mixed_batch:
+            reason = self.executor.mixed_unsupported()
+            if reason is not None:
+                logger.info(
+                    "mixed-batch dispatch disabled: %s", reason
+                )
+                mixed_batch = False
+        self.mixed_batch = bool(mixed_batch)
+        if self.mixed_batch:
+            # one extra group slot for the prefill chunk, so fusing never
+            # costs the decode batcher any of its max_batch decode seats
+            self.compute = ComputeQueue(
+                max_group=self.max_batch + 1, compat=self._mixed_compat
+            )
+        else:
+            self.compute = ComputeQueue(max_group=self.max_batch)
         self.peers = _PeerPool()
         # server-side multi-step decode (decode_n): needs the checkpoint's
         # embed/norm/lm_head trio; lazy-loaded from model_dir on first use
@@ -554,6 +607,15 @@ class BlockServer:
         self.prefill_chunk_tokens = 0
         self.decode_steps_interleaved = 0
         self._chunking_sessions = 0
+        # mixed-batch observability: fused ragged dispatches issued, the
+        # tokens they carried, and the all-paths dispatch/token totals
+        # behind dispatches_per_token (every inference dispatch counts —
+        # solo steps, merged decodes, prefill chunks, mixed groups — so
+        # the ratio falls exactly when fusing removes dispatches)
+        self.mixed_dispatches = 0
+        self.mixed_tokens = 0
+        self.step_dispatches = 0
+        self.step_tokens = 0
         # overload protection: the admission controller sheds NEW work
         # past the high watermark (established streams are never routed
         # through it); the load advert republishes live queue gauges
@@ -1176,6 +1238,16 @@ class BlockServer:
             "prefill_chunks": self.prefill_chunks,
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "decode_steps_interleaved": self.decode_steps_interleaved,
+            # mixed-batch observability: fused decode+prefill dispatches,
+            # the tokens they carried, and dispatches_per_token over ALL
+            # inference dispatches (1.0 from pure single-token decodes;
+            # drops as chunking and fusing pack more tokens per dispatch)
+            "mixed_batch": self.mixed_batch,
+            "mixed_dispatches": self.mixed_dispatches,
+            "mixed_tokens": self.mixed_tokens,
+            "dispatches_per_token": (
+                self.step_dispatches / max(self.step_tokens, 1)
+            ),
             # prefix-cache observability: sessions that adopted pooled
             # prompt pages, tokens they skipped prefilling, copy-on-write
             # page splits, and current cached-pool occupancy (plus
@@ -2010,7 +2082,11 @@ class BlockServer:
                     ("decode1", session.layers, session.adapter,
                      str(hidden.dtype)),
                     _BatchMember(session, handle, hidden),
-                    self._compute_step_group,
+                    # with --mixed-batch the group may also hold a prefill
+                    # chunk; the mixed runner degrades to the classic
+                    # decode-group path for chunk-free groups
+                    self._compute_mixed_group if self.mixed_batch
+                    else self._compute_step_group,
                     deadline=deadline,
                     task_class="decode",
                 )
@@ -2833,18 +2909,35 @@ class BlockServer:
                     raise DeadlineExpired(
                         "client deadline expired between prefill chunks"
                     )
-                out, dt_ms = await self.compute.submit(
-                    aged_chunk_priority(stream_t0),
-                    self._compute_prefill_chunk,
-                    session,
-                    handle,
-                    hidden[:, s:e],
-                    idx == 0,
-                    idx == last,
-                    prefix_skip,
-                    deadline=deadline,
-                    task_class="prefill",
-                )
+                if self.mixed_batch:
+                    # batchable chunk: the worker may fuse this chunk with
+                    # queued decode steps into one ragged dispatch (and a
+                    # popped decode may likewise absorb this chunk)
+                    out, dt_ms = await self.compute.submit_group(
+                        aged_chunk_priority(stream_t0),
+                        ("chunkm", session.layers, session.adapter,
+                         str(hidden.dtype), e - s),
+                        _ChunkMember(
+                            session, handle, hidden[:, s:e],
+                            idx == 0, idx == last, prefix_skip,
+                        ),
+                        self._compute_mixed_group,
+                        deadline=deadline,
+                        task_class="prefill",
+                    )
+                else:
+                    out, dt_ms = await self.compute.submit(
+                        aged_chunk_priority(stream_t0),
+                        self._compute_prefill_chunk,
+                        session,
+                        handle,
+                        hidden[:, s:e],
+                        idx == 0,
+                        idx == last,
+                        prefix_skip,
+                        deadline=deadline,
+                        task_class="prefill",
+                    )
                 outs.append(out)
                 total_ms += dt_ms
                 self.prefill_chunks += 1
@@ -2902,12 +2995,15 @@ class BlockServer:
             # (same semantics as _compute_step's settle)
             self.manager.ensure_resident(handle)
             self.manager.trim_adopted(handle, int(prefix_skip or 0))
+        session.adoption_settled = True
         out = self.executor.prefill_chunk(
             handle, hidden, commit=False, layers=session.layers,
             fetch=False, adapter=session.adapter,
         )
         if last:
             self.manager.commit(handle)
+        self.step_dispatches += 1
+        self.step_tokens += int(hidden.shape[0]) * int(hidden.shape[1])
         dt_ms = (time.perf_counter() - t0) * 1000.0
         if env.log_channel_enabled("timing"):
             logger.info(
@@ -2951,6 +3047,7 @@ class BlockServer:
             self.manager.trim_adopted(
                 handle, int(prefix_skip or 0)
             )
+        session.adoption_settled = True
         if hidden.shape[1] > 1 and tree_mask is None:
             out = self.executor.prefill(
                 handle, hidden, commit=commit, layers=session.layers,
@@ -2974,6 +3071,8 @@ class BlockServer:
             self.failover_replayed_tokens += int(
                 hidden.shape[0] * hidden.shape[1]
             )
+        self.step_dispatches += 1
+        self.step_tokens += int(hidden.shape[0]) * int(hidden.shape[1])
         dt_ms = (time.perf_counter() - t0) * 1000.0
         if env.log_channel_enabled("timing"):
             logger.info(
@@ -3026,11 +3125,15 @@ class BlockServer:
                     "replay"
                 )
             elif (self.manager.has_parked(m.handle)
-                  or self.manager.has_adopted(m.handle)):
+                  or (not m.session.adoption_settled
+                      and self.manager.has_adopted(m.handle))):
                 # unparking inside a merged dispatch could OutOfPages the
                 # whole batch; alone, only this member wears the failure.
-                # An unsettled prefix adoption likewise needs the solo
-                # path: _compute_step drops it (skip 0) before computing
+                # An UNSETTLED prefix adoption likewise needs the solo
+                # path (_compute_step trims it to the declared skip before
+                # computing) — but only until its first step settles it:
+                # a settled adopted session batches like any other instead
+                # of soloing for the rest of its life
                 results[i] = self._solo_member_step(m)
             else:
                 ready.append(i)
@@ -3085,6 +3188,8 @@ class BlockServer:
         dt_ms = (time.perf_counter() - t0) * 1000.0
         self.batch_dispatches += 1
         self.batched_steps += len(group)
+        self.step_dispatches += 1
+        self.step_tokens += sum(m.handle.batch_size for m in group)
         if self._chunking_sessions:
             self.decode_steps_interleaved += len(group)
         if env.log_channel_enabled("timing"):
@@ -3099,6 +3204,173 @@ class BlockServer:
             b = m.handle.batch_size
             outs.append((out[row:row + b], dt_ms))
             row += b
+        return outs
+
+    # --------------------------------------------------- mixed-batch dispatch
+    def _mixed_compat(self, members: list, cand) -> bool:
+        """ComputeQueue group-membership predicate with --mixed-batch on:
+        decode steps ("decode1") and prefill chunks ("chunkm") may share
+        one ragged dispatch when their layers/adapter/dtype agree, with at
+        most ONE chunk per group (the ragged step models N decode rows +
+        one chunk row-group) and at most max_batch decode members (the
+        chunk rides the +1 group slot, never a decode seat). Any other
+        key kind falls back to exact-key coalescing."""
+        keys = [m.key for m in members]
+        mixable = ("decode1", "chunkm")
+        if cand.key[0] not in mixable or keys[0][0] not in mixable:
+            return cand.key == keys[0]
+        if any(k[1:4] != cand.key[1:4] for k in keys):
+            return False
+        kinds = [k[0] for k in keys]
+        if cand.key[0] == "chunkm":
+            return "chunkm" not in kinds
+        return kinds.count("decode1") < self.max_batch
+
+    def _compute_mixed_group(self, members: list) -> list:
+        """Runs on the compute thread: a group that may hold decode steps
+        AND one prefill chunk. Chunk-free groups take the classic merged-
+        decode path (identical outcomes to _compute_step_group); a lone
+        chunk runs the plain chunk step; a chunk plus decode members runs
+        as ONE ragged span dispatch, with row-by-row solo replay if the
+        fused dispatch fails so one member's fault never sinks its peers."""
+        results: list = [None] * len(members)
+        decode_idx: list[int] = []
+        chunk_idx: list[int] = []
+        for i, m in enumerate(members):
+            if not self.manager.epoch_valid(m.handle):
+                results[i] = SessionKVLost(
+                    "server KV arena was rebuilt; session cache lost — "
+                    "replay"
+                )
+            elif isinstance(m, _ChunkMember):
+                if (self.manager.has_parked(m.handle)
+                        or (m.first and self.manager.has_adopted(m.handle))):
+                    # unpark / adoption settle mutate the table mid-group;
+                    # the solo chunk path owns those side effects
+                    results[i] = self._solo_chunk_step(m)
+                else:
+                    chunk_idx.append(i)
+            elif (self.manager.has_parked(m.handle)
+                  or (not m.session.adoption_settled
+                      and self.manager.has_adopted(m.handle))):
+                # same solo carve-outs as _compute_step_group
+                results[i] = self._solo_member_step(m)
+            else:
+                decode_idx.append(i)
+        if not chunk_idx:
+            # no chunk in the group: exact _compute_step_group semantics
+            if len(decode_idx) == 1:
+                results[decode_idx[0]] = self._solo_member_step(
+                    members[decode_idx[0]]
+                )
+            elif decode_idx:
+                group = [members[i] for i in decode_idx]
+                try:
+                    outs = self._dispatch_batched(group)
+                except Exception as e:
+                    logger.warning(
+                        "batched decode of %d sessions failed (%r); "
+                        "replaying row-by-row", len(group), e,
+                    )
+                    outs = [self._solo_member_step(m) for m in group]
+                for i, out in zip(decode_idx, outs):
+                    results[i] = out
+            return results
+        if not decode_idx:
+            results[chunk_idx[0]] = self._solo_chunk_step(members[chunk_idx[0]])
+            return results
+        order = decode_idx + chunk_idx  # chunk member LAST
+        group = [members[i] for i in order]
+        try:
+            outs = self._dispatch_mixed(group)
+        except Exception as e:
+            logger.warning(
+                "mixed dispatch of %d decodes + 1 chunk failed (%r); "
+                "replaying solo", len(group) - 1, e,
+            )
+            outs = [
+                self._solo_chunk_step(m) if isinstance(m, _ChunkMember)
+                else self._solo_member_step(m)
+                for m in group
+            ]
+        for i, out in zip(order, outs):
+            results[i] = out
+        return results
+
+    def _solo_chunk_step(self, m: _ChunkMember):
+        try:
+            return self._compute_prefill_chunk(
+                m.session, m.handle, m.hidden, m.first, m.last,
+                m.prefix_skip,
+            )
+        except Exception as e:
+            return e
+
+    def _dispatch_mixed(self, group: list) -> list:
+        """ONE ragged span dispatch for >= 1 decode steps plus one prefill
+        chunk (the chunk is group[-1]). Every member's KV writes go in
+        speculatively; decode handles commit after the dispatch succeeds
+        and the chunk commits only on its stream's LAST chunk. On failure
+        the decodes roll back to their committed state while the chunk
+        handle is TRUNCATED to its pre-dispatch length — a plain rollback
+        would also discard the stream's earlier (still wanted) speculative
+        chunks — so the solo replays append no ghost tokens."""
+        import time
+
+        t0 = time.perf_counter()
+        now = time.monotonic()
+        for m in group:
+            m.session.last_step_at = now
+        chunk = group[-1]
+        decodes = group[:-1]
+        # pre-dispatch speculative lengths, the truncate target on failure
+        snaps = [int(x) for x in self.manager.context_lens(chunk.handle)]
+        try:
+            out, combined = self.executor.mixed_group(
+                [m.handle for m in group],
+                [m.hidden for m in group],
+                layers=group[0].session.layers,
+                adapter=group[0].session.adapter,
+            )
+        except Exception:
+            if self.manager.epoch_valid(chunk.handle):
+                self.manager.truncate_speculative(chunk.handle, snaps)
+            for m in decodes:
+                if self.manager.epoch_valid(m.handle):
+                    self.manager.rollback(m.handle)
+            raise
+        for m in decodes:
+            self.manager.commit(m.handle)
+        if chunk.last:
+            self.manager.commit(chunk.handle)
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        ntok = sum(
+            m.handle.batch_size * int(m.hidden.shape[1]) for m in group
+        )
+        self.mixed_dispatches += 1
+        self.mixed_tokens += ntok
+        self.step_dispatches += 1
+        self.step_tokens += ntok
+        # the decodes literally ran inside a mid-stream prefill's dispatch
+        self.decode_steps_interleaved += len(decodes)
+        if env.log_channel_enabled("timing"):
+            logger.info(
+                "[timing] mixed dispatch: %d decodes + %d-token chunk, "
+                "%d rows, dispatch_ms=%.2f",
+                len(decodes), int(chunk.hidden.shape[1]),
+                sum(m.handle.batch_size for m in group), dt_ms,
+            )
+        # slice the member-major token-packed [R, D] result back out:
+        # decode members get [b, 1, D], the chunk gets [b, t, D]
+        outs = []
+        off = 0
+        for m in group:
+            b = m.handle.batch_size
+            t = int(m.hidden.shape[1])
+            outs.append(
+                (out[off:off + b * t].reshape(b, t, -1), dt_ms)
+            )
+            off += b * t
         return outs
 
     def _reclaim_idle(self, need_pages: int, exclude_seq_ids: set) -> int:

@@ -105,7 +105,11 @@ class _GroupTask:
 
 
 class ComputeQueue:
-    def __init__(self, max_group: int = 8) -> None:
+    def __init__(
+        self,
+        max_group: int = 8,
+        compat: Callable[[list, "_GroupTask"], bool] | None = None,
+    ) -> None:
         self._queue: asyncio.PriorityQueue = asyncio.PriorityQueue()
         self._seq = itertools.count()
         self._thread = ThreadPoolExecutor(
@@ -113,6 +117,12 @@ class ComputeQueue:
         )
         self._worker_task: asyncio.Task | None = None
         self.max_group = max(1, int(max_group))
+        # group-membership predicate: compat(members_so_far, candidate).
+        # None = exact key equality, the classic same-shape decode
+        # coalescing. A custom predicate lets the server admit
+        # heterogeneous members into one dispatch (mixed decode+prefill
+        # batching) while still refusing cross-adapter/dtype mixes.
+        self.compat = compat
         # samples are (picked_up_at_monotonic, wait_s) so windowed readers
         # (admission control, load adverts) can discard old load regimes
         # instead of averaging over the whole 512-sample tail
@@ -278,13 +288,13 @@ class ComputeQueue:
 
     async def _run_group(self, loop, first: _GroupTask) -> None:
         members = [first]
-        members += self._gather(first.key, self.max_group - len(members))
+        self._gather(members, self.max_group - len(members))
         window_s = float(env.get("BBTPU_BATCH_WINDOW_MS")) / 1000.0
         if window_s > 0 and len(members) < self.max_group:
             # hold the device for one short window: steps of other sessions
             # in the same decode round are typically in flight right now
             await asyncio.sleep(window_s)
-            members += self._gather(first.key, self.max_group - len(members))
+            self._gather(members, self.max_group - len(members))
         try:
             live = []
             for m in members:
@@ -327,11 +337,22 @@ class ComputeQueue:
             else:
                 m.fut.set_result(out)
 
-    def _gather(self, key: Hashable, limit: int) -> list[_GroupTask]:
-        """Pull up to `limit` queued group tasks matching `key`; everything
-        else goes back with its original (priority, seq) so ordering is
-        untouched."""
-        taken: list[_GroupTask] = []
+    def _match(self, members: list[_GroupTask], task: _GroupTask) -> bool:
+        """Can `task` join the group gathered so far? Default: exact key
+        equality with the first member. A server-supplied `compat`
+        predicate sees the whole group, so it can enforce structural rules
+        (e.g. at most one prefill chunk per mixed dispatch)."""
+        if self.compat is not None:
+            return bool(self.compat(members, task))
+        return task.key == members[0].key
+
+    def _gather(self, members: list[_GroupTask], limit: int) -> None:
+        """Pull up to `limit` queued group tasks compatible with the group
+        gathered so far, appending them to `members` in place (each
+        admission may widen what the next candidate is matched against);
+        everything else goes back with its original (priority, seq) so
+        ordering is untouched."""
+        taken = 0
         keep: list = []
         while True:
             try:
@@ -340,17 +361,17 @@ class ComputeQueue:
                 break
             task = entry[2]
             if (
-                len(taken) < limit
+                taken < limit
                 and isinstance(task, _GroupTask)
-                and task.key == key
                 and not task.fut.cancelled()
+                and self._match(members, task)
             ):
-                taken.append(task)
+                members.append(task)
+                taken += 1
             else:
                 keep.append(entry)
         for entry in keep:
             self._queue.put_nowait(entry)
-        return taken
 
     def _note_wait(self, task) -> None:
         now = time.monotonic()

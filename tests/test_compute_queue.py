@@ -256,6 +256,50 @@ def test_group_keys_do_not_mix():
     asyncio.run(run())
 
 
+def test_compat_predicate_mixes_heterogeneous_keys():
+    """A custom compat(members, candidate) predicate admits members with
+    DIFFERENT keys into one dispatch (the mixed-batch hook): decode-keyed
+    members absorb one chunk-keyed member, a second chunk stays out, and
+    admission sees the members gathered so far (the predicate widens as
+    the group grows)."""
+
+    async def run():
+        def compat(members, cand):
+            # any number of "d" keys, at most one "c" key per group
+            if cand.key == "c":
+                return all(m.key != "c" for m in members)
+            return cand.key == "d"
+
+        q = ComputeQueue(max_group=8, compat=compat)
+        q.start()
+        calls = []
+
+        def run_group(payloads):
+            calls.append(sorted(payloads))
+            return payloads
+
+        gate, jam = _jam(q)
+        await asyncio.sleep(0.05)
+        ts = [
+            asyncio.create_task(
+                q.submit_group(PRIORITY_INFERENCE, key, payload, run_group)
+            )
+            for key, payload in (
+                ("d", "d0"), ("d", "d1"), ("c", "c0"), ("c", "c1"),
+            )
+        ]
+        await asyncio.sleep(0.05)
+        gate.set()
+        results = await asyncio.gather(jam, *ts)
+        assert results[1:] == ["d0", "d1", "c0", "c1"]
+        # first pop gathered both decodes AND one chunk; the second chunk
+        # was requeued and dispatched on its own
+        assert calls == [["c0", "d0", "d1"], ["c1"]]
+        await q.stop()
+
+    asyncio.run(run())
+
+
 def test_group_member_exception_is_scattered():
     """run_group returning an Exception instance for one member fails only
     that member's future; the rest resolve normally."""

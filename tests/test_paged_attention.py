@@ -689,3 +689,148 @@ def test_executor_tree_step_paged_matches_dense(monkeypatch):
         np.asarray(paged, np.float32), np.asarray(dense, np.float32),
         rtol=2e-4, atol=2e-4,
     )
+
+
+# ------------------------------------------------ ragged mixed-batch kernel
+def dense_ragged_reference(
+    q, k_slab, v_slab, page_table, lens, q_seq, q_pos, page_size, window=0
+):
+    """Row-by-row gather + masked softmax with the ragged kernel's exact
+    semantics: row i belongs to sequence q_seq[i] (>= B = padding, emits
+    zeros) and sees keys at positions <= q_pos[i] (within the window)."""
+    r, h, hd = q.shape
+    hkv = k_slab.shape[1]
+    g = h // hkv
+    b = page_table.shape[0]
+    out = np.zeros((r, h, hd), np.float32)
+    for i in range(r):
+        sq = int(q_seq[i])
+        if sq >= b:
+            continue
+        slots = [
+            p * page_size + o
+            for p in page_table[sq]
+            for o in range(page_size)
+        ]
+        k = k_slab[np.asarray(slots)]
+        v = v_slab[np.asarray(slots)]
+        n = k.shape[0]
+        pos = int(q_pos[i])
+        mask = np.arange(n) <= pos
+        if window > 0:
+            mask &= np.arange(n) > pos - window
+        for head in range(h):
+            kv = head // g
+            logits = (q[i, head].astype(np.float32) @
+                      k[:, kv].astype(np.float32).T) * hd**-0.5
+            logits = np.where(mask, logits, -1e30)
+            p_att = np.exp(logits - logits.max())
+            p_att = p_att / p_att.sum()
+            out[i, head] = p_att @ v[:, kv].astype(np.float32)
+    return out
+
+
+@pytest.mark.parametrize("seed,window", [(0, 0), (1, 0), (2, 9), (3, 0)])
+def test_paged_ragged_matches_dense_and_sibling_kernels(seed, window):
+    """The parity gate for the mixed-batch kernel on RANDOMIZED ragged
+    shapes (N decode rows + one multi-token chunk group + bucket-padding
+    rows): paged_ragged_attention must match (a) the dense reference,
+    (b) paged_decode_attention on the decode rows, and (c)
+    paged_chunk_attention on the chunk member — the three paths a mixed
+    group's members would otherwise take. Padding rows emit exact zeros."""
+    from bloombee_tpu.ops.pallas.paged_attention import (
+        paged_chunk_attention,
+        paged_ragged_attention,
+    )
+
+    rng = np.random.default_rng(seed)
+    page_size = int(rng.choice([8, 16]))
+    hkv = int(rng.choice([1, 2]))
+    h = hkv * int(rng.choice([2, 4]))
+    hd = 64
+    b = int(rng.integers(2, 5))
+    max_pages = 4
+    lens = rng.integers(
+        6, page_size * max_pages + 1, size=b
+    ).astype(np.int32)
+    # disjoint shuffled physical pages per sequence; table padding = 0
+    n_phys = b * max_pages + 2
+    pool = rng.permutation(n_phys)
+    page_table = np.zeros((b, max_pages), np.int32)
+    off = 0
+    for i in range(b):
+        need = -(-int(lens[i]) // page_size)
+        page_table[i, :need] = pool[off:off + need]
+        off += need
+    k_slab = rng.standard_normal(
+        (n_phys * page_size, hkv, hd)
+    ).astype(np.float32)
+    v_slab = rng.standard_normal(
+        (n_phys * page_size, hkv, hd)
+    ).astype(np.float32)
+
+    # ragged rows: every sequence but one contributes a single decode row
+    # (pos = len-1); sequence `c` contributes a t-token chunk; then padding
+    c = int(rng.integers(0, b))
+    t = int(rng.integers(2, min(6, int(lens[c])) + 1))
+    q_seq, q_pos = [], []
+    for i in range(b):
+        if i == c:
+            q_seq.extend([c] * t)
+            q_pos.extend(range(int(lens[c]) - t, int(lens[c])))
+        else:
+            q_seq.append(i)
+            q_pos.append(int(lens[i]) - 1)
+    n_pad = int(rng.integers(0, 3))
+    q_seq.extend([b] * n_pad)
+    q_pos.extend([0] * n_pad)
+    q_seq = np.asarray(q_seq, np.int32)
+    q_pos = np.asarray(q_pos, np.int32)
+    r = len(q_seq)
+    q = rng.standard_normal((r, h, hd)).astype(np.float32)
+
+    got = np.asarray(
+        paged_ragged_attention(
+            jnp.asarray(q), jnp.asarray(k_slab), jnp.asarray(v_slab),
+            jnp.asarray(page_table), jnp.asarray(lens),
+            jnp.asarray(q_seq), jnp.asarray(q_pos),
+            page_size=page_size, interpret=True, window=window,
+        )
+    )
+    want = dense_ragged_reference(
+        q, k_slab, v_slab, page_table, lens, q_seq, q_pos, page_size,
+        window=window,
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    if n_pad:
+        np.testing.assert_array_equal(got[r - n_pad:], 0.0)
+
+    # (b) the decode rows match the single-token decode kernel
+    dec_rows = [i for i in range(r - n_pad) if int(q_seq[i]) != c]
+    dec_seqs = [int(q_seq[i]) for i in dec_rows]
+    if dec_rows:
+        dec_got = np.asarray(
+            paged_decode_attention(
+                jnp.asarray(q[dec_rows]), jnp.asarray(k_slab),
+                jnp.asarray(v_slab), jnp.asarray(page_table[dec_seqs]),
+                jnp.asarray(lens[dec_seqs]), page_size=page_size,
+                interpret=True, window=window,
+            )
+        )
+        np.testing.assert_allclose(
+            got[dec_rows], dec_got, rtol=2e-5, atol=2e-5
+        )
+
+    # (c) the chunk member matches the multi-token chunk kernel
+    chunk_rows = [i for i in range(r - n_pad) if int(q_seq[i]) == c]
+    chunk_got = np.asarray(
+        paged_chunk_attention(
+            jnp.asarray(q[chunk_rows])[None], jnp.asarray(k_slab),
+            jnp.asarray(v_slab), jnp.asarray(page_table[c:c + 1]),
+            jnp.asarray(lens[c:c + 1]), page_size=page_size,
+            interpret=True, window=window,
+        )
+    )
+    np.testing.assert_allclose(
+        got[chunk_rows], chunk_got[0], rtol=2e-5, atol=2e-5
+    )

@@ -462,6 +462,122 @@ def test_prefix_adopt_cow_and_eviction():
     t.drop_seq(2)
 
 
+def test_combine_handles_ragged_write_fuzz():
+    """Property-fuzz the mixed-batch KV path: a combined handle over
+    several live sessions takes HETEROGENEOUS per-sequence token counts
+    (decode members write 1, the chunk member writes many) through
+    write_slots_ragged, then randomly commits or truncate_speculative's
+    back to the pre-dispatch snapshot. After every round the per-sequence
+    lengths match a list-based model, the flat slots are sequence-major
+    and agree with the table's own range mapping, no page is double-owned,
+    and a failed (OutOfPages) ragged write mutates NOTHING."""
+    import asyncio
+    import contextlib
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.kv.cache_manager import CacheManager
+    from bloombee_tpu.kv.paged import OutOfPages
+
+    async def run():
+        rng = np.random.default_rng(21)
+        for trial in range(6):
+            page_size = int(rng.integers(2, 6))
+            # admission must always fit the 3 handles (up to 6 seqs of 16
+            # tokens, page-rounded) or allocate() blocks forever; the writes
+            # below still exhaust pages to hit the OutOfPages branch
+            num_pages = 6 * (-(-16 // page_size)) + int(rng.integers(0, 8))
+            manager = CacheManager(
+                num_layers=1, num_pages=num_pages, page_size=page_size,
+                n_kv_heads=1, head_dim=8, dtype=jnp.float32,
+            )
+            async with contextlib.AsyncExitStack() as stack:
+                handles = [
+                    await stack.enter_async_context(
+                        manager.allocate(int(rng.integers(1, 3)), 16,
+                                         timeout=10)
+                    )
+                    for _ in range(3)
+                ]
+                combined = manager.combine_handles(handles)
+                assert combined.seq_ids == [
+                    sid for h in handles for sid in h.seq_ids
+                ]
+                n = len(combined.seq_ids)
+                table = manager.table
+                model = {sid: [0, 0] for sid in combined.seq_ids}
+                for _ in range(30):
+                    snap = [model[sid][1] for sid in combined.seq_ids]
+                    if rng.integers(0, 2):
+                        # mixed-batch shape: all decodes + one fat chunk
+                        counts = [1] * n
+                        counts[int(rng.integers(0, n))] = int(
+                            rng.integers(2, 3 * page_size)
+                        )
+                    else:
+                        counts = [
+                            int(c) for c in rng.integers(1, 6, size=n)
+                        ]
+                    before_free = table.free_pages
+                    try:
+                        slots = manager.write_slots_ragged(
+                            combined, counts, commit=False
+                        )
+                    except OutOfPages:
+                        # atomicity: a failed ragged write claims nothing
+                        assert table.free_pages == before_free
+                        for sid in combined.seq_ids:
+                            st = table.seq(sid)
+                            assert [st.l_acc, st.l_seq] == model[sid]
+                        manager.truncate_speculative(
+                            combined,
+                            [model[sid][0] for sid in combined.seq_ids],
+                        )
+                        for sid in combined.seq_ids:
+                            model[sid][1] = model[sid][0]
+                        continue
+                    assert len(slots) == sum(counts)
+                    # sequence-major flat slots match the table's own
+                    # per-sequence range mapping
+                    off = 0
+                    for sid, c in zip(combined.seq_ids, counts):
+                        old = model[sid][1]
+                        np.testing.assert_array_equal(
+                            slots[off:off + c],
+                            table.range_slots(sid, old, old + c),
+                        )
+                        model[sid][1] = old + c
+                        off += c
+                    action = rng.integers(0, 3)
+                    if action == 0:  # dispatch succeeded: commit all
+                        manager.commit(combined)
+                        for sid in combined.seq_ids:
+                            model[sid][0] = model[sid][1]
+                    elif action == 1:  # dispatch failed: undo THIS write
+                        manager.truncate_speculative(combined, snap)
+                        for sid, ln in zip(combined.seq_ids, snap):
+                            model[sid][1] = ln
+                    # action == 2: leave speculative (mid-stream chunks)
+                    np.testing.assert_array_equal(
+                        manager.context_lens(combined),
+                        [model[sid][1] for sid in combined.seq_ids],
+                    )
+                    np.testing.assert_array_equal(
+                        manager.context_lens(combined, committed_only=True),
+                        [model[sid][0] for sid in combined.seq_ids],
+                    )
+                    owned = [
+                        p for sid in combined.seq_ids
+                        for p in table.seq(sid).pages
+                    ]
+                    assert len(owned) == len(set(owned)), (trial, owned)
+                    assert len(owned) + table.free_pages == num_pages
+            # allocate() exit freed everything
+            assert manager.table.free_pages == num_pages, trial
+
+    asyncio.run(run())
+
+
 def test_native_table_bit_identical_to_python():
     """The C++ table must be BIT-IDENTICAL to the Python table across random
     op sequences (same LIFO free-list order => same slots)."""
@@ -484,7 +600,7 @@ def test_native_table_bit_identical_to_python():
         for _ in range(300):
             op = rng.choice(
                 ["add", "write", "commit", "commit_len", "rollback",
-                 "accept", "drop"]
+                 "accept", "truncate", "drop"]
             )
             if op == "add" or not sids:
                 py.add_seq(next_sid)
@@ -527,6 +643,13 @@ def test_native_table_bit_identical_to_python():
                     k = int(rng.integers(0, spec + 1))
                     py.accept(sid, k)
                     cc.accept(sid, k)
+            elif op == "truncate":
+                # partial rollback (mixed-dispatch failure recovery): drop
+                # spec tokens past a snapshot length, keep the ones below
+                st = py.seq(sid)
+                ln = int(rng.integers(st.l_acc, st.l_seq + 1))
+                py.truncate_speculative(sid, ln)
+                cc.truncate_speculative(sid, ln)
             elif op == "drop":
                 py.drop_seq(sid)
                 cc.drop_seq(sid)
