@@ -29,6 +29,8 @@ import time
 
 import numpy as np
 
+from bloombee_tpu.utils import env
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -275,7 +277,7 @@ def _emit_json_locked():
 def start_watchdog():
     """Emit whatever has been measured and exit 0 if the run exceeds the
     deadline (a wedged PJRT transfer cannot be interrupted, only abandoned)."""
-    deadline_s = float(os.environ.get("BBTPU_BENCH_DEADLINE_S", "1500"))
+    deadline_s = float(env.get("BBTPU_BENCH_DEADLINE_S"))
 
     def watch():
         if not _DONE.wait(deadline_s):
@@ -309,7 +311,7 @@ def _require_backend():
     (round-4 verdict #1)."""
     import subprocess
 
-    deadline_s = float(os.environ.get("BBTPU_BENCH_DEADLINE_S", "1500"))
+    deadline_s = float(env.get("BBTPU_BENCH_DEADLINE_S"))
     # probe for up to half the deadline (an explicit long deadline means
     # "ride out the outage" — honor it), but always leave ~700s so the
     # CPU-smoke fallback can complete its phase ledger
@@ -394,9 +396,7 @@ def main():
     from bloombee_tpu.utils.tree import stack_params
 
     # one span = 8 of Llama-3-8B's 32 layers
-    smoke = os.environ.get("BBTPU_BENCH_SMOKE", "").strip().lower() not in (
-        "", "0", "false", "no",
-    )
+    smoke = bool(env.get("BBTPU_BENCH_SMOKE"))
     span_layers, total_layers = 8, 32
     spec = ModelSpec(
         family="llama",
@@ -1786,7 +1786,10 @@ def run_served(spec, params, B, PREFILL, DECODE, spans_per_model) -> dict:
         # captures the win.
         if not wedged:
             server_cb = None
-            old_window = os.environ.get("BBTPU_BATCH_WINDOW_MS")
+            # raw read on purpose: saving the unparsed string to restore
+            # after the temporary override below, not reading config
+            old_window = os.environ.get(
+                "BBTPU_BATCH_WINDOW_MS")  # bbtpu: noqa[BB005]
             try:
                 os.environ["BBTPU_BATCH_WINDOW_MS"] = "4"
                 server_cb = BlockServer(

@@ -1,0 +1,11 @@
+"""bbtpu-lint: project-specific AST static analysis (rules BB001–BB006).
+
+Run via `python -m bloombee_tpu.analysis` or `scripts/analyze.sh`; the
+invariants each rule guards are documented in ARCHITECTURE.md
+("Invariants") and in bloombee_tpu/analysis/rules.py.
+"""
+
+from bloombee_tpu.analysis.core import Finding, analyze_source
+from bloombee_tpu.analysis.rules import ALL_CODES, make_rules
+
+__all__ = ["Finding", "analyze_source", "make_rules", "ALL_CODES"]

@@ -1,0 +1,209 @@
+"""bbtpu-lint core: file loading, suppressions, baseline, and the runner.
+
+Eight PRs in, the hard bugs in this repro are protocol-discipline bugs —
+speculative-write/commit/rollback pairing, lock discipline around device
+dispatch, wire-field version filtering, the env.declare registry — none of
+which ruff can see. This package is an AST-based checker with project-
+specific rules (BB0xx codes, bloombee_tpu/analysis/rules.py) that encode
+those invariants so they are enforced by CI instead of by memory.
+
+Mechanics (all pure stdlib — the lint itself must never import jax):
+
+- suppressions: ``# bbtpu: noqa[BB001]`` (or ``noqa[BB001,BB005]``, or a
+  bare ``noqa`` for every code) on any physical line of the flagged
+  statement silences that finding. Suppressions are for sites where the
+  invariant is deliberately delegated (e.g. a speculative step whose
+  rollback is owned by the calling stream driver) — the comment next to
+  the noqa must say who owns it.
+- baseline: a committed file of finding fingerprints
+  (bloombee_tpu/analysis/baseline.txt). Findings in the baseline don't
+  fail the gate; NEW findings do. Fingerprints hash the stripped source
+  line (not the line number), so unrelated edits above a baselined
+  finding don't invalidate it. ``--update-baseline`` rewrites the file
+  from the current tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import re
+from pathlib import Path
+
+NOQA_RE = re.compile(
+    r"#\s*bbtpu:\s*noqa(?:\s*\[\s*([A-Z0-9_,\s]+?)\s*\])?",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str  # rule id, e.g. "BB001"
+    path: str  # repo-relative posix path
+    line: int  # 1-based line of the offending node
+    message: str
+    snippet: str = ""  # stripped source line (fingerprint input)
+
+    def fingerprint(self) -> str:
+        """Line-number-independent identity: a baselined finding survives
+        edits elsewhere in the file but is invalidated the moment its own
+        line changes (which is when a human should re-look at it)."""
+        h = hashlib.sha1(
+            f"{self.path}::{self.code}::{self.snippet}".encode()
+        ).hexdigest()
+        return h[:12]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+class SourceFile:
+    """One parsed file plus its suppression map."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path  # repo-relative posix
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # lineno -> set of suppressed codes (None = every code)
+        self.noqa: dict[int, set[str] | None] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = NOQA_RE.search(line)
+            if not m:
+                continue
+            codes = m.group(1)
+            if codes is None:
+                self.noqa[i] = None
+            else:
+                self.noqa[i] = {
+                    c.strip() for c in codes.split(",") if c.strip()
+                }
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, code: str, lineno: int, end_lineno: int) -> bool:
+        for ln in range(lineno, (end_lineno or lineno) + 1):
+            codes = self.noqa.get(ln, "missing")
+            if codes is None:
+                return True
+            if codes != "missing" and code in codes:
+                return True
+        return False
+
+    def finding(self, code: str, node: ast.AST, message: str):
+        """Build a Finding for `node`, honoring noqa. Returns None when
+        the site is suppressed."""
+        lineno = getattr(node, "lineno", 1)
+        end = getattr(node, "end_lineno", lineno) or lineno
+        if self.suppressed(code, lineno, end):
+            return None
+        return Finding(
+            code=code,
+            path=self.path,
+            line=lineno,
+            message=message,
+            snippet=self.line_text(lineno),
+        )
+
+
+def iter_py_files(root: Path, paths: list[str]) -> list[Path]:
+    """Expand CLI path arguments into .py files (sorted, deduped)."""
+    out: set[Path] = set()
+    for p in paths:
+        fp = (root / p).resolve() if not Path(p).is_absolute() else Path(p)
+        if fp.is_dir():
+            out.update(fp.rglob("*.py"))
+        elif fp.suffix == ".py" and fp.exists():
+            out.add(fp)
+    return sorted(out)
+
+
+def load_source_files(
+    root: Path, paths: list[str]
+) -> tuple[list[SourceFile], list[Finding]]:
+    """Parse every target file; unparsable files become findings instead
+    of crashing the gate (ruff owns syntax, but a half-written file must
+    not make the invariant gate vacuously pass)."""
+    files: list[SourceFile] = []
+    errors: list[Finding] = []
+    for fp in iter_py_files(root, paths):
+        rel = fp.relative_to(root).as_posix() if fp.is_relative_to(
+            root
+        ) else fp.as_posix()
+        text = fp.read_text(encoding="utf-8")
+        try:
+            files.append(SourceFile(rel, text))
+        except SyntaxError as e:
+            errors.append(
+                Finding(
+                    code="BB000",
+                    path=rel,
+                    line=int(e.lineno or 1),
+                    message=f"file does not parse: {e.msg}",
+                    snippet="",
+                )
+            )
+    return files, errors
+
+
+# ------------------------------------------------------------------ baseline
+def load_baseline(path: Path) -> set[str]:
+    """Fingerprint set from a baseline file. Lines are
+    ``<fingerprint>  # free-text comment``; blank lines and pure-comment
+    lines are ignored, so an 'empty-or-commented' baseline stays legal."""
+    if not path.exists():
+        return set()
+    fps: set[str] = set()
+    for raw in path.read_text(encoding="utf-8").splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        fps.add(line.split()[0])
+    return fps
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    """Rewrite the baseline from the current findings, one commented line
+    per entry so a reviewer can see WHAT was baselined without chasing
+    fingerprints."""
+    lines = [
+        "# bbtpu-lint baseline — accepted legacy findings.",
+        "# Regenerate with: scripts/analyze.sh --update-baseline",
+        "# Every entry MUST carry a justification comment; prefer an",
+        "# inline `# bbtpu: noqa[BBxxx]` (visible at the site) for",
+        "# deliberate invariant delegations and keep this file for",
+        "# legacy findings awaiting a real fix.",
+        "",
+    ]
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.code)):
+        lines.append(f"{f.fingerprint()}  # {f.render()}")
+    path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+
+
+# -------------------------------------------------------------------- runner
+def run_rules(
+    files: list[SourceFile], rules: list
+) -> list[Finding]:
+    """Per-file pass then cross-file finalize (BB004/BB006 correlate
+    declarations in one file with surfacing in another)."""
+    findings: list[Finding] = []
+    for rule in rules:
+        for sf in files:
+            findings.extend(rule.visit_file(sf))
+    for rule in rules:
+        findings.extend(rule.finalize())
+    return findings
+
+
+def analyze_source(
+    sources: dict[str, str], rules: list | None = None
+) -> list[Finding]:
+    """Run rules over in-memory sources ({relpath: text}) — the fixture
+    entry point tests/test_analysis.py drives."""
+    from bloombee_tpu.analysis.rules import make_rules
+
+    files = [SourceFile(p, t) for p, t in sources.items()]
+    return run_rules(files, make_rules() if rules is None else rules)

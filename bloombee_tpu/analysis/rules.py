@@ -1,0 +1,538 @@
+"""bbtpu-lint rules BB001–BB006.
+
+Each rule encodes one invariant this codebase has already been burned by
+(see ARCHITECTURE.md "Invariants"). Rules are plugin classes over the
+shared SourceFile from core.py: per-file `visit_file` plus a cross-file
+`finalize` for rules that correlate a declaration in one file with its
+surfacing in another (BB006) or need nothing global (most).
+
+Rule-authoring contract: a rule must be cheap (pure ast walk), must
+build findings via ``sf.finding(...)`` so `# bbtpu: noqa[...]` works,
+and must prefer missing a contorted true positive over spamming false
+positives — the gate is only useful while `scripts/analyze.sh` exits 0
+on a healthy tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from bloombee_tpu.analysis.core import Finding, SourceFile
+
+_STRINGS_RE = re.compile(r"'[^']*'|\"[^\"]*\"")
+
+
+def _call_name(node: ast.Call) -> str:
+    """Trailing name of the called thing: `a.b.write_slots(...)` ->
+    'write_slots', `rollback(...)` -> 'rollback'."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _mentions_lock(expr: ast.AST) -> bool:
+    """'lock' appears in the expression's code, not inside a string
+    literal (`open(".evict.lock")` is a file, not a mutex)."""
+    text = _STRINGS_RE.sub("", _expr_text(expr))
+    return "lock" in text.lower()
+
+
+def _is_locked_decorated(fn: ast.AST) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    return any("_locked" in _expr_text(d) for d in fn.decorator_list)
+
+
+class Rule:
+    code = "BB000"
+    name = "base"
+    summary = ""
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        return []
+
+    def finalize(self) -> list[Finding]:
+        return []
+
+
+class SpeculativeWriteRule(Rule):
+    """BB001: a speculative KV mutation must be dominated by a try whose
+    handlers/finally reach rollback/truncate_speculative.
+
+    Motivated by PR 8: a failed mixed dispatch that plain-rollback'd the
+    fused handle destroyed prefill chunks committed by EARLIER chunks —
+    the fix (truncate_speculative) only exists because someone noticed.
+    Sites that deliberately delegate recovery to their caller (the
+    stream driver owns the handle's lifecycle) carry
+    `# bbtpu: noqa[BB001]` with a comment naming the owner.
+    """
+
+    code = "BB001"
+    name = "speculative-write-unprotected"
+    summary = (
+        "speculative KV mutation not dominated by a try reaching "
+        "rollback/truncate_speculative"
+    )
+
+    # These mutate KV speculatively no matter how they're called.
+    ALWAYS = {"append_speculative", "decode_group", "mixed_group"}
+    # These are speculative only when explicitly called commit=False
+    # (a literal False keyword; `commit=commit` pass-through is the
+    # callee's own contract and stays quiet).
+    WHEN_COMMIT_FALSE = {
+        "write_slots",
+        "write_slots_ragged",
+        "assign_write_slots",
+        "prefill",
+        "prefill_chunk",
+        "prefill_chunked",
+        "decode",
+        "decode_n",
+        "step",
+        "_step",
+        "_step_once",
+    }
+    RECOVERY = {
+        "commit",
+        "rollback",
+        "truncate_speculative",
+        "rollback_if_valid",
+        "_rollback_if_valid",
+        "abort_chunked_prefill",
+        "_abort_chunked_prefill",
+    }
+
+    def _is_speculative(self, node: ast.Call) -> bool:
+        name = _call_name(node)
+        if name in self.ALWAYS:
+            return True
+        if name not in self.WHEN_COMMIT_FALSE:
+            return False
+        return any(
+            kw.arg == "commit"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False
+            for kw in node.keywords
+        )
+
+    def _has_recovery(self, stmts: list[ast.stmt]) -> bool:
+        for stmt in stmts:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call) and (
+                    _call_name(n) in self.RECOVERY
+                ):
+                    return True
+        return False
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        # id()-sets of every node inside a try body whose failure path
+        # (handlers or finally) reaches a recovery call
+        guarded: list[set[int]] = []
+        for t in ast.walk(sf.tree):
+            if not isinstance(t, ast.Try):
+                continue
+            recovery_stmts: list[ast.stmt] = list(t.finalbody)
+            for h in t.handlers:
+                recovery_stmts.extend(h.body)
+            if not self._has_recovery(recovery_stmts):
+                continue
+            guarded.append(
+                {
+                    id(x)
+                    for stmt in t.body
+                    for x in ast.walk(stmt)
+                }
+            )
+        out = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._is_speculative(node):
+                continue
+            if any(id(node) in g for g in guarded):
+                continue
+            f = sf.finding(
+                self.code,
+                node,
+                f"speculative KV write `{_call_name(node)}(...)` is not "
+                "dominated by a try whose handlers reach "
+                "rollback/truncate_speculative; wrap it, or mark the "
+                "recovery owner with `# bbtpu: noqa[BB001]`",
+            )
+            if f:
+                out.append(f)
+        return out
+
+
+class BlockingUnderLockRule(Rule):
+    """BB002: no blocking call while a threading lock is held.
+
+    CacheManager serializes on one RLock (`@_locked`); a recv/sleep/
+    future-result/device-sync inside it stalls every session on the
+    server, which is exactly the head-of-line blocking PR 5/8 spent two
+    PRs removing from the dispatch path. asyncio locks are out of scope
+    (they don't pin a thread).
+    """
+
+    code = "BB002"
+    name = "blocking-call-under-lock"
+    summary = "blocking call while a threading lock is held"
+
+    BLOCKING_ATTRS = {
+        "sleep",
+        "recv",
+        "result",
+        "block_until_ready",
+        "resolve",
+    }
+
+    def _is_blocking(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in self.BLOCKING_ATTRS:
+                return True
+            # device dispatch through the executor is a synchronous
+            # multi-ms device round-trip
+            if "executor" in _STRINGS_RE.sub("", _expr_text(f.value)):
+                return True
+        return False
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+
+        def walk(node: ast.AST, depth: int) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def's body doesn't run under the outer lock
+                inner = 1 if _is_locked_decorated(node) else 0
+                for child in ast.iter_child_nodes(node):
+                    walk(child, inner)
+                return
+            d = depth
+            if isinstance(node, ast.With):  # sync only, not AsyncWith
+                if any(
+                    _mentions_lock(item.context_expr)
+                    for item in node.items
+                ):
+                    d = depth + 1
+            if (
+                depth > 0
+                and isinstance(node, ast.Call)
+                and self._is_blocking(node)
+            ):
+                f = sf.finding(
+                    self.code,
+                    node,
+                    f"blocking call `{_expr_text(node.func)}(...)` while "
+                    "a threading lock is held stalls every thread "
+                    "contending for it; move it outside the lock",
+                )
+                if f:
+                    out.append(f)
+            for child in ast.iter_child_nodes(node):
+                walk(child, d)
+
+        walk(sf.tree, 0)
+        return out
+
+
+class LockOrderRule(Rule):
+    """BB003: locks must be acquired in the declared hierarchy order
+    cache_manager(0) -> paged table(1) -> compute queue(2).
+
+    Acquiring a lower-numbered lock while holding a higher-numbered one
+    is the classic ABBA deadlock setup; the ordering matches the call
+    direction the code actually uses (manager methods reach into the
+    table, never the reverse).
+    """
+
+    code = "BB003"
+    name = "lock-order-violation"
+    summary = "lock acquired against the declared hierarchy"
+
+    HIERARCHY = ("cache_manager", "paged table", "compute queue")
+
+    def _level(self, sf: SourceFile, expr: ast.AST) -> int | None:
+        """Classify a with-context expression into a hierarchy level, or
+        None when it isn't a recognized lock."""
+        text = _STRINGS_RE.sub("", _expr_text(expr)).lower()
+        if "lock" not in text:
+            return None
+        if "manager" in text or "cache" in text:
+            return 0
+        if "table" in text or "paged" in text:
+            return 1
+        if "compute" in text or "queue" in text:
+            return 2
+        if text == "self._lock" and sf.path.endswith("kv/cache_manager.py"):
+            return 0
+        return None
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        in_cm = sf.path.endswith("kv/cache_manager.py")
+
+        def walk(node: ast.AST, held: list[int]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # @_locked methods run with the cache_manager lock held
+                inner = [0] if (in_cm and _is_locked_decorated(node)) else []
+                for child in ast.iter_child_nodes(node):
+                    walk(child, inner)
+                return
+            h = held
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lvl = self._level(sf, item.context_expr)
+                    if lvl is None:
+                        continue
+                    worst = max((x for x in h if x > lvl), default=None)
+                    if worst is not None:
+                        f = sf.finding(
+                            self.code,
+                            node,
+                            f"acquires {self.HIERARCHY[lvl]} lock while "
+                            f"holding {self.HIERARCHY[worst]} lock; "
+                            "declared order is "
+                            f"{' -> '.join(self.HIERARCHY)}",
+                        )
+                        if f:
+                            out.append(f)
+                    h = h + [lvl]
+            for child in ast.iter_child_nodes(node):
+                walk(child, h)
+
+        walk(sf.tree, [])
+        return out
+
+
+class WireCompatRule(Rule):
+    """BB004: a wire dataclass whose `from_wire` splats the wire dict
+    into the constructor must (a) filter unknown keys through
+    dataclasses.fields and (b) default every field.
+
+    PR 6's compat story in one rule: (a) lets an OLD server accept a
+    NEW peer's dict (unknown fields dropped), (b) lets a NEW server
+    accept an OLD peer's dict (missing fields defaulted). from_wire
+    bodies that construct field-by-field (TensorMeta) opt out of the
+    splat pattern and are trusted to handle versioning manually.
+    """
+
+    code = "BB004"
+    name = "wire-field-compat"
+    summary = "wire dataclass field without from_wire filter or default"
+
+    def _is_dataclass(self, cls: ast.ClassDef) -> bool:
+        for d in cls.decorator_list:
+            if "dataclass" in _expr_text(d):
+                return True
+        return False
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not self._is_dataclass(cls):
+                continue
+            fw = next(
+                (
+                    n
+                    for n in cls.body
+                    if isinstance(
+                        n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    )
+                    and n.name == "from_wire"
+                ),
+                None,
+            )
+            if fw is None:
+                continue
+            splat = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id == "cls"
+                and any(kw.arg is None for kw in n.keywords)
+                for n in ast.walk(fw)
+            )
+            if not splat:
+                continue
+            filtered = any(
+                isinstance(n, ast.Call) and _call_name(n) == "fields"
+                for n in ast.walk(fw)
+            )
+            if not filtered:
+                f = sf.finding(
+                    self.code,
+                    fw,
+                    f"{cls.name}.from_wire splats the wire dict into "
+                    "cls(**...) without a dataclasses.fields filter; "
+                    "a newer peer's unknown field will crash this "
+                    "version",
+                )
+                if f:
+                    out.append(f)
+            for stmt in cls.body:
+                if (
+                    isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and stmt.value is None
+                    and not stmt.target.id.startswith("_")
+                ):
+                    f = sf.finding(
+                        self.code,
+                        stmt,
+                        f"wire field {cls.name}.{stmt.target.id} has no "
+                        "default; an older peer's dict that lacks it "
+                        "will crash from_wire",
+                    )
+                    if f:
+                        out.append(f)
+        return out
+
+
+class EnvRegistryRule(Rule):
+    """BB005: every BBTPU_* switch is read through utils/env.get, never
+    raw os.environ/getenv.
+
+    The registry is what makes `cli/health --switches` and the README
+    table authoritative; a raw read is an undocumented switch with no
+    type coercion and no default in one place. Raw WRITES (tests and
+    bench save/set/restore) are out of scope.
+    """
+
+    code = "BB005"
+    name = "env-read-bypasses-registry"
+    summary = "raw os.environ/getenv read of a BBTPU_* switch"
+
+    def _bbtpu_key(self, node: ast.AST) -> str | None:
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value.startswith("BBTPU_")
+        ):
+            return node.value
+        return None
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        if sf.path.endswith("utils/env.py"):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            key = None
+            if isinstance(node, ast.Call) and node.args:
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "get"
+                    and _expr_text(f.value).endswith("environ")
+                ):
+                    key = self._bbtpu_key(node.args[0])
+                elif _call_name(node) == "getenv":
+                    key = self._bbtpu_key(node.args[0])
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if _expr_text(node.value).endswith("environ"):
+                    key = self._bbtpu_key(node.slice)
+            if key is None:
+                continue
+            f = sf.finding(
+                self.code,
+                node,
+                f"raw environment read of {key} bypasses "
+                "utils/env.declare; declare the switch and read it "
+                "via env.get",
+            )
+            if f:
+                out.append(f)
+        return out
+
+
+class CounterSurfacingRule(Rule):
+    """BB006: a counter incremented in server/kv code must be surfaced —
+    its name must appear as a string literal somewhere in the scanned
+    tree (rpc_info dict key, health --probe key, stats() dict).
+
+    A counter nobody can read is debugging theater: PR 4/5/8 each
+    shipped counters precisely so operators can see replication lag /
+    chunking / fusing without log access. Private bookkeeping escapes
+    with a leading underscore.
+    """
+
+    code = "BB006"
+    name = "counter-not-surfaced"
+    summary = "server counter never surfaced via rpc_info/health"
+
+    def __init__(self):
+        # name -> (SourceFile, node) of the first increment site
+        self.counters: dict[str, tuple[SourceFile, ast.AST]] = {}
+        self.surfaced: set[str] = set()
+
+    SCOPES = ("/server/", "/kv/", "server/", "kv/")
+
+    def _in_scope(self, path: str) -> bool:
+        return "/server/" in path or "/kv/" in path or path.startswith(
+            ("server/", "kv/")
+        )
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ):
+                self.surfaced.add(node.value)
+        if self._in_scope(sf.path):
+            for node in ast.walk(sf.tree):
+                if (
+                    isinstance(node, ast.AugAssign)
+                    and isinstance(node.target, ast.Attribute)
+                    and isinstance(node.target.value, ast.Name)
+                    and node.target.value.id == "self"
+                    and not node.target.attr.startswith("_")
+                ):
+                    self.counters.setdefault(
+                        node.target.attr, (sf, node)
+                    )
+        return []
+
+    def finalize(self) -> list[Finding]:
+        out = []
+        for name, (sf, node) in sorted(self.counters.items()):
+            if name in self.surfaced:
+                continue
+            f = sf.finding(
+                self.code,
+                node,
+                f"counter `self.{name}` is incremented in server code "
+                "but never surfaced (no string literal names it in "
+                "rpc_info / health --probe / stats()); surface it or "
+                "prefix it with `_`",
+            )
+            if f:
+                out.append(f)
+        return out
+
+
+def make_rules() -> list[Rule]:
+    """Fresh rule instances (BB006 keeps cross-file state)."""
+    return [
+        SpeculativeWriteRule(),
+        BlockingUnderLockRule(),
+        LockOrderRule(),
+        WireCompatRule(),
+        EnvRegistryRule(),
+        CounterSurfacingRule(),
+    ]
+
+
+ALL_CODES = tuple(r.code for r in make_rules())

@@ -82,6 +82,10 @@ def main(argv=None):
                         line += "  " + " ".join(
                             f"{k}={v}" for k, v in sorted(repl.items())
                         )
+                    # nonzero arena_epoch = donated-arena self-heal
+                    # events; sessions lost KV and had to replay
+                    if probe.get("arena_epoch"):
+                        line += f"  arena_epoch={probe['arena_epoch']}"
                     # stall-free scheduling counters: is chunked prefill
                     # firing, and are decode steps actually landing
                     # between chunks
@@ -106,6 +110,8 @@ def main(argv=None):
                         for k in (
                             "mixed_dispatches",
                             "mixed_tokens",
+                            "step_dispatches",
+                            "step_tokens",
                         )
                         if probe.get(k)
                     }

@@ -1333,7 +1333,9 @@ class InferenceSession:
                         [list(r) for r in self._id_rows]
                     )
                 replay = self.embed_fn(padded)
-                await self._step_once(
+                # recovery owner: commit_lens commits server-side within
+                # this same step; a failed replay just re-runs failover
+                await self._step_once(  # bbtpu: noqa[BB001]
                     replay[:, skip:], commit=False, tree_mask=None,
                     commit_lens=lens, prefix_skip=skip,
                 )

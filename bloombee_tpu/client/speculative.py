@@ -129,7 +129,10 @@ async def generate_speculative(
 
             h_tree = model.embed(toks)
             if prune_threshold is None:
-                out = await session.step(
+                # recovery owner: the server-side accept/rollback protocol
+                # settles speculative rows when the NEXT step's `accept`
+                # arrives; a dead session is reaped by its lease
+                out = await session.step(  # bbtpu: noqa[BB001]
                     h_tree,
                     commit=False,
                     tree_mask=mask,
@@ -148,7 +151,8 @@ async def generate_speculative(
                     "tokens": toks.tolist(),
                     "parents": parents.tolist(),
                 }
-                out_k, keep = await session.step(
+                # recovery owner: same accept/rollback protocol as above
+                out_k, keep = await session.step(  # bbtpu: noqa[BB001]
                     h_tree,
                     commit=False,
                     tree_mask=mask,

@@ -629,6 +629,10 @@ class CacheManager:
                 getattr(self.table, "cached_pages", 0)
             ),
             "repl_pages_installed": int(self.repl_pages_installed),
+            # device-arena rebuilds after a failed donated dispatch: a
+            # nonzero value means sessions lost KV to self-heal events,
+            # which an operator should correlate with failover replays
+            "arena_epoch": int(self.arena_epoch),
         }
 
     # ------------------------------------------------------- kv replication
@@ -940,8 +944,11 @@ class CacheManager:
         state = self.table.seq(seq_id)
         assert state.l_seq == 0, "unpark target must be empty"
         # may raise OutOfPages: the parked host copy must survive a failed
-        # attempt, so only drop it once slots are secured
-        slots_np = self.table.assign_write_slots(seq_id, l_seq, commit=False)
+        # attempt, so only drop it once slots are secured; recovery owner:
+        # on failure the seq simply stays empty+parked (nothing committed
+        # yet), so there is nothing to roll back
+        slots_np = self.table.assign_write_slots(
+            seq_id, l_seq, commit=False)  # bbtpu: noqa[BB001]
         del self._parked[seq_id]
         self.table.restore_committed(seq_id, l_acc)
         slots = jnp.asarray(slots_np)

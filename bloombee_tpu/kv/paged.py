@@ -59,6 +59,14 @@ class PagedKVTable:
     writer gets a fresh page and the (src, dst) pair is queued for the
     device-side page copy (drained by CacheManager before the step's
     scatter lands).
+
+    Concurrency contract (enforced by bbtpu-lint BB002/BB003, see
+    ARCHITECTURE.md "Invariants"): the table carries NO lock of its own —
+    every mutation happens under CacheManager's RLock (its `@_locked`
+    methods) on the compute thread. If this class ever grows a lock, it
+    sits at level 1 of the declared hierarchy
+    cache_manager -> paged table -> compute queue: it may be taken while
+    holding the manager's lock, never the reverse.
     """
 
     def __init__(self, num_pages: int, page_size: int = DEFAULT_PAGE_SIZE):

@@ -481,7 +481,9 @@ class SpanExecutor:
         combined handle is what the caller commits or rolls back."""
         combined = self.manager.combine_handles(handles)
         hidden = np.concatenate(hiddens, axis=0)
-        out = self._step(
+        # recovery owner: the caller (block_server._dispatch_batched)
+        # commits/rolls back the combined handle around this dispatch
+        out = self._step(  # bbtpu: noqa[BB001]
             combined, hidden, commit=False, layers=layers, fetch=False,
             adapter=adapter,
         )
@@ -549,7 +551,9 @@ class SpanExecutor:
         r = sum(counts)
 
         starts = self.manager.context_lens(combined)  # [B] before write
-        slots = self.manager.write_slots_ragged(
+        # recovery owner: block_server._dispatch_mixed rolls decodes back
+        # and truncate_speculative's the chunk if this dispatch fails
+        slots = self.manager.write_slots_ragged(  # bbtpu: noqa[BB001]
             combined, counts, commit=False
         )  # [R]
         total_lens = self.manager.context_lens(combined)  # [B] after write

@@ -795,6 +795,8 @@ class BlockServer:
                     expiration=max(60.0, self.announce_period * 2.5 + 10.0),
                 )
             except Exception:
+                # best-effort: a dead registry at shutdown must not block
+                # drain; the announce record expires on its own anyway
                 pass
         await self.compute.stop()
         await self.peers.close()
@@ -1245,6 +1247,8 @@ class BlockServer:
             "mixed_batch": self.mixed_batch,
             "mixed_dispatches": self.mixed_dispatches,
             "mixed_tokens": self.mixed_tokens,
+            "step_dispatches": self.step_dispatches,
+            "step_tokens": self.step_tokens,
             "dispatches_per_token": (
                 self.step_dispatches / max(self.step_tokens, 1)
             ),
@@ -1823,6 +1827,8 @@ class BlockServer:
                         self.pushes_dropped,
                     )
                 except Exception:
+                    # the push either failed in flight or the inbox is
+                    # full — both moot at teardown; the client replays
                     pass
             else:
                 push_next.cancel()
@@ -2996,7 +3002,10 @@ class BlockServer:
             self.manager.ensure_resident(handle)
             self.manager.trim_adopted(handle, int(prefix_skip or 0))
         session.adoption_settled = True
-        out = self.executor.prefill_chunk(
+        # recovery owner: _run_chunked_prefill's except BaseException ->
+        # _abort_chunked_prefill (epoch-guarded rollback); this helper
+        # runs only inside that stream driver
+        out = self.executor.prefill_chunk(  # bbtpu: noqa[BB001]
             handle, hidden, commit=False, layers=session.layers,
             fetch=False, adapter=session.adapter,
         )

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# bbtpu-lint gate: project-specific AST rules (BB001-BB006) plus the
+# README env-switch-table drift check, against the committed baseline.
+#
+#   scripts/analyze.sh                     # the CI gate
+#   scripts/analyze.sh --update-baseline   # accept current findings
+#   scripts/analyze.sh --fix-env-docs      # regenerate README table
+#   scripts/analyze.sh --list-rules
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# --check-env-docs imports the package to populate the env registry;
+# keep that import off any TPU tunnel.
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+case "${1:-}" in
+    --update-baseline|--fix-env-docs|--list-rules|--dump-env-table)
+        exec python -m bloombee_tpu.analysis "$@"
+        ;;
+esac
+
+exec python -m bloombee_tpu.analysis --check-env-docs "$@"

@@ -1,9 +1,13 @@
 #!/usr/bin/env bash
-# Lint gate: ruff over the package, tests, bench, and entry scripts.
-# Config lives in pyproject.toml ([tool.ruff]); run with --fix to apply
-# safe autofixes (e.g. deleting unused imports) in place.
+# Lint gate: bbtpu-lint (project AST rules BB001-BB006 + README
+# env-table drift, scripts/analyze.sh) then ruff over the package,
+# tests, bench, and entry scripts. Ruff config lives in pyproject.toml
+# ([tool.ruff]); run with --fix to apply safe autofixes (e.g. deleting
+# unused imports) in place.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+scripts/analyze.sh
 
 if ! command -v ruff >/dev/null 2>&1 && ! python -m ruff --version >/dev/null 2>&1; then
     echo "lint: ruff not installed; skipping (pip install ruff to enable)" >&2

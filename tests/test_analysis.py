@@ -1,0 +1,376 @@
+"""bbtpu-lint (bloombee_tpu/analysis): one true-positive and one
+true-negative fixture per rule BB001-BB006, plus suppression and
+baseline mechanics. Fixtures run through `analyze_source` on in-memory
+sources, so these tests never depend on the live tree's findings."""
+
+import textwrap
+
+from bloombee_tpu.analysis import analyze_source
+from bloombee_tpu.analysis.cli import main as cli_main
+from bloombee_tpu.analysis.core import Finding, SourceFile
+
+CLIENT = "bloombee_tpu/client/mod.py"
+SERVER = "bloombee_tpu/server/mod.py"
+
+
+def codes(src: str, path: str = CLIENT) -> list[str]:
+    return [
+        f.code
+        for f in analyze_source({path: textwrap.dedent(src)})
+    ]
+
+
+# ------------------------------------------------------------------ BB001
+BB001_TP = """
+    def step(mgr, handle, h):
+        mgr.write_slots_ragged(handle, [1], commit=False)
+        return h
+"""
+
+BB001_TN = """
+    def step(mgr, handle, h):
+        try:
+            mgr.write_slots_ragged(handle, [1], commit=False)
+            mgr.commit(handle)
+        except Exception:
+            mgr.rollback(handle)
+            raise
+        return h
+"""
+
+
+def test_bb001_true_positive():
+    assert codes(BB001_TP) == ["BB001"]
+
+
+def test_bb001_true_negative():
+    assert codes(BB001_TN) == []
+
+
+def test_bb001_committed_write_is_quiet():
+    # commit=True (and commit passed through a variable) is the
+    # callee's contract, not a speculative site
+    assert codes(
+        """
+        def f(mgr, handle):
+            mgr.write_slots(handle, 2, commit=True)
+            mgr.prefill(handle, commit=commit_flag)
+        """
+    ) == []
+
+
+def test_bb001_finally_counts_as_recovery():
+    assert codes(
+        """
+        def f(mgr, handle):
+            try:
+                mgr.assign_write_slots(handle, 4, commit=False)
+            finally:
+                mgr.truncate_speculative(handle, snaps)
+        """
+    ) == []
+
+
+# ------------------------------------------------------------------ BB002
+BB002_TP = """
+    class C:
+        def f(self, conn):
+            with self._lock:
+                return conn.recv()
+"""
+
+BB002_TN = """
+    class C:
+        def f(self, conn):
+            with self._lock:
+                self.n = 1
+            return conn.recv()
+
+        async def g(self, conn):
+            async with self._alock:
+                return await conn.recv()
+"""
+
+
+def test_bb002_true_positive():
+    assert codes(BB002_TP) == ["BB002"]
+
+
+def test_bb002_true_negative():
+    # blocking outside the lock, or under an asyncio lock (which does
+    # not pin a thread), is fine
+    assert codes(BB002_TN) == []
+
+
+def test_bb002_locked_decorator_and_nested_def():
+    src = """
+        class C:
+            @_locked
+            def f(self):
+                return self.future.result()
+
+            @_locked
+            def g(self):
+                def later():
+                    return self.future.result()
+                return later
+    """
+    # f() blocks under the decorator's lock; g() only DEFINES a
+    # function, which does not run under the lock
+    assert codes(src) == ["BB002"]
+
+
+# ------------------------------------------------------------------ BB003
+BB003_TP = """
+    def f(self):
+        with self.table._lock:
+            with self.manager._lock:
+                pass
+"""
+
+BB003_TN = """
+    def f(self):
+        with self.manager._lock:
+            with self.table._lock:
+                with self.compute.queue_lock:
+                    pass
+"""
+
+
+def test_bb003_true_positive():
+    assert codes(BB003_TP) == ["BB003"]
+
+
+def test_bb003_true_negative():
+    assert codes(BB003_TN) == []
+
+
+# ------------------------------------------------------------------ BB004
+BB004_TP = """
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Info:
+        version: str
+
+        @classmethod
+        def from_wire(cls, d):
+            return cls(**d)
+"""
+
+BB004_TN = """
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Info:
+        version: str = "v0"
+
+        @classmethod
+        def from_wire(cls, d):
+            known = {f.name for f in dataclasses.fields(cls)}
+            return cls(**{k: v for k, v in d.items() if k in known})
+"""
+
+
+def test_bb004_true_positive():
+    # both defects fire: the unfiltered splat (newer peer's unknown
+    # field) and the undefaulted field (older peer's missing field)
+    found = codes(BB004_TP, path="bloombee_tpu/swarm/mod.py")
+    assert found == ["BB004", "BB004"]
+
+
+def test_bb004_true_negative():
+    assert codes(BB004_TN, path="bloombee_tpu/swarm/mod.py") == []
+
+
+def test_bb004_explicit_construction_opts_out():
+    # field-by-field from_wire (TensorMeta-style) handles versioning
+    # manually; the splat rules don't apply
+    assert codes(
+        """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Meta:
+            dtype: str
+
+            @classmethod
+            def from_wire(cls, d):
+                return cls(d["dtype"])
+        """,
+        path="bloombee_tpu/wire/mod.py",
+    ) == []
+
+
+# ------------------------------------------------------------------ BB005
+BB005_TP = """
+    import os
+    TIMEOUT = float(os.environ.get("BBTPU_TIMEOUT_S", "1"))
+"""
+
+BB005_TN = """
+    import os
+    from bloombee_tpu.utils import env
+    TIMEOUT = env.get("BBTPU_TIMEOUT_S")
+    HOME = os.environ.get("HOME")
+    os.environ["BBTPU_TIMEOUT_S"] = "2"
+"""
+
+
+def test_bb005_true_positive():
+    assert codes(BB005_TP) == ["BB005"]
+    assert codes("import os\nX = os.getenv('BBTPU_X')\n") == ["BB005"]
+    assert codes("import os\nX = os.environ['BBTPU_X']\n") == ["BB005"]
+
+
+def test_bb005_true_negative():
+    # registry reads, non-BBTPU keys, and writes (save/set/restore) are
+    # all out of scope
+    assert codes(BB005_TN) == []
+
+
+# ------------------------------------------------------------------ BB006
+BB006_TP = """
+    class S:
+        def step(self):
+            self.widgets_made += 1
+"""
+
+BB006_TN = """
+    class S:
+        def step(self):
+            self.widgets_made += 1
+            self._scratch += 1
+
+        def stats(self):
+            return {"widgets_made": self.widgets_made}
+"""
+
+
+def test_bb006_true_positive():
+    assert codes(BB006_TP, path=SERVER) == ["BB006"]
+
+
+def test_bb006_true_negative():
+    # surfaced via a stats() string key; underscore-prefixed private
+    # bookkeeping never needs surfacing
+    assert codes(BB006_TN, path=SERVER) == []
+
+
+def test_bb006_surfacing_may_live_in_another_file():
+    findings = analyze_source(
+        {
+            SERVER: textwrap.dedent(BB006_TP),
+            "bloombee_tpu/cli/health.py": "KEYS = ('widgets_made',)\n",
+        }
+    )
+    assert findings == []
+
+
+def test_bb006_ignores_non_server_code():
+    assert codes(BB006_TP, path=CLIENT) == []
+
+
+# ------------------------------------------------- suppressions & baseline
+def test_noqa_suppresses_named_code():
+    src = 'import os\nX = os.getenv("BBTPU_X")  # bbtpu: noqa[BB005]\n'
+    assert codes(src) == []
+
+
+def test_noqa_bare_suppresses_everything():
+    src = 'import os\nX = os.getenv("BBTPU_X")  # bbtpu: noqa\n'
+    assert codes(src) == []
+
+
+def test_noqa_wrong_code_does_not_suppress():
+    src = 'import os\nX = os.getenv("BBTPU_X")  # bbtpu: noqa[BB001]\n'
+    assert codes(src) == ["BB005"]
+
+
+def test_noqa_applies_to_multiline_statement():
+    src = (
+        "def f(mgr, handle):\n"
+        "    mgr.write_slots_ragged(  # bbtpu: noqa[BB001]\n"
+        "        handle, [1], commit=False\n"
+        "    )\n"
+    )
+    assert codes(src) == []
+
+
+def test_fingerprint_survives_line_drift():
+    src = 'import os\nX = os.getenv("BBTPU_X")\n'
+    (f1,) = analyze_source({CLIENT: src})
+    (f2,) = analyze_source({CLIENT: "# a new leading comment\n" + src})
+    assert f1.line != f2.line
+    assert f1.fingerprint() == f2.fingerprint()
+
+
+def test_fingerprint_changes_when_line_changes():
+    a = Finding("BB005", CLIENT, 2, "m", snippet="X = 1")
+    b = Finding("BB005", CLIENT, 2, "m", snippet="X = 2")
+    assert a.fingerprint() != b.fingerprint()
+
+
+def test_source_file_rejects_unparsable_noqa_scan():
+    sf = SourceFile(CLIENT, "x = 1  # bbtpu: noqa[BB001, BB005]\n")
+    assert sf.noqa[1] == {"BB001", "BB005"}
+
+
+def test_cli_baseline_workflow(tmp_path, monkeypatch, capsys):
+    """new finding fails -> --update-baseline accepts it -> gate green
+    -> the NEXT new finding fails again; --no-baseline sees through."""
+    monkeypatch.chdir(tmp_path)
+    mod = tmp_path / "mod.py"
+    mod.write_text('import os\nX = os.getenv("BBTPU_X")\n')
+    argv = ["mod.py", "--baseline", "bl.txt"]
+
+    assert cli_main(argv) == 1
+    assert cli_main(argv + ["--update-baseline"]) == 0
+    assert (tmp_path / "bl.txt").exists()
+    assert cli_main(argv) == 0  # baselined finding no longer fails
+
+    mod.write_text(
+        'import os\nX = os.getenv("BBTPU_X")\n'
+        'Y = os.getenv("BBTPU_Y")\n'
+    )
+    assert cli_main(argv) == 1  # only the NEW finding trips the gate
+    out = capsys.readouterr()
+    assert "BBTPU_Y" in out.out
+    assert cli_main(argv + ["--no-baseline"]) == 1
+
+
+def test_cli_fingerprints_are_cwd_independent(tmp_path, monkeypatch,
+                                              capsys):
+    """A baseline written from the checkout root must still match when
+    the CLI runs from an unrelated cwd with absolute path arguments
+    (findings relativize against the detected checkout, not cwd)."""
+    proj = tmp_path / "proj"
+    (proj / "bloombee_tpu").mkdir(parents=True)
+    (proj / "bloombee_tpu" / "__init__.py").write_text("")
+    mod = proj / "bloombee_tpu" / "mod.py"
+    mod.write_text('import os\nX = os.getenv("BBTPU_X")\n')
+    bl = proj / "bl.txt"
+
+    monkeypatch.chdir(proj)
+    argv = ["bloombee_tpu", "--baseline", str(bl)]
+    assert cli_main(argv + ["--update-baseline"]) == 0
+    assert cli_main(argv) == 0
+
+    elsewhere = tmp_path / "elsewhere"
+    elsewhere.mkdir()
+    monkeypatch.chdir(elsewhere)
+    assert cli_main(
+        [str(proj / "bloombee_tpu"), "--baseline", str(bl)]
+    ) == 0
+    capsys.readouterr()
+
+
+def test_cli_select(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "mod.py").write_text(
+        'import os\nX = os.getenv("BBTPU_X")\n'
+    )
+    base = ["mod.py", "--baseline", "bl.txt", "--no-baseline"]
+    assert cli_main(base + ["--select", "BB001"]) == 0
+    assert cli_main(base + ["--select", "BB005"]) == 1
+    capsys.readouterr()

@@ -19,3 +19,16 @@ def test_lint_clean():
     assert proc.returncode == 0, (
         f"ruff regressions:\n{proc.stdout}\n{proc.stderr}"
     )
+
+
+def test_analyze_clean():
+    """bbtpu-lint (BB001-BB006 + env-docs drift) against the committed
+    baseline: a new finding, or a BBTPU_* switch missing from README's
+    generated table, fails tier-1 — not just a dev-machine lint run."""
+    proc = subprocess.run(
+        ["bash", str(REPO / "scripts" / "analyze.sh")],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"bbtpu-lint findings:\n{proc.stdout}\n{proc.stderr}"
+    )
