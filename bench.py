@@ -632,6 +632,18 @@ def main():
         RESULTS.setdefault("degraded", f"overload phase failed: {e!r}")
         log(f"overload phase FAILED: {e!r}")
 
+    # ---- spec_decode phase: N concurrent speculating sessions. Solo mode
+    # pays one device dispatch per session per tree round; --spec-batch
+    # coalesces concurrent rounds into grouped ragged dispatches, so
+    # dispatches per committed token drops with session count.
+    try:
+        phase("spec_decode", "started")
+        run_spec_decode(spec, params, smoke)
+    except Exception as e:  # noqa: BLE001
+        phase("spec_decode", f"failed: {e!r}"[:200])
+        RESULTS.setdefault("degraded", f"spec_decode phase failed: {e!r}")
+        log(f"spec_decode phase FAILED: {e!r}")
+
     # value: SERVED full-model-equivalent PER-SEQUENCE decode tok/s (batch 8
     # session through registry + BlockServer + wire); baseline 35 tok/s =
     # single-A100 single-stream HF decode on Llama-3-8B (BASELINE.md).
@@ -1117,6 +1129,149 @@ def run_interference(spec, params, smoke: bool) -> None:
         f"{chunked['dispatches_per_token']:.4f} — "
         f"{RESULTS['interference']['dispatches_per_token_reduction']:.2f}x "
         f"fewer; mixed TBT p95 {mixed['tbt_p95_ms']:.1f} ms"
+    )
+
+
+def run_spec_decode(spec, params, smoke: bool) -> None:
+    """Speculative-decode phase: N sessions speculate concurrently against
+    one server, each round shipping a drafted token tree for verification.
+    Solo mode (flag off) pays one device dispatch per session per round;
+    --spec-batch gathers concurrent rounds sharing (layers, adapter, dtype)
+    into ONE grouped ragged dispatch. The drafter runs the SAME weights as
+    the server (client-side, unstacked), so acceptance is high and the
+    dispatch counters — not token quality — are what the modes contrast."""
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+    from bloombee_tpu.client.speculative import generate_speculative
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+    from bloombee_tpu.utils.tree import unstack_params
+
+    span_layers = spec.num_hidden_layers
+    N_SESS = 2
+    N_NEW = 6 if smoke else 16
+    PROMPT = 8
+    VOCAB_EFF = min(1024, spec.vocab_size)
+
+    rng = np.random.default_rng(41)
+    # client head sized to the effective vocab: every generated id comes
+    # from an argmax over these logits, so embeds never index past it
+    client_params = {
+        # jnp (not np): the drafter jit-traces embeds, and a numpy table
+        # indexed by a tracer raises TracerArrayConversionError
+        "embed": jnp.asarray(
+            rng.standard_normal((VOCAB_EFF, spec.hidden_size)) * 0.02,
+            jnp.float32,
+        ),
+        "norm": jnp.ones((spec.hidden_size,), jnp.float32),
+        "lm_head": jnp.asarray(
+            rng.standard_normal((spec.hidden_size, VOCAB_EFF)) * 0.02,
+            jnp.float32,
+        ),
+    }
+    draft_model = LocalJaxDraftModel(
+        spec, unstack_params(params, span_layers), client_params
+    )
+    prompts = [
+        rng.integers(0, VOCAB_EFF, size=(1, PROMPT)) for _ in range(N_SESS)
+    ]
+
+    async def one_mode(spec_batch: bool, window_ms: str) -> dict:
+        # save/restore needs the raw possibly-absent value, not the
+        # typed default env.get would substitute
+        old = os.environ.get("BBTPU_BATCH_WINDOW_MS")  # bbtpu: noqa[BB005]
+        os.environ["BBTPU_BATCH_WINDOW_MS"] = window_ms
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        server = BlockServer(
+            model_uid="bench_spec", start=0, end=span_layers,
+            params=params, spec=spec, registry=rc(), num_pages=256,
+            page_size=16, max_batch=2 * N_SESS, spec_batch=spec_batch,
+        )
+        await server.start()
+        model = DistributedModelForCausalLM(
+            spec, client_params,
+            RemoteSequenceManager(rc(), "bench_spec", span_layers),
+        )
+        try:
+            coros = [
+                generate_speculative(
+                    model,
+                    GreedyTreeDrafter(draft_model, branching=(2, 1)),
+                    p, max_new_tokens=N_NEW,
+                )
+                for p in prompts
+            ]
+            t0 = time.perf_counter()
+            if spec_batch:
+                outs = await asyncio.gather(*coros)
+            else:
+                outs = [await c for c in coros]
+            wall_s = time.perf_counter() - t0
+            tokens = N_SESS * N_NEW
+            return {
+                "tokens": [np.asarray(o).tolist() for o in outs],
+                "wall_s": wall_s,
+                "tok_per_s": tokens / max(wall_s, 1e-9),
+                "tree_steps": server.tree_steps,
+                "tree_group_dispatches": server.tree_group_dispatches,
+                "mean_tree_batch_width": (
+                    server.tree_group_members
+                    / max(server.tree_group_dispatches, 1)
+                ),
+                "spec_tokens_drafted": server.spec_tokens_drafted,
+                "spec_tokens_accepted": server.spec_tokens_accepted,
+                "step_dispatches": server.step_dispatches,
+                "dispatches_per_token": (
+                    server.step_dispatches / max(tokens, 1)
+                ),
+            }
+        finally:
+            if old is None:
+                os.environ.pop("BBTPU_BATCH_WINDOW_MS", None)
+            else:
+                os.environ["BBTPU_BATCH_WINDOW_MS"] = old
+            for stop in (server.stop, reg.stop):
+                try:
+                    await asyncio.wait_for(stop(), timeout=30.0)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # window must exceed per-round client think time (drafter forward) or
+    # concurrently pacing sessions phase-lock and never share a window
+    batched = asyncio.run(one_mode(True, "2000"))
+    solo = asyncio.run(one_mode(False, "0"))
+    identical = batched["tokens"] == solo["tokens"]
+    reduction = solo["dispatches_per_token"] / max(
+        batched["dispatches_per_token"], 1e-9
+    )
+    for mode in (batched, solo):
+        mode.pop("tokens")  # raw ids would bloat the ledger
+    RESULTS["spec_decode"] = {
+        "batched": batched,
+        "solo": solo,
+        "sessions": N_SESS,
+        "new_tokens_per_session": N_NEW,
+        "token_identical": identical,
+        "dispatches_per_token_reduction": reduction,
+    }
+    phase("spec_decode", "ok" if identical else "failed: tokens diverged")
+    log(
+        f"spec_decode ({N_SESS} sessions x {N_NEW} tokens): batched "
+        f"{batched['dispatches_per_token']:.3f} dispatches/token "
+        f"({batched['tree_group_dispatches']} group dispatches, width "
+        f"{batched['mean_tree_batch_width']:.2f}) vs solo "
+        f"{solo['dispatches_per_token']:.3f} — {reduction:.2f}x fewer; "
+        f"token_identical={identical}"
     )
 
 

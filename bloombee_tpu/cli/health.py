@@ -122,6 +122,31 @@ def main(argv=None):
                         dpt = probe.get("dispatches_per_token")
                         if dpt:
                             line += f"  dispatches_per_token={dpt:.3f}"
+                    # speculative-decode counters: are tree-verify steps
+                    # flowing, are they coalescing into group dispatches
+                    # (--spec-batch), and what the swarm-measured draft
+                    # acceptance works out to
+                    spec = {
+                        k: probe[k]
+                        for k in (
+                            "tree_steps",
+                            "tree_rows",
+                            "spec_tokens_drafted",
+                            "spec_tokens_accepted",
+                            "tree_group_dispatches",
+                        )
+                        if probe.get(k)
+                    }
+                    if spec:
+                        line += "  " + " ".join(
+                            f"{k}={v}" for k, v in sorted(spec.items())
+                        )
+                        rate = probe.get("spec_accept_rate")
+                        if rate:
+                            line += f"  spec_accept_rate={rate:.3f}"
+                        width = probe.get("mean_tree_batch_width")
+                        if width:
+                            line += f"  mean_tree_batch_width={width:.2f}"
                     # session lease counters: are leases reaping abandoned
                     # sessions, are clients resuming instead of replaying,
                     # and is keepalive traffic flowing on idle conns

@@ -475,6 +475,9 @@ class InferenceSession:
         commit_lens: list | None = None,
         prune: dict | None = None,  # mid-chain tree pruning (tree steps)
         accept_per_span: list | None = None,  # pruned chains: accept per span
+        rows: tuple | None = None,  # (lo, hi): hidden covers only this
+        # contiguous row window of the session's cache; accept stays
+        # full-width (servers apply it before slicing the handle)
     ) -> np.ndarray:
         """Push hidden through the whole chain; returns last span's output
         (or (output, keep) for pruned tree steps)."""
@@ -524,6 +527,7 @@ class InferenceSession:
                 out = await self._step_once(
                     send_hidden, commit, tree_mask, depths, accept,
                     commit_lens, prefix_skip=skip, step_id=step_id,
+                    rows=rows,
                 )
                 if commit and tree_mask is None:
                     if ids is not None and self.embed_fn is not None:
@@ -685,7 +689,7 @@ class InferenceSession:
 
     async def _step_once(
         self, hidden, commit, tree_mask, depths=None, accept=None,
-        commit_lens=None, prefix_skip=None, step_id=None,
+        commit_lens=None, prefix_skip=None, step_id=None, rows=None,
     ):
         if not self._spans:
             # a failed recovery left no open chain; surface as a retryable
@@ -746,9 +750,26 @@ class InferenceSession:
             or mb > b
         ):
             mb = 1
-        bounds = [
-            (round(k * b / mb), round((k + 1) * b / mb)) for k in range(mb)
-        ]
+        # live-row window (tree steps): hidden carries only rows
+        # [rows[0], rows[1]) of the cache — the servers slice their handle
+        # to that window, so finished rows stop burning tree slots. All
+        # row labels on the wire stay ABSOLUTE; row_base maps them back
+        # onto this window-sized hidden/out.
+        row_base = 0
+        if rows is not None:
+            lo_r, hi_r = int(rows[0]), int(rows[1])
+            if hi_r - lo_r != b:
+                raise ValueError(
+                    f"rows window {rows} does not match hidden batch {b}"
+                )
+            mb = 1
+            row_base = lo_r
+            bounds = [(lo_r, hi_r)]
+        else:
+            bounds = [
+                (round(k * b / mb), round((k + 1) * b / mb))
+                for k in range(mb)
+            ]
 
         route = []
         if self.use_push and len(self._spans) > 1:
@@ -771,7 +792,7 @@ class InferenceSession:
             if route:
                 meta["route"] = route
             await self._spans[0].stream.send(
-                meta, [hidden_w[lo:hi]] + extra
+                meta, [hidden_w[lo - row_base:hi - row_base]] + extra
             )
 
         t_start = time.perf_counter()
@@ -801,9 +822,11 @@ class InferenceSession:
                     span_ms += resp_meta["t_compute_ms"]
                 if resp_meta.get("ack"):
                     continue
-                lo, hi = resp_meta.get("rows") or (0, b)
+                lo, hi = resp_meta.get("rows") or (row_base, row_base + b)
                 chunk = resp_tensors[0]
-                out[lo:hi] = np.asarray(chunk, dtype=np.float32)
+                out[lo - row_base:hi - row_base] = np.asarray(
+                    chunk, dtype=np.float32
+                )
                 got_tensor = True
                 if not self.use_push and i + 1 < len(self._spans):
                     # relay mode: forward each chunk as it lands so the next

@@ -99,33 +99,47 @@ async def generate_speculative(
         pending_spans = None  # per-span accepts for pruned chains
 
         while min(len(r) for r in new_rows) < max_new_tokens:
-            # done rows still occupy a slot in the rectangular tree step,
-            # but draft from a 1-token context so their drafter cost is nil
-            # (their speculative writes roll back via empty accepts)
+            # live-row window: finished rows at the batch edges stop
+            # burning tree slots — the contiguous [lo, hi) window of
+            # unfinished rows ships as a `rows` slice and the servers
+            # slice their cache handle to match. Interior done rows
+            # (live neighbors on both sides) still occupy a slot but
+            # draft from a 1-token context so their drafter cost is nil
+            # (their speculative writes roll back via empty accepts).
+            # Pruned chains keep the full batch: per-span accept
+            # translation is indexed in full-batch space.
+            live = [
+                i for i in range(b) if len(new_rows[i]) < max_new_tokens
+            ]
+            if prune_threshold is not None:
+                lo, hi = 0, b
+            else:
+                lo, hi = live[0], live[-1] + 1
+            w = hi - lo
             contexts = [
                 (rows[i] + new_rows[i])
                 if len(new_rows[i]) < max_new_tokens
                 else [new_rows[i][-1]]
-                for i in range(b)
+                for i in range(lo, hi)
             ]
             subs, _probs = drafter.build_batch(contexts)
             # per-row tree: node 0 = that row's last (certain) token, the
             # drafter's tree hanging under it; structure shared across rows
             toks = np.stack(
                 [
-                    np.concatenate([[new_rows[i][-1]], subs[i].tokens])
-                    for i in range(b)
+                    np.concatenate([[new_rows[lo + j][-1]], subs[j].tokens])
+                    for j in range(w)
                 ]
-            )  # [B, T]
+            )  # [W, T]
             parents = np.concatenate(
                 [[-1], np.where(subs[0].parents < 0, 0, subs[0].parents + 1)]
             ).astype(np.int32)
             tree0 = DraftTree(tokens=toks[0], parents=parents)
             t = tree0.size
             mask = np.broadcast_to(
-                tree_attention_mask(tree0)[None], (b, t, t)
+                tree_attention_mask(tree0)[None], (w, t, t)
             )
-            depths = np.broadcast_to(tree0.depths()[None], (b, t))
+            depths = np.broadcast_to(tree0.depths()[None], (w, t))
 
             h_tree = model.embed(toks)
             if prune_threshold is None:
@@ -138,8 +152,9 @@ async def generate_speculative(
                     tree_mask=mask,
                     depths=depths,
                     accept=pending_accept,
+                    rows=None if (lo, hi) == (0, b) else (lo, hi),
                 )
-                logits = model.logits(out)  # [B, T, V]
+                logits = model.logits(out)  # [W, T, V]
                 verifiable = None
             else:
                 # mid-chain pruning: span 0 keeps only MidLMHead survivors;
@@ -181,25 +196,28 @@ async def generate_speculative(
                 if room <= 0:
                     # row done: accept nothing (its speculative rows roll
                     # back) so its cache stays "all committed but the final
-                    # bonus" while slow rows continue
+                    # bonus" while slow rows continue. Rows outside the
+                    # shipped window had nothing drafted this round — the
+                    # empty accept is a no-op on their (empty) spec region.
                     pending_accept.append(np.asarray([], dtype=np.int64))
                     committed_rows.append([])
                     continue
+                j = i - lo  # this row's index in the shipped window
                 if do_sample:
                     # SpecInfer rejection sampling over the drafter's
                     # sub-tree (node 0 is the committed bonus; targets at
                     # its children come from logits[0])
                     accepted_sub, nxt = accept_sampling(
-                        subs[i], logits[i][0], logits[i][1:], _probs[i],
+                        subs[j], logits[j][0], logits[j][1:], _probs[j],
                         rng, temperature,
                     )
                     accepted = [0] + [a + 1 for a in accepted_sub]
                 else:
-                    tree_i = DraftTree(tokens=toks[i], parents=parents)
+                    tree_i = DraftTree(tokens=toks[j], parents=parents)
                     accepted, _ = accept_greedy(
-                        tree_i, root_logits[i], logits[i],
+                        tree_i, root_logits[i], logits[j],
                         verifiable=(
-                            None if verifiable is None else verifiable[i]
+                            None if verifiable is None else verifiable[j]
                         ),
                     )
                 assert accepted and accepted[0] == 0
@@ -215,15 +233,15 @@ async def generate_speculative(
                         # rejected, so the bonus is a plain sample from the
                         # last kept node's target distribution
                         nxt = int(_pick(
-                            logits[i][accepted[-1]][None], True,
+                            logits[j][accepted[-1]][None], True,
                             temperature, rng,
                         )[0])
                 else:
-                    nxt = int(np.argmax(logits[i][accepted[-1]]))
+                    nxt = int(np.argmax(logits[j][accepted[-1]]))
                 pending_accept.append(np.asarray(accepted))
-                committed_rows.append([int(toks[i][a]) for a in accepted])
-                root_logits[i] = logits[i][accepted[-1]]
-                new_rows[i].extend(int(toks[i][a]) for a in accepted[1:])
+                committed_rows.append([int(toks[j][a]) for a in accepted])
+                root_logits[i] = logits[j][accepted[-1]]
+                new_rows[i].extend(int(toks[j][a]) for a in accepted[1:])
                 new_rows[i].append(nxt)
             # accepted nodes' token ids ARE the committed history
             session.record_history_ids(committed_rows)

@@ -609,22 +609,16 @@ def _ragged_kernel(
     pt_ref,  # [B, NP] i32 scalar prefetch: logical page j of seq b
     lens_ref,  # [B] i32 scalar prefetch (lens INCLUDE each seq's new tokens)
     win_ref,  # [1] i32 scalar prefetch: sliding window (0 = full attention)
-    seq_ref,  # [rq, 1] i32: owning sequence of each query row (>= B = pad)
-    pos_ref,  # [rq, 1] i32: context position of each query row
-    q_ref,  # [rq, hd] — ALL members' query rows, token-major then head
-    k_ref,  # [page_size * Hkv, hd] — current physical page, ALL kv heads
-    v_ref,
-    o_ref,  # [rq, hd]
-    m_scr,  # [rq, 1] f32
-    l_scr,  # [rq, 1] f32
-    acc_scr,  # [rq, hd] f32
-    *,
+    *refs,  # [nt_ref (has_tree)], [tree_ref (has_tree)], seq_ref, pos_ref,
+    # q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr — see below
     scale: float,
     page_size: int,
     n_pages: int,
     n_seqs: int,
     hkv: int,
     g: int,
+    has_tree: bool = False,
+    t_max: int = 0,
 ):
     """Ragged mixed-batch variant of _chunk_kernel: ONE launch covers every
     member of a mixed group (N single-token decode rows + one multi-token
@@ -642,7 +636,24 @@ def _ragged_kernel(
     dispatches; the HBM bytes stay one pass over every member's pages —
     the same bytes B separate kernel calls would read. No windowed
     page-skip here (the skip bound is per row, not per block); dead pages
-    still predicate off their compute via page_live."""
+    still predicate off their compute via page_live.
+
+    has_tree switches the causal term into ragged TREE-verify semantics:
+    nt_ref[b] is sequence b's in-step (speculative) token count — its last
+    nt storage slots hold this step's linearized tree — committed keys
+    (pos < length - nt) stay fully visible, and tree_ref[i, m] says whether
+    query row i may attend the m-th in-step slot of its own sequence.
+    Mosaic has no arbitrary 2D gather, so the per-key lookup rides the
+    one-hot matmul trick from _chunk_kernel: sel one-hots each key column
+    to its in-step index, tree_vis = tree_ref @ sel."""
+    if has_tree:
+        nt_ref, tree_ref = refs[0], refs[1]
+        refs = refs[2:]
+    else:
+        nt_ref = tree_ref = None
+    (
+        seq_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    ) = refs
     b = pl.program_id(0)
     j = pl.program_id(1)
     h = hkv * g
@@ -674,8 +685,26 @@ def _ragged_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [rq, rows]
-        mask = own & (pos < length) & (seq == b) & (pos <= qpos)
-        mask &= (win <= 0) | (pos > qpos - win)
+        if has_tree:
+            ss = length - nt_ref[b]  # first in-step storage slot of seq b
+            tm = tree_ref[...].astype(jnp.float32)  # [rq, t_max]
+            ti = jax.lax.broadcasted_iota(jnp.int32, (t_max, rows), 0)
+            posk = (
+                j * page_size
+                + jax.lax.broadcasted_iota(jnp.int32, (t_max, rows), 1)
+                // hkv
+            )
+            sel = (posk == ss + ti).astype(jnp.float32)  # [t_max, rows]
+            tree_vis = jax.lax.dot_general(
+                tm, sel, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [rq, rows]
+            mask = own & (pos < length) & (seq == b) & (
+                (pos < ss) | (tree_vis > 0.5)
+            )
+        else:
+            mask = own & (pos < length) & (seq == b) & (pos <= qpos)
+            mask &= (win <= 0) | (pos > qpos - win)
         logits = jnp.where(mask, logits, NEG)
         m = m_scr[...]
         m_new = jnp.maximum(m, logits.max(axis=1, keepdims=True))
@@ -699,7 +728,7 @@ def _ragged_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("page_size", "scale", "interpret"),
+    static_argnames=("page_size", "scale", "interpret", "has_tree"),
 )
 def paged_ragged_attention(
     q: jax.Array,  # [R, H, hd] — ragged token rows across ALL members
@@ -713,13 +742,20 @@ def paged_ragged_attention(
     scale: float | None = None,
     interpret: bool = False,
     window=0,  # traced i32 scalar; 0 = full attention (per-layer in scan)
+    nt: jax.Array | None = None,  # [B] i32 in-step token count (has_tree)
+    tree_rows: jax.Array | None = None,  # [R, t_max] in-step visibility
+    has_tree: bool = False,
 ) -> jax.Array:  # [R, H, hd]
     """Paged attention over a ragged mixed batch: R tokens spread unevenly
     across B sequences (decode members contribute 1 row, the prefill-chunk
     member contributes its chunk), all in ONE grid launch. Token row i
     belongs to sequence q_seq[i] at context position q_pos[i]; padding rows
-    (q_seq >= B) emit zeros. VMEM budget: caller gates on R*H rows (the
-    executor allows <= 2048, mirroring paged_chunk_attention)."""
+    (q_seq >= B) emit zeros. has_tree switches into the ragged TREE-verify
+    variant: nt rides as a fourth scalar prefetch and tree_rows (row-major
+    in-step visibility, head-expanded here) as an extra VMEM input; the
+    window must be 0 (tree groups gate windowed models off host-side).
+    VMEM budget: caller gates on R*H rows (the executor allows <= 2048,
+    mirroring paged_chunk_attention)."""
     r, h, hd = q.shape
     s_tot, hkv = k_slab.shape[0], k_slab.shape[1]
     if h % hkv:
@@ -741,22 +777,41 @@ def paged_ragged_attention(
     seq_rows = jnp.repeat(q_seq.astype(jnp.int32), h).reshape(rq, 1)
     pos_rows = jnp.repeat(q_pos.astype(jnp.int32), h).reshape(rq, 1)
 
-    def kv_index(bi, j, pt, ln, wn):
+    # index-map arity follows num_scalar_prefetch (3, +1 for the tree
+    # variant's nt), so take the prefetch refs variadically
+    def kv_index(bi, j, pt, ln, wn, *rest):
         return (pt[bi, j], 0, 0)
 
-    def const_index(bi, j, pt, ln, wn):
+    def const_index(bi, j, pt, ln, wn, *rest):
         return (0, 0)
 
+    t_max = tree_rows.shape[1] if has_tree else 0
+    in_specs = [
+        pl.BlockSpec((rq, 1), const_index),
+        pl.BlockSpec((rq, 1), const_index),
+        pl.BlockSpec((rq, hd), const_index),
+        pl.BlockSpec((None, rows, hd), kv_index),
+        pl.BlockSpec((None, rows, hd), kv_index),
+    ]
+    prefetch = [
+        page_table.astype(jnp.int32), lens.astype(jnp.int32),
+        jnp.asarray(window, jnp.int32).reshape(1),
+    ]
+    args = [seq_rows, pos_rows, q2, kp, vp]
+    if has_tree:
+        assert nt is not None and tree_rows is not None
+        prefetch.append(nt.astype(jnp.int32))
+        # per-ROW visibility: each token's tree row repeated per head,
+        # mirroring seq_rows/pos_rows
+        tree_rq = jnp.repeat(
+            tree_rows.astype(jnp.float32), h, axis=0
+        ).reshape(rq, t_max)
+        in_specs.insert(0, pl.BlockSpec((rq, t_max), const_index))
+        args.insert(0, tree_rq)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=3 + int(has_tree),
         grid=(b, n_pages),
-        in_specs=[
-            pl.BlockSpec((rq, 1), const_index),
-            pl.BlockSpec((rq, 1), const_index),
-            pl.BlockSpec((rq, hd), const_index),
-            pl.BlockSpec((None, rows, hd), kv_index),
-            pl.BlockSpec((None, rows, hd), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((rq, hd), const_index),
         scratch_shapes=[
             pltpu.VMEM((rq, 1), jnp.float32),
@@ -764,17 +819,14 @@ def paged_ragged_attention(
             pltpu.VMEM((rq, hd), jnp.float32),
         ],
     )
-    win_arr = jnp.asarray(window, jnp.int32).reshape(1)
     out = pl.pallas_call(
         functools.partial(
             _ragged_kernel, scale=scale, page_size=page_size,
             n_pages=n_pages, n_seqs=b, hkv=hkv, g=g,
+            has_tree=has_tree, t_max=t_max,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rq, hd), q.dtype),
         interpret=interpret,
-    )(
-        page_table.astype(jnp.int32), lens.astype(jnp.int32), win_arr,
-        seq_rows, pos_rows, q2, kp, vp,
-    )
+    )(*prefetch, *args)
     return out.reshape(r, h, hd)

@@ -657,6 +657,184 @@ class SpanExecutor:
         self.manager.arena = {"k": new_k, "v": new_v}
         return out[0, :r], combined
 
+    def tree_group_unsupported(self) -> str | None:
+        """Why this executor can't batch tree-verify steps into one ragged
+        dispatch; None when it can. Everything mixed dispatch can't do the
+        tree group can't either, plus sliding-window layers: the ragged
+        tree mask replaces causality outright, and window clipping against
+        depth-positioned tree tokens only exists on the solo dense path."""
+        reason = self.mixed_unsupported()
+        if reason is not None:
+            return reason
+        if any(w > 0 for w in self.windows):
+            return "sliding-window layers"
+        return None
+
+    def tree_group(
+        self,
+        handles: list[CacheHandle],
+        hiddens: list[np.ndarray],  # per-member [b_i, t_i, D], same dtype
+        tree_masks: list[np.ndarray],  # per-member [b_i, t_i, t_i] bool
+        depths_list: list[np.ndarray],  # per-member [b_i, t_i] i32
+        layers: tuple[int, int] | None = None,
+        adapter: str | None = None,
+    ):
+        """Ragged generalization of decode_group for TREE-verify steps: N
+        sessions' linearized speculative trees (differing sizes) pack
+        row-major into one pow2 bucket [1, R, D] and verify as ONE span
+        dispatch. Each row's rotary position is its committed length plus
+        its node depth, and per-row tree visibility rides the plan into the
+        ragged kernel (dense attend_ragged for kernel-ineligible configs),
+        so the merged step is numerically identical to the members run
+        alone through `_step`'s solo tree path.
+
+        KV writes are SPECULATIVE for every member (tree steps never
+        commit): the caller rolls every member back to its pre-dispatch
+        length if the dispatch fails and replays solo; on success the
+        speculative region stays parked until the session's next accept
+        settles the surviving slots via accept_speculative.
+
+        Returns (out, combined_handle): `out` is the lazy [R, D] device
+        result in member-major token order (slice b_i * t_i row blocks per
+        member, fetch off-queue)."""
+        reason = self.tree_group_unsupported()
+        if reason is not None:
+            raise ValueError(f"tree_group unsupported: {reason}")
+        spec = self.spec
+        from bloombee_tpu.models.checkpoint import resolve_adapter
+
+        lora = resolve_adapter(self.adapters, adapter)
+        combined = self.manager.combine_handles(handles)
+        self.manager.ensure_resident(combined)
+
+        d = spec.hidden_size
+        counts: list[int] = []
+        row_blocks = []
+        for hid in hiddens:
+            b_i, t_i, d_i = hid.shape
+            assert d_i == d
+            counts.extend([t_i] * b_i)
+            row_blocks.append(hid.reshape(b_i * t_i, d))
+        n_seqs = len(counts)
+        r = sum(counts)
+        t_max = next_pow2(max(counts))
+
+        starts = self.manager.context_lens(combined)  # [B] before write
+        # recovery owner: block_server._dispatch_tree_group rolls every
+        # member back to its pre-dispatch length if this dispatch fails
+        slots = self.manager.write_slots_ragged(  # bbtpu: noqa[BB001]
+            combined, counts, commit=False
+        )  # [R]
+        total_lens = self.manager.context_lens(combined)  # [B] after write
+
+        rb = next_pow2(r)
+        sb = next_pow2(n_seqs)
+        arena_tokens = self.manager.capacity_tokens
+        pages_needed = int(
+            max(-(-int(l) // self.page_size) for l in total_lens)
+        )
+        pb = min(
+            next_pow2(max(pages_needed, 1), floor=4),
+            arena_tokens // self.page_size,
+        )
+        oob = arena_tokens  # out-of-bounds slot => dropped write
+
+        h_pad = np.zeros((1, rb, d), dtype=self.transfer_dtype)
+        h_pad[0, :r] = np.concatenate(row_blocks, axis=0).astype(
+            self.transfer_dtype
+        )
+        slots_pad = np.full((rb,), oob, dtype=np.int32)
+        slots_pad[:r] = slots
+        positions = np.zeros((1, rb), dtype=np.int32)
+        # padding rows own no sequence (q_seq >= B): fully masked in the
+        # kernel, sliced away with the pad rows
+        q_seq = np.full((rb,), sb, dtype=np.int32)
+        nt = np.zeros((sb,), dtype=np.int32)
+        tree_rows = np.zeros((rb, t_max), dtype=np.int32)
+        off = 0
+        s_i = 0
+        for m_i, hid in enumerate(hiddens):
+            b_i, t_i, _ = hid.shape
+            tm = np.asarray(tree_masks[m_i], dtype=bool)
+            dep = np.asarray(depths_list[m_i], dtype=np.int32)
+            for row in range(b_i):
+                positions[0, off : off + t_i] = starts[s_i] + dep[row]
+                q_seq[off : off + t_i] = s_i
+                nt[s_i] = t_i
+                tree_rows[off : off + t_i, :t_i] = tm[row]
+                off += t_i
+                s_i += 1
+        pt_pad = np.zeros((sb, pb), dtype=np.int32)
+        pt_pad[:n_seqs] = self.manager.page_table(combined, pb)
+        lens_pad = np.zeros((sb,), dtype=np.int32)
+        lens_pad[:n_seqs] = total_lens
+        num_layers = self.manager.num_layers
+        layer_active = np.ones((num_layers,), dtype=np.int32)
+        if layers is not None:
+            layer_active[:] = 0
+            layer_active[layers[0] : layers[1]] = 1
+        plan = pack_ragged_plan(
+            slots_pad, pt_pad, positions, lens_pad, q_seq, layer_active,
+            nt=nt, tree_rows=tree_rows,
+        )
+
+        # ragged-kernel eligibility mirrors mixed_group's gate; ineligible
+        # configs run attend_ragged's tree branch — still ONE dispatch
+        use_kernel = bool(
+            not getattr(self, "_paged_broken", False)
+            and self.manager.quant is None
+            and rb * spec.num_attention_heads <= 2048
+            and pb * self.page_size >= env.get("BBTPU_PAGED_MIN_CONTEXT")
+            and not spec.alibi
+            and not spec.attn_logit_softcap
+            and env.get("BBTPU_PAGED_ATTENTION")
+            and (
+                jax.default_backend() == "tpu"
+                or env.get("BBTPU_PAGED_INTERPRET")
+            )
+        )
+
+        payload_dev = jnp.asarray(pack_step_payload(h_pad, plan))
+        arena = self.manager.arena
+
+        def _run(use_kernel_now: bool):
+            return span_step_ragged(
+                self.params,
+                arena["k"],
+                arena["v"],
+                payload_dev,
+                lora,
+                spec=spec,
+                r=rb,
+                n_seqs=sb,
+                page_size=self.page_size,
+                max_pages=pb,
+                windows=self.windows,
+                use_kernel=use_kernel_now,
+                t_max=t_max,
+            )
+
+        try:
+            out, new_k, new_v = _run(use_kernel)
+        except Exception:
+            # same self-heal contract as _step: retry on the dense ragged
+            # path only if the donated arena buffers are still alive
+            if self._arena_consumed(arena):
+                self._rebuild_after_failure("tree ragged step")
+                raise
+            if not use_kernel:
+                raise
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "paged ragged tree kernel failed; retrying on the dense "
+                "ragged path"
+            )
+            out, new_k, new_v = _run(False)
+            self._paged_broken = True
+        self.manager.arena = {"k": new_k, "v": new_v}
+        return out[0, :r], combined
+
     def fetch(self, out) -> np.ndarray:
         """Materialize a fetch=False result on host in the wire dtype
         (blocks on the device round trip — call off the compute queue).

@@ -289,6 +289,8 @@ def attend_ragged(
     q_seq: jax.Array,  # [R] owning sequence per token (>= B = padding)
     total_lens: jax.Array,  # [B]
     window,  # traced int32 scalar; 0 = full attention
+    nt: jax.Array | None = None,  # [B] in-step token count per sequence
+    tree_rows: jax.Array | None = None,  # [R, t_max] in-step visibility
 ) -> jax.Array:  # [R, H, hd]
     """Dense fallback for the ragged mixed-batch step: every token row
     attends the full [B, S] cross-session context and masks everything it
@@ -296,18 +298,34 @@ def attend_ragged(
     soft-cap, quantized arenas via gather_pages dequant) so those models
     still get the single fused dispatch. The x B masked logits columns are
     the fallback's price; padding rows (q_seq >= B) are fully masked and
-    softmax to garbage that the executor slices away."""
+    softmax to garbage that the executor slices away.
+
+    (nt, tree_rows) switch the causal term into ragged TREE-verify
+    semantics: sequence b's last nt[b] storage slots hold this step's
+    speculative tree tokens, committed keys (storage pos < lens - nt) stay
+    fully visible, and row i sees in-step slot m of its own sequence iff
+    tree_rows[i, m]. Causality between in-step tokens is entirely encoded
+    by tree_rows (ancestor-or-self), since depth positions repeat across
+    sibling branches."""
     r, h, hd = q.shape
     b, s = k_ctx.shape[:2]
     key_pos = jnp.arange(s, dtype=jnp.int32)[None, None, :]  # [1, 1, S]
     seq_ids = jnp.arange(b, dtype=jnp.int32)[None, :, None]  # [1, B, 1]
     qp = q_pos[:, None, None]  # [R, 1, 1]
-    mask = (
-        (q_seq[:, None, None] == seq_ids)
-        & (key_pos < total_lens[None, :, None])
-        & (key_pos <= qp)
+    own = (q_seq[:, None, None] == seq_ids) & (
+        key_pos < total_lens[None, :, None]
     )
-    mask &= (window <= 0) | (key_pos > (qp - window))
+    if tree_rows is None:
+        mask = own & (key_pos <= qp)
+        mask &= (window <= 0) | (key_pos > (qp - window))
+    else:
+        t_max = tree_rows.shape[1]
+        step_start = (total_lens - nt)[None, :, None]  # [1, B, 1]
+        m = key_pos - step_start  # [1, B, S] in-step slot index (or < 0)
+        mc = jnp.clip(m[0], 0, t_max - 1)  # [B, S]
+        vis = tree_rows[:, mc] > 0  # [R, B, S]
+        in_step = (m >= 0) & (key_pos < total_lens[None, :, None])
+        mask = own & ((key_pos < step_start) | (in_step & vis))
 
     n_rep = h // k_ctx.shape[2]
     k_r = repeat_kv(k_ctx, n_rep)  # [B, S, H, hd]
@@ -350,13 +368,16 @@ def layer_body_ragged(
     window,  # traced per-layer scalar
     use_kernel: bool = False,  # static: ragged Pallas kernel vs dense
     lora: dict | None = None,
+    nt: jax.Array | None = None,  # [B] in-step token counts (tree groups)
+    tree_rows: jax.Array | None = None,  # [R, t_max] in-step visibility
 ):
     """layer_body for the ragged mixed-batch step: one [1, R, D] row-major
-    pack of N decode tokens plus one prefill chunk's tokens. Projections,
-    rotary, and the arena scatter are position-wise, so they need no
-    per-member structure — only attention does, and it gets it from
-    (q_seq, q_positions) per row instead of layer_body's block-uniform
-    (B, T)."""
+    pack of N decode tokens plus one prefill chunk's tokens — or, when
+    (nt, tree_rows) are given, N sessions' speculative TREE rows verifying
+    in one dispatch. Projections, rotary, and the arena scatter are
+    position-wise, so they need no per-member structure — only attention
+    does, and it gets it from (q_seq, q_positions) per row instead of
+    layer_body's block-uniform (B, T)."""
     _, r, d = hidden.shape
     h_heads, kv_heads, hd = (
         spec.num_attention_heads,
@@ -392,7 +413,8 @@ def layer_body_ragged(
             q_seq, q_positions[0],
             page_size=page_size, scale=attn_scale(spec),
             interpret=jax.default_backend() != "tpu",
-            window=window,
+            window=window, nt=nt, tree_rows=tree_rows,
+            has_tree=tree_rows is not None,
         )[None]
     else:
         k_ctx = gather_pages(
@@ -403,7 +425,7 @@ def layer_body_ragged(
         ).astype(hidden.dtype)
         attn = attend_ragged(
             spec, q[0], k_ctx, v_ctx, q_positions[0], q_seq, total_lens,
-            window,
+            window, nt=nt, tree_rows=tree_rows,
         )[None]
     attn_out = _proj(
         attn.reshape(1, r, h_heads * hd), params, "o_proj", lora

@@ -253,12 +253,17 @@ span_step = functools.partial(
 
 
 def unpack_ragged_plan(
-    plan: jax.Array, r: int, n_seqs: int, max_pages: int, num_layers: int
+    plan: jax.Array, r: int, n_seqs: int, max_pages: int, num_layers: int,
+    t_max: int = 0,
 ):
     """unpack_plan for the ragged mixed-batch step: token-axis vectors are
     [R] (one entry per ragged token row) and sequence-axis vectors are
     [n_seqs], tied together by q_seq — [slots(R) | page_table(B*max_pages)
-    | positions(R) | total_lens(B) | q_seq(R) | layer_active(L)]."""
+    | positions(R) | total_lens(B) | q_seq(R) | layer_active(L)]. A ragged
+    TREE-verify group (t_max > 0) appends two more segments:
+    [... | nt(B) | tree_rows(R*t_max)] — nt[b] is sequence b's in-step
+    (speculative) token count and tree_rows[i, m] says whether token row i
+    may attend the m-th in-step token of its own sequence."""
     o1 = r
     o2 = o1 + n_seqs * max_pages
     o3 = o2 + r
@@ -269,25 +274,40 @@ def unpack_ragged_plan(
     q_positions = plan[o2:o3].reshape(1, r)
     total_lens = plan[o3:o4]
     q_seq = plan[o4:o5]
-    layer_active = plan[o5 : o5 + num_layers]
-    return slots, page_table, q_positions, total_lens, q_seq, layer_active
+    o6 = o5 + num_layers
+    layer_active = plan[o5:o6]
+    if not t_max:
+        return (
+            slots, page_table, q_positions, total_lens, q_seq, layer_active,
+            None, None,
+        )
+    o7 = o6 + n_seqs
+    nt = plan[o6:o7]
+    tree_rows = plan[o7 : o7 + r * t_max].reshape(r, t_max)
+    return (
+        slots, page_table, q_positions, total_lens, q_seq, layer_active,
+        nt, tree_rows,
+    )
 
 
 def pack_ragged_plan(
-    slots, page_table, q_positions, total_lens, q_seq, layer_active
+    slots, page_table, q_positions, total_lens, q_seq, layer_active,
+    nt=None, tree_rows=None,
 ):
     import numpy as np
 
-    return np.concatenate(
-        [
-            np.ravel(slots).astype(np.int32),
-            np.ravel(page_table).astype(np.int32),
-            np.ravel(q_positions).astype(np.int32),
-            np.ravel(total_lens).astype(np.int32),
-            np.ravel(q_seq).astype(np.int32),
-            np.ravel(layer_active).astype(np.int32),
-        ]
-    )
+    parts = [
+        np.ravel(slots).astype(np.int32),
+        np.ravel(page_table).astype(np.int32),
+        np.ravel(q_positions).astype(np.int32),
+        np.ravel(total_lens).astype(np.int32),
+        np.ravel(q_seq).astype(np.int32),
+        np.ravel(layer_active).astype(np.int32),
+    ]
+    if nt is not None:
+        parts.append(np.ravel(nt).astype(np.int32))
+        parts.append(np.ravel(tree_rows).astype(np.int32))
+    return np.concatenate(parts)
 
 
 def span_step_ragged_impl(
@@ -304,19 +324,24 @@ def span_step_ragged_impl(
     max_pages: int,
     windows: tuple | None = None,
     use_kernel: bool = False,
+    t_max: int = 0,
 ):
     """The ragged mixed-batch span step: N single-token decode members plus
     one prefill-chunk member packed into ONE [1, R, D] dispatch (the
     Sarathi-Serve fused iteration). Rides pack_step_payload as a b=1, t=R
     hidden; per-row (q_seq, q_positions) carry the member structure the
-    block shapes no longer do. No tree masks, prompts, or offload-resident
-    splits here — those step types stay on their dedicated paths (the
-    executor gates eligibility host-side)."""
+    block shapes no longer do. t_max > 0 switches the step into the ragged
+    TREE-verify variant: the plan carries per-sequence in-step counts and
+    per-row tree visibility, so N sessions' speculative trees verify in one
+    dispatch. No prompts or offload-resident splits here — those step types
+    stay on their dedicated paths (the executor gates eligibility
+    host-side)."""
     hidden, plan = unpack_step_payload(payload, 1, r, spec.hidden_size)
     num_layers = arena_k.shape[0]
-    slots, page_table, q_positions, total_lens, q_seq, layer_active = (
-        unpack_ragged_plan(plan, r, n_seqs, max_pages, num_layers)
-    )
+    (
+        slots, page_table, q_positions, total_lens, q_seq, layer_active,
+        nt, tree_rows,
+    ) = unpack_ragged_plan(plan, r, n_seqs, max_pages, num_layers, t_max)
     cos, sin = rotary_cos_sin(q_positions, spec.head_dim, spec.rope_theta)
     cos = cos.astype(hidden.dtype)
     sin = sin.astype(hidden.dtype)
@@ -348,6 +373,7 @@ def span_step_ragged_impl(
                 spec, page_size, h, params_l, k_l, v_l, cos_l, sin_l,
                 slots, page_table, q_positions, total_lens, q_seq,
                 window_l, use_kernel=use_kernel, lora=lora_l,
+                nt=nt, tree_rows=tree_rows,
             )
 
         def skip(h, k_l, v_l):
@@ -364,7 +390,7 @@ span_step_ragged = functools.partial(
     jax.jit,
     static_argnames=(
         "spec", "r", "n_seqs", "page_size", "max_pages", "windows",
-        "use_kernel",
+        "use_kernel", "t_max",
     ),
     donate_argnames=("arena_k", "arena_v"),
 )(span_step_ragged_impl)

@@ -24,6 +24,7 @@ import asyncio
 import dataclasses
 import logging
 import uuid
+from collections import OrderedDict
 
 import numpy as np
 
@@ -125,6 +126,18 @@ env.declare(
     "prefill no longer costs decodes a whole dispatch each. Off = the "
     "decode-only batcher and per-chunk prefill tasks, byte-for-byte",
 )
+env.declare(
+    "BBTPU_SPEC_BATCH", bool, False,
+    "batched tree-speculative verification: let concurrent sessions' "
+    "tree-verify steps that share (layers, adapter, dtype) pad/stack into "
+    "ONE ragged span dispatch (executor.tree_group) instead of a solo "
+    "dispatch per speculating session; per-session speculative KV still "
+    "commits/rolls back row-by-row and the accept-rides-next-step "
+    "protocol is unchanged. Falls back to solo tree steps on configs the "
+    "ragged tree step doesn't cover (TP mesh, weight offload, hetero "
+    "spans, top-k attention, sliding-window layers). Off = every "
+    "tree-verify step dispatches solo, byte-for-byte",
+)
 
 
 class _ChainError(RuntimeError):
@@ -166,6 +179,21 @@ class _ChunkMember:
     prefix_skip: object = None
 
 
+@dataclasses.dataclass
+class _TreeMember:
+    """One session's tree-verify step inside a batched ragged dispatch
+    (--spec-batch): the linearized draft tree's rows verify alongside
+    other sessions' trees in one executor.tree_group call. `handle` may be
+    a row slice of the session handle (the client shrinks the step to its
+    live-row window as rows finish)."""
+
+    session: "_Session"
+    handle: object
+    hidden: np.ndarray  # [b, t, D] in the wire dtype
+    tree_mask: np.ndarray  # [b, t, t] bool ancestor-or-self visibility
+    depths: np.ndarray  # [b, t] i32 node depths (rotary offsets)
+
+
 class _Session:
     def __init__(self, session_id: str, handle, batch_size: int,
                  layers: tuple[int, int] | None = None,
@@ -198,6 +226,12 @@ class _Session:
         # last pruned tree step's (hidden, tokens, parents) for online
         # pruner-head training when its accept arrives
         self.last_tree = None
+        # per-session measured speculation: drafted tree tokens this
+        # session verified and how many its accepts kept (the server half
+        # of the drafter's feedback loop — surfaced via rpc_info so an
+        # operator can see which streams speculate productively)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # session-KV replication to a standby (client-directed kv_repl
         # items): standby (host, port), the client's full-history hash
         # chains per row, pages already shipped per row, and a lock so
@@ -366,6 +400,13 @@ class BlockServer:
         # doesn't cover (TP mesh, weight offload, hetero spans, top-k
         # attention). None -> BBTPU_MIXED_BATCH env; off = current
         # decode-only batching, byte-for-byte
+        spec_batch: bool | None = None,  # batched tree-speculative
+        # verification: pad/stack concurrent sessions' compatible
+        # tree-verify steps into ONE ragged span dispatch
+        # (executor.tree_group) instead of one solo dispatch per
+        # speculating session; falls back to solo tree steps on configs
+        # the ragged tree step doesn't cover. None -> BBTPU_SPEC_BATCH
+        # env; off = solo tree dispatches, byte-for-byte
     ):
         self.model_dir = model_dir
         if weight_quant is None:
@@ -548,6 +589,19 @@ class BlockServer:
                 )
                 mixed_batch = False
         self.mixed_batch = bool(mixed_batch)
+        if spec_batch is None:
+            spec_batch = bool(env.get("BBTPU_SPEC_BATCH"))
+        if spec_batch:
+            reason = self.executor.tree_group_unsupported()
+            if reason is not None:
+                logger.info(
+                    "batched tree verification disabled: %s", reason
+                )
+                spec_batch = False
+        # tree-verify keys coalesce via the queue's exact-key fallback
+        # (trees of differing size share one ("tree", ...) key), so no
+        # extra compat predicate is needed here
+        self.spec_batch = bool(spec_batch)
         if self.mixed_batch:
             # one extra group slot for the prefill chunk, so fusing never
             # costs the decode batcher any of its max_batch decode seats
@@ -616,6 +670,21 @@ class BlockServer:
         self.mixed_tokens = 0
         self.step_dispatches = 0
         self.step_tokens = 0
+        # speculative-decode observability (previously client-side only):
+        # tree-verify steps served (solo or grouped), the session rows
+        # they carried, drafted vs accepted speculative tokens (from the
+        # accept metas riding each next step), and the batched-verification
+        # group counters behind mean_tree_batch_width
+        self.tree_steps = 0
+        self.tree_rows = 0
+        self.spec_tokens_drafted = 0
+        self.spec_tokens_accepted = 0
+        self.tree_group_dispatches = 0
+        self.tree_group_members = 0
+        # per-session acceptance outlives the session: closed sessions'
+        # drafted/accepted tallies stay probeable (bounded ring) so an
+        # operator can still see which finished streams speculated well
+        self._closed_session_spec: "OrderedDict[str, dict]" = OrderedDict()
         # overload protection: the admission controller sheds NEW work
         # past the high watermark (established streams are never routed
         # through it); the load advert republishes live queue gauges
@@ -1252,6 +1321,44 @@ class BlockServer:
             "dispatches_per_token": (
                 self.step_dispatches / max(self.step_tokens, 1)
             ),
+            # spec-decode observability (batched tree verification):
+            # tree-verify steps served, the session rows they carried,
+            # drafted vs accepted speculative tokens (from the accept
+            # metas riding each next step — the server half of the
+            # drafter's feedback loop), and the batched-group counters
+            # (mean_tree_batch_width > 1 means sessions actually fused)
+            "spec_batch": self.spec_batch,
+            "tree_steps": self.tree_steps,
+            "tree_rows": self.tree_rows,
+            "spec_tokens_drafted": self.spec_tokens_drafted,
+            "spec_tokens_accepted": self.spec_tokens_accepted,
+            "spec_accept_rate": (
+                self.spec_tokens_accepted / max(self.spec_tokens_drafted, 1)
+            ),
+            "tree_group_dispatches": self.tree_group_dispatches,
+            "tree_group_members": self.tree_group_members,
+            "mean_tree_batch_width": (
+                self.tree_group_members / self.tree_group_dispatches
+                if self.tree_group_dispatches else 0.0
+            ),
+            # per-session measured acceptance, keyed by session id: which
+            # streams speculate(d) productively (a cold stream's low rate
+            # is the signal the client's auto-tuner shrinks on); recently
+            # closed sessions stay visible via the bounded teardown ring
+            "session_spec": {
+                **dict(self._closed_session_spec),
+                **{
+                    sid: {
+                        "drafted": s.spec_drafted,
+                        "accepted": s.spec_accepted,
+                        "accept_rate": (
+                            s.spec_accepted / max(s.spec_drafted, 1)
+                        ),
+                    }
+                    for sid, s in self._sessions.items()
+                    if s.spec_drafted
+                },
+            },
             # prefix-cache observability: sessions that adopted pooled
             # prompt pages, tokens they skipped prefilling, copy-on-write
             # page splits, and current cached-pool occupancy (plus
@@ -1538,6 +1645,17 @@ class BlockServer:
                             break  # lease expired; pages reclaimed below
             finally:
                 self._sessions.pop(session_id, None)
+                if session.spec_drafted:
+                    self._closed_session_spec[session_id] = {
+                        "drafted": session.spec_drafted,
+                        "accepted": session.spec_accepted,
+                        "accept_rate": (
+                            session.spec_accepted
+                            / max(session.spec_drafted, 1)
+                        ),
+                    }
+                    while len(self._closed_session_spec) > 64:
+                        self._closed_session_spec.popitem(last=False)
                 session.parked = False
                 # release the resume handler carrying the current stream
                 # (it returns once we are done with its stream)
@@ -2000,6 +2118,12 @@ class BlockServer:
                 ):
                     return
                 raise
+            # measured speculation: each row's accept keeps its surviving
+            # path beyond node 0 (node 0 is the previous round's bonus
+            # token — certain, not drafted)
+            kept = sum(max(0, len(a) - 1) for a in accept)
+            self.spec_tokens_accepted += kept
+            session.spec_accepted += kept
         if meta.get("accept_only"):
             # the accept above compacted KV: record before delivery so a
             # retried accept after a lost ack never compacts twice
@@ -2043,6 +2167,14 @@ class BlockServer:
             tree_mask = np.asarray(tensors[1], dtype=bool)
             if meta.get("depths") is not None:
                 depths = np.asarray(meta["depths"], dtype=np.int32)
+            # spec-decode observability: every tree-verify step counts
+            # (solo or grouped); node 0 of each row is the previous bonus
+            # token, so drafted = rows * (nodes - 1)
+            drafted = int(hidden.shape[0]) * max(0, int(hidden.shape[1]) - 1)
+            self.tree_steps += 1
+            self.tree_rows += int(hidden.shape[0])
+            self.spec_tokens_drafted += drafted
+            session.spec_drafted += drafted
         commit = bool(meta.get("commit", True))
         # micro-batch chunk: operate on a row slice of the session's cache
         # handle (seq_ids are independent, so a sub-handle is just a slice)
@@ -2093,6 +2225,22 @@ class BlockServer:
                     # decode-group path for chunk-free groups
                     self._compute_mixed_group if self.mixed_batch
                     else self._compute_step_group,
+                    deadline=deadline,
+                    task_class="decode",
+                )
+            elif self._tree_batchable(commit, tree_mask, depths,
+                                      commit_lens, meta):
+                # batched tree verification: compatible tree-verify steps
+                # of OTHER speculating sessions that are queued right now
+                # (or arrive within BBTPU_BATCH_WINDOW_MS) pad/stack into
+                # one ragged span dispatch; trees of differing size share
+                # the key (size is not part of it)
+                out_dev, t_dispatch_ms = await self.compute.submit_group(
+                    PRIORITY_INFERENCE,
+                    ("tree", session.layers, session.adapter,
+                     str(hidden.dtype)),
+                    _TreeMember(session, handle, hidden, tree_mask, depths),
+                    self._compute_tree_group,
                     deadline=deadline,
                     task_class="decode",
                 )
@@ -3213,6 +3361,138 @@ class BlockServer:
             b = m.handle.batch_size
             outs.append((out[row:row + b], dt_ms))
             row += b
+        return outs
+
+    # ----------------------------------------- batched tree verification
+    def _tree_batchable(
+        self, commit, tree_mask, depths, commit_lens, meta
+    ) -> bool:
+        """Whether this tree-verify step may share a batched ragged
+        dispatch (--spec-batch): a plain speculative (commit=False) tree
+        step with depth positions. Pruned relay steps keep the solo path
+        (their keep-set reply is computed per session against the solo
+        step's layout), as do failover replays (commit_lens) and
+        prefix-skip settles; a draining server stops coalescing."""
+        return (
+            self.spec_batch
+            and self.max_batch > 1
+            and tree_mask is not None
+            and depths is not None
+            and not commit
+            and commit_lens is None
+            and meta.get("prune") is None
+            and meta.get("prefix_skip") is None
+            and not self._draining
+        )
+
+    def _compute_tree_group(self, members: list[_TreeMember]) -> list:
+        """Runs on the compute thread: execute a group of compatible
+        tree-verify steps as ONE ragged span dispatch. Returns one outcome
+        per member — (lazy [b, t, D] out, dispatch_ms) or an Exception
+        instance, which the queue raises only at that member's caller.
+
+        Same member hygiene as _compute_step_group: stale-epoch members
+        fail typed, parked / adoption-unsettled members fall out to the
+        solo tree path, and a failed group dispatch truncates every
+        member's speculation back to its pre-dispatch length and replays
+        solo, so one session's fault never sinks its co-batched peers."""
+        results: list = [None] * len(members)
+        ready: list[int] = []
+        for i, m in enumerate(members):
+            if not self.manager.epoch_valid(m.handle):
+                results[i] = SessionKVLost(
+                    "server KV arena was rebuilt; session cache lost — "
+                    "replay"
+                )
+            elif (self.manager.has_parked(m.handle)
+                  or (not m.session.adoption_settled
+                      and self.manager.has_adopted(m.handle))):
+                results[i] = self._solo_tree_step(m)
+            else:
+                ready.append(i)
+        if len(ready) == 1:
+            results[ready[0]] = self._solo_tree_step(members[ready[0]])
+        elif ready:
+            group = [members[i] for i in ready]
+            try:
+                outs = self._dispatch_tree_group(group)
+            except Exception as e:
+                logger.warning(
+                    "batched tree verification of %d sessions failed "
+                    "(%r); replaying solo", len(group), e,
+                )
+                outs = [self._solo_tree_step(m) for m in group]
+            for i, out in zip(ready, outs):
+                results[i] = out
+        return results
+
+    def _solo_tree_step(self, m: _TreeMember):
+        self.batch_solo_steps += 1
+        try:
+            return self._compute_step(
+                m.session, m.handle, m.hidden, False, m.tree_mask,
+                m.depths,
+            )
+        except Exception as e:
+            return e
+
+    def _dispatch_tree_group(self, group: list[_TreeMember]) -> list:
+        """One ragged span dispatch for >= 2 sessions' tree-verify steps.
+        Every member's tree rows write in SPECULATIVELY; a tree step
+        enters with an EMPTY speculative region (the previous round's
+        accept settled before this step was queued), so a failed dispatch
+        truncates each member back to its pre-dispatch committed length —
+        row-by-row, exactly as decode_group members roll back — and the
+        solo replay re-verifies from a clean table. On success nothing
+        commits here: the surviving slots settle when each session's next
+        accept rides in (accept_speculative, unchanged)."""
+        import time
+
+        t0 = time.perf_counter()
+        now = time.monotonic()
+        for m in group:
+            m.session.last_step_at = now
+        handles = [m.handle for m in group]
+        snaps = [
+            [int(x) for x in self.manager.context_lens(m.handle)]
+            for m in group
+        ]
+        try:
+            out, _combined = self.executor.tree_group(
+                handles,
+                [m.hidden for m in group],
+                [m.tree_mask for m in group],
+                [m.depths for m in group],
+                layers=group[0].session.layers,
+                adapter=group[0].session.adapter,
+            )
+        except Exception:
+            for m, snap in zip(group, snaps):
+                if self.manager.epoch_valid(m.handle):
+                    self.manager.truncate_speculative(m.handle, snap)
+            raise
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        self.tree_group_dispatches += 1
+        self.tree_group_members += len(group)
+        self.step_dispatches += 1
+        self.step_tokens += sum(
+            int(m.hidden.shape[0]) * int(m.hidden.shape[1]) for m in group
+        )
+        if self._chunking_sessions:
+            self.decode_steps_interleaved += len(group)
+        if env.log_channel_enabled("timing"):
+            logger.info(
+                "[timing] batched tree verify: %d sessions, %d rows, "
+                "dispatch_ms=%.2f",
+                len(group),
+                sum(int(m.hidden.shape[0]) for m in group), dt_ms,
+            )
+        outs = []
+        row = 0
+        for m in group:
+            b, t = int(m.hidden.shape[0]), int(m.hidden.shape[1])
+            outs.append((out[row:row + b * t].reshape(b, t, -1), dt_ms))
+            row += b * t
         return outs
 
     # --------------------------------------------------- mixed-batch dispatch

@@ -211,6 +211,7 @@ class GreedyTreeDrafter:
     def __init__(
         self, model: LocalJaxDraftModel, branching=(2, 2, 1),
         adaptive: bool = False, retune_every: int = 8,
+        shape_cost_per_node: float = 0.05,
     ):
         from bloombee_tpu.spec.shape import AcceptanceStats, tree_nodes
 
@@ -218,21 +219,36 @@ class GreedyTreeDrafter:
         self.branching = tuple(branching)
         self.adaptive = adaptive
         self.retune_every = retune_every
+        self.shape_cost_per_node = float(shape_cost_per_node)
         self.stats = AcceptanceStats()
         self._budget_nodes = tree_nodes(self.branching)
         self._rounds = 0
+        self.levels_drafted = 0
+        self.levels_accepted = 0
+
+    @property
+    def accept_rate(self) -> float:
+        """Measured drafted-level acceptance across every observed round —
+        the client-side mirror of the server's spec_accept_rate counter."""
+        return self.levels_accepted / max(self.levels_drafted, 1)
 
     def observe(self, accepted_lens: list[int]) -> None:
         """Feed per-row accepted DRAFTED-level counts from a verify round;
         periodically re-choose the branching when adaptive."""
         from bloombee_tpu.spec.shape import choose_branching
 
+        depth = len(self.branching)
         for a in accepted_lens:
             self.stats.observe(int(a), self.branching)
+            self.levels_drafted += depth
+            self.levels_accepted += min(int(a), depth)
         self._rounds += 1
         if self.adaptive and self._rounds % self.retune_every == 0:
             self.branching = choose_branching(
-                self.stats, budget_nodes=self._budget_nodes
+                self.stats, budget_nodes=self._budget_nodes,
+                cost_per_node=self.shape_cost_per_node,
+                current=self.branching,
+                grow_margin=2.0 * self.shape_cost_per_node,
             )
 
     def build(self, context_ids: np.ndarray) -> tuple[DraftTree, np.ndarray]:

@@ -50,13 +50,20 @@ class AcceptanceStats:
         self, accepted_len: int, branching: tuple[int, ...]
     ) -> None:
         """One round for one row: the tree had levels `branching` (per-level
-        widths) and `accepted_len` of them matched (0..len(branching))."""
+        widths) and `accepted_len` of them matched (0..len(branching)).
+
+        Decay is PER LEVEL, applied only when that level is actually
+        reached: an unreached level keeps its last measured rate instead
+        of fading back to the optimistic prior. Under acceptance collapse
+        level 0's rate falls monotonically while the frozen deeper rates
+        stay put, so the chooser's preferred tree shrinks monotonically
+        rather than oscillating as stale levels re-inflate."""
         depth = len(branching)
-        self.hits *= self.decay
-        self.tries *= self.decay
         for d in range(min(depth, self.max_depth)):
             if d > accepted_len:
                 break  # level d was never reached
+            self.hits[d] *= self.decay
+            self.tries[d] *= self.decay
             self.tries[d] += 1
             self.widths[d] = branching[d]  # rate observed at THIS width
             if d < accepted_len:
@@ -100,13 +107,36 @@ def choose_branching(
     stats: AcceptanceStats,
     candidates=DEFAULT_CANDIDATES,
     budget_nodes: int = 16,
+    cost_per_node: float = 0.0,
+    current: tuple[int, ...] | None = None,
+    grow_margin: float = 0.0,
 ) -> tuple[int, ...]:
     """Best candidate under the node budget; ties prefer fewer nodes
-    (cheaper verify step)."""
+    (cheaper verify step).
+
+    `cost_per_node` charges every tree node a fixed expected-token cost:
+    E alone is monotone in node count (each extra level or child can only
+    add expected accepts), so without a cost the chooser always maxes the
+    budget. With one, collapsed acceptance makes every node a net loss and
+    the tree shrinks toward the smallest candidate.
+
+    `current`/`grow_margin` add growth hysteresis: a LARGER tree than
+    `current` is adopted only when its score beats current's by the
+    margin. The per-child rate estimate shifts with the width it was
+    observed at, so near-tied small candidates can flap on width changes
+    alone — shrinking is always allowed, growing must clear real signal."""
     viable = [c for c in candidates if tree_nodes(c) <= budget_nodes]
     if not viable:
         viable = [min(candidates, key=tree_nodes)]
-    return max(
-        viable,
-        key=lambda c: (expected_accepted(c, stats), -tree_nodes(c)),
-    )
+
+    def score(c):
+        return expected_accepted(c, stats) - cost_per_node * tree_nodes(c)
+
+    best = max(viable, key=lambda c: (score(c), -tree_nodes(c)))
+    if (
+        current is not None
+        and tree_nodes(best) > tree_nodes(current)
+        and score(best) < score(tuple(current)) + grow_margin
+    ):
+        return tuple(current)
+    return best

@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # Chaos gate: replay the chaos-marked suite under a fixed seed matrix of
 # ambient wire faults (the BBTPU_CHAOS_* env plan). Each entry is
-# "SEED:DELAY_P:ADMIT:PARTITION_P:MIXED" — mild delay-only ambient chaos, so
+# "SEED:DELAY_P:ADMIT:PARTITION_P:MIXED:SPEC" — mild delay-only ambient
+# chaos, so
 # the per-test seeded FaultPlans stay the dominant fault source while
 # connections opened before a test installs its plan still see injected
 # jitter; the ADMIT flag additionally turns on server admission control
@@ -12,7 +13,9 @@
 # what keep the suite green (keepalive is forced small for that entry);
 # MIXED=1 turns on mixed-batch dispatch (BBTPU_MIXED_BATCH) so the fused
 # decode+prefill path and its solo-replay failure recovery run under the
-# same ambient jitter.
+# same ambient jitter; SPEC=1 turns on batched tree-speculative
+# verification (BBTPU_SPEC_BATCH) so grouped tree-verify dispatches and
+# their rollback-then-solo-replay recovery run under ambient jitter too.
 # Fixed seeds keep every run replayable bit-for-bit (wire/faults.py
 # contract).
 # Exits 0 when pytest is unavailable (mirrors scripts/lint.sh).
@@ -24,12 +27,13 @@ if ! python -c "import pytest" >/dev/null 2>&1; then
     exit 0
 fi
 
-MATRIX=("11:0.05:0:0:0" "23:0.1:0:0:0" "31:0.05:1:0:0" "43:0.02:0:0.02:0"
-        "57:0.05:0:0:1")
+MATRIX=("11:0.05:0:0:0:0" "23:0.1:0:0:0:0" "31:0.05:1:0:0:0"
+        "43:0.02:0:0.02:0:0" "57:0.05:0:0:1:0" "71:0.05:0:0:0:1")
 for entry in "${MATRIX[@]}"; do
-    IFS=: read -r seed delay_p admit partition_p mixed <<<"${entry}"
+    IFS=: read -r seed delay_p admit partition_p mixed spec <<<"${entry}"
     partition_p="${partition_p:-0}"
     mixed="${mixed:-0}"
+    spec="${spec:-0}"
     # partitioned conns go silent instead of erroring: a small keepalive
     # turns the blackhole into a prompt local abort so lease park/resume
     # (not a step_timeout expiry) is the recovery path under test
@@ -38,7 +42,7 @@ for entry in "${MATRIX[@]}"; do
         keepalive_s=0.5
     fi
     echo "chaos: seed=${seed} delay_p=${delay_p} admit=${admit}" \
-         "partition_p=${partition_p} mixed=${mixed}" >&2
+         "partition_p=${partition_p} mixed=${mixed} spec=${spec}" >&2
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     BBTPU_CHAOS=1 \
     BBTPU_CHAOS_SEED="${seed}" \
@@ -49,6 +53,7 @@ for entry in "${MATRIX[@]}"; do
     BBTPU_ADMIT="${admit}" \
     BBTPU_ADMIT_HIGH_MS=400 \
     BBTPU_MIXED_BATCH="${mixed}" \
+    BBTPU_SPEC_BATCH="${spec}" \
     python -m pytest tests/ -q -m chaos \
         -p no:cacheprovider -p no:xdist -p no:randomly "$@"
 done

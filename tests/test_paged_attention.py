@@ -834,3 +834,137 @@ def test_paged_ragged_matches_dense_and_sibling_kernels(seed, window):
     np.testing.assert_allclose(
         got[chunk_rows], chunk_got[0], rtol=2e-5, atol=2e-5
     )
+
+
+def dense_tree_ragged_reference(
+    q, k_slab, v_slab, page_table, lens, nt, q_seq, q_pos, tree_rows,
+    page_size,
+):
+    """Per-row numpy reference for the ragged TREE-verify mask: committed
+    keys (pos < len - nt) are fully visible; in-step slot m of the row's
+    own sequence is visible iff tree_rows[i, m]."""
+    r, h, hd = q.shape
+    hkv = k_slab.shape[1]
+    g = h // hkv
+    b = page_table.shape[0]
+    out = np.zeros((r, h, hd), np.float32)
+    for i in range(r):
+        sq = int(q_seq[i])
+        if sq >= b:
+            continue
+        slots = [
+            p * page_size + o
+            for p in page_table[sq]
+            for o in range(page_size)
+        ]
+        k = k_slab[np.asarray(slots)]
+        v = v_slab[np.asarray(slots)]
+        n = k.shape[0]
+        ss = int(lens[sq]) - int(nt[sq])
+        pos = np.arange(n)
+        mask = pos < ss
+        for m in range(int(nt[sq])):
+            if tree_rows[i, m]:
+                mask |= pos == ss + m
+        mask &= pos < int(lens[sq])
+        for head in range(h):
+            kv = head // g
+            logits = (q[i, head].astype(np.float32) @
+                      k[:, kv].astype(np.float32).T) * hd**-0.5
+            logits = np.where(mask, logits, -1e30)
+            p_att = np.exp(logits - logits.max())
+            p_att = p_att / p_att.sum()
+            out[i, head] = p_att @ v[:, kv].astype(np.float32)
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_paged_ragged_tree_matches_dense_reference(seed):
+    """Parity gate for the ragged TREE-verify kernel variant: N sessions'
+    linearized trees (random ancestor-or-self structures, differing sizes,
+    zero-padded tree rows) over shuffled disjoint pages must match the
+    per-row dense reference, and padding rows emit exact zeros."""
+    from bloombee_tpu.ops.pallas.paged_attention import (
+        paged_ragged_attention,
+    )
+    from bloombee_tpu.spec.tree import DraftTree, tree_attention_mask
+
+    rng = np.random.default_rng(seed)
+    page_size = int(rng.choice([8, 16]))
+    hkv = int(rng.choice([1, 2]))
+    h = hkv * int(rng.choice([2, 4]))
+    hd = 64
+    b = int(rng.integers(2, 5))
+    max_pages = 4
+    # committed context per sequence, then a tree of t_b in-step tokens
+    committed = rng.integers(5, 20, size=b).astype(np.int32)
+    t_max = 8
+    nts = rng.integers(2, t_max + 1, size=b).astype(np.int32)
+    lens = (committed + nts).astype(np.int32)
+    assert int(lens.max()) <= page_size * max_pages
+
+    n_phys = b * max_pages + 2
+    pool = rng.permutation(n_phys)
+    page_table = np.zeros((b, max_pages), np.int32)
+    off = 0
+    for i in range(b):
+        need = -(-int(lens[i]) // page_size)
+        page_table[i, :need] = pool[off:off + need]
+        off += need
+    k_slab = rng.standard_normal(
+        (n_phys * page_size, hkv, hd)
+    ).astype(np.float32)
+    v_slab = rng.standard_normal(
+        (n_phys * page_size, hkv, hd)
+    ).astype(np.float32)
+
+    q_seq, q_pos = [], []
+    tree_rows = []
+    for i in range(b):
+        t = int(nts[i])
+        # random ancestor-or-self tree: node j's parent uniform in [-1, j)
+        parents = np.asarray(
+            [-1] + [int(rng.integers(-1, j)) for j in range(1, t)],
+            np.int64,
+        )
+        tree = DraftTree(
+            tokens=np.zeros(t, np.int64), parents=parents
+        )
+        tm = tree_attention_mask(tree)
+        depths = tree.depths()
+        q_seq.extend([i] * t)
+        q_pos.extend((int(committed[i]) + depths).tolist())
+        for row in range(t):
+            tr = np.zeros(t_max, np.int32)
+            tr[:t] = tm[row]
+            tree_rows.append(tr)
+    n_pad = int(rng.integers(0, 3))
+    for _ in range(n_pad):
+        q_seq.append(b)
+        q_pos.append(0)
+        tree_rows.append(np.zeros(t_max, np.int32))
+    q_seq = np.asarray(q_seq, np.int32)
+    q_pos = np.asarray(q_pos, np.int32)
+    tree_rows = np.stack(tree_rows)
+    r = len(q_seq)
+    q = rng.standard_normal((r, h, hd)).astype(np.float32)
+
+    got = np.asarray(
+        paged_ragged_attention(
+            jnp.asarray(q), jnp.asarray(k_slab), jnp.asarray(v_slab),
+            jnp.asarray(page_table), jnp.asarray(lens),
+            jnp.asarray(q_seq), jnp.asarray(q_pos),
+            page_size=page_size, interpret=True, window=0,
+            nt=jnp.asarray(nts), tree_rows=jnp.asarray(tree_rows),
+            has_tree=True,
+        )
+    )
+    want = dense_tree_ragged_reference(
+        q, k_slab, v_slab, page_table, lens, nts, q_seq, q_pos, tree_rows,
+        page_size,
+    )
+    np.testing.assert_allclose(
+        got[: r - n_pad], want[: r - n_pad], rtol=2e-5, atol=2e-5
+    )
+    if n_pad:
+        np.testing.assert_array_equal(got[r - n_pad:], 0.0)
