@@ -243,6 +243,43 @@ def _emit_json_locked():
         out["overload_light_share_unprotected"] = round(
             off.get("light_share", 0.0), 3
         )
+    asc = RESULTS.get("autoscale")
+    if asc:
+        # elastic self-healing: light-session decode TBT under a shifting
+        # heavy-prefill load with the standby control loop ON (promotes,
+        # absorbs the flood) vs OFF (same two processes, watermark parked
+        # at infinity), plus the kill-recovery leg: primary killed
+        # mid-generation, the client rides the dark window onto the
+        # promoted standby and the resumed tokens match an uninterrupted
+        # run exactly
+        el = asc.get("elastic") or {}
+        st = asc.get("static") or {}
+        out["autoscale_tbt_p95_elastic_ms"] = round(
+            el.get("tbt_p95_ms", 0.0), 1
+        )
+        out["autoscale_tbt_p95_static_ms"] = round(
+            st.get("tbt_p95_ms", 0.0), 1
+        )
+        out["autoscale_tbt_p95_speedup"] = round(
+            asc.get("tbt_p95_speedup", 0.0), 2
+        )
+        out["autoscale_promotions"] = int(el.get("promotions", 0))
+        out["autoscale_hard_failures"] = int(
+            el.get("hard_failures", 0) + st.get("hard_failures", 0)
+        )
+        rec = asc.get("recovery") or {}
+        out["autoscale_recover_stall_ms"] = round(
+            rec.get("stall_ms", 0.0), 1
+        )
+        out["autoscale_token_identical"] = bool(
+            rec.get("token_identical", False)
+        )
+        out["autoscale_recover_hard_failures"] = int(
+            rec.get("hard_failures", 0)
+        )
+        out["autoscale_recover_promotions"] = int(
+            rec.get("promotions", 0)
+        )
     if RESULTS.get("phases"):
         out["phases"] = RESULTS["phases"]
     if RESULTS.get("cpu_fallback"):
@@ -271,6 +308,11 @@ def _emit_json_locked():
     out["backend_degraded"] = bool(
         RESULTS.get("cpu_fallback") or RESULTS.get("degraded")
     )
+    # preflight verdict, stamped before any phase ran: True means the
+    # tunnel was already dead at bench start (see run_preflight) — a
+    # watchdog-partial or empty ledger with tunnel_down=True is a tunnel
+    # outage, not a code failure
+    out["tunnel_down"] = bool(RESULTS.get("tunnel_down"))
     print(json.dumps(out), flush=True)
 
 
@@ -291,6 +333,62 @@ def start_watchdog():
             os._exit(0)
 
     threading.Thread(target=watch, daemon=True).start()
+
+
+_PREFLIGHT_DEGRADED = (
+    "tunnel preflight failed: no usable jax backend at bench start "
+    "(tunnel_down)"
+)
+
+
+def run_preflight() -> bool:
+    """Cheap tunnel-health probe BEFORE the phase ledger: one short
+    subprocess backend init (a dead tunnel blocks PJRT init forever, so
+    never probe in-process). A failure stamps tunnel_down +
+    backend_degraded into the JSON up front — even a watchdog-partial
+    run then says WHY it is empty instead of leaving a bare rc to
+    disambiguate. _require_backend still rides out the outage afterwards
+    with its full retry budget; if it recovers, the preflight verdict is
+    amended rather than left stale."""
+    import subprocess
+
+    phase("preflight", "started")
+    probe_code = (
+        "import os, jax\n"
+        "if os.environ.get('JAX_PLATFORMS', '').strip() == 'cpu':\n"
+        "    jax.config.update('jax_platforms', 'cpu')\n"
+        "print(len(jax.devices()))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", probe_code],
+            timeout=45.0, capture_output=True, text=True,
+            env=os.environ.copy(),
+        )
+        ok = proc.returncode == 0 and proc.stdout.strip().isdigit()
+        detail = proc.stderr.strip()[-200:]
+    except subprocess.TimeoutExpired:
+        ok, detail = False, "probe timed out (wedged tunnel?)"
+    if ok:
+        phase("preflight", "ok")
+        return True
+    log(f"preflight: tunnel DOWN at bench start ({detail})")
+    phase("preflight", "tunnel_down")
+    RESULTS["tunnel_down"] = True
+    RESULTS.setdefault("degraded", _PREFLIGHT_DEGRADED)
+    return False
+
+
+def _preflight_recovered() -> None:
+    """The backend came up after a failed preflight: amend the up-front
+    tunnel_down stamp so a recovered run isn't reported as degraded for
+    an outage it rode out."""
+    if not RESULTS.get("tunnel_down"):
+        return
+    RESULTS["tunnel_down"] = False
+    phase("preflight", "tunnel_down_recovered")
+    if RESULTS.get("degraded") == _PREFLIGHT_DEGRADED:
+        del RESULTS["degraded"]
 
 
 def _require_backend():
@@ -367,6 +465,7 @@ def _require_backend():
                 log(f"backend probe ok after {attempt} attempt(s) "
                     f"({time.time() - t_start:.0f}s): "
                     f"{proc.stdout.strip()} device(s)")
+                _preflight_recovered()
                 return
             log(f"backend probe attempt {attempt} failed "
                 f"(rc={proc.returncode}): {proc.stderr.strip()[-200:]}")
@@ -384,6 +483,7 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    run_preflight()
     _require_backend()
     import jax
     import jax.numpy as jnp
@@ -631,6 +731,21 @@ def main():
         phase("overload", f"failed: {e!r}"[:200])
         RESULTS.setdefault("degraded", f"overload phase failed: {e!r}")
         log(f"overload phase FAILED: {e!r}")
+
+    # ---- autoscale phase: elastic self-healing under a shifting hot
+    # load. With the standby control loop ON the standby promotes when
+    # the primary's advertised queue delay crosses the watermark and
+    # absorbs the heavy flood (light decode TBT p95 must beat the same
+    # topology with the loop OFF); the kill-recovery leg then kills the
+    # primary mid-generation and requires a token-identical resume via
+    # standby promotion with zero hard session failures.
+    try:
+        phase("autoscale", "started")
+        run_autoscale(spec, params, smoke)
+    except Exception as e:  # noqa: BLE001
+        phase("autoscale", f"failed: {e!r}"[:200])
+        RESULTS.setdefault("degraded", f"autoscale phase failed: {e!r}")
+        log(f"autoscale phase FAILED: {e!r}")
 
     # ---- spec_decode phase: N concurrent speculating sessions. Solo mode
     # pays one device dispatch per session per tree round; --spec-batch
@@ -1488,6 +1603,339 @@ def run_overload(spec, params, smoke: bool) -> None:
         f"{unprotected['tbt_p95_ms']:.1f} ms, "
         f"{unprotected['hard_failures']} hard failures, light share "
         f"{unprotected['light_share']:.3f}"
+    )
+
+
+def run_autoscale(spec, params, smoke: bool) -> None:
+    """Elastic self-healing phase. Two legs:
+
+    1. TBT leg: one primary + one warm standby on the same span; N light
+       sessions decode steadily while heavy prefill sessions flood in (a
+       shifting hot prompt). With the control loop ON (fast watermarks)
+       the primary's load advert trips promotion, the standby starts
+       serving, and load-aware heavy routing drains the primary's queue
+       — light decode TBT p95 must beat the loop-OFF run (identical
+       topology, watermark parked at infinity, so ONLY the control loop
+       differs).
+    2. Kill-recovery leg: greedy generation through the primary, killed
+       after exactly half the tokens are out (deterministic relative to
+       progress, not wall clock). The client rides the dark window
+       (MissingBlocksError is retriable while the swarm heals), the
+       standby promotes on span loss, and the resumed run's tokens must
+       equal an uninterrupted reference exactly — zero hard session
+       failures."""
+    import asyncio
+
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.client.session import InferenceSession
+    from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    span_layers = spec.num_hidden_layers
+    PAGE = 16
+    PROMPT = 2 * PAGE
+    HEAVY = 96 if smoke else 384  # the shifting hot prompts
+    N_LIGHT = 2
+    N_HEAVY = 3
+    HEAVY_DEC = 8  # hot sessions decode too: they compete for the
+    # batcher's max_batch decode seats, which is exactly the queueing
+    # pressure promotion relieves (prefill alone rides the mixed
+    # dispatch chunk lane and would never crowd the lights)
+    DURATION = 5.0 if smoke else 10.0
+    # unmeasured lead-in: in elastic mode the promotion fires here and the
+    # freshly-promoted standby pays its jit-compile for the heavy prefill
+    # bucket OUTSIDE the measured window — otherwise the one-off compile
+    # transient dominates p95 and the comparison measures XLA, not the
+    # control loop
+    WARMUP = 4.0 if smoke else 8.0
+    SETTLE = 3.0
+    # a light session lives the WHOLE run (its decode budget covers
+    # warmup + settle + the measured window): renewal mid-window would
+    # re-route the light and muddy whose queue its gaps measure
+    LIGHT_BUDGET = 1000 if smoke else 2048
+    VOCAB_EFF = min(1024, spec.vocab_size)
+
+    def _server(rc, *, standby=False, elastic=True, uid="bench_as"):
+        kw = {}
+        if standby:
+            kw = {
+                "standby": True,
+                # OFF mode parks the high watermark at infinity: the
+                # standby stays warm but the control loop never fires,
+                # so the two modes differ ONLY in the loop
+                "promote_high_ms": 150.0 if elastic else 1e12,
+                "promote_low_ms": 30.0,
+                "promote_sustain_s": 0.5,
+                "promote_jitter_s": 0.2,
+            }
+        return BlockServer(
+            model_uid=uid, start=0, end=span_layers, params=params,
+            spec=spec, registry=rc,
+            num_pages=max(
+                256,
+                (
+                    N_LIGHT * (PROMPT + LIGHT_BUDGET)
+                    + (N_HEAVY + 1) * (HEAVY + HEAVY_DEC + 4)
+                ) // PAGE + 48,
+            ),
+            page_size=PAGE,
+            max_batch=N_LIGHT, announce_period=0.3, load_advert_s=0.25,
+            **kw,
+        )
+
+    async def tbt_mode(elastic: bool) -> dict:
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        primary = _server(rc(), elastic=elastic)
+        standby = _server(rc(), standby=True, elastic=elastic)
+        await primary.start()
+        await standby.start()
+
+        def mk_manager():
+            return RemoteSequenceManager(
+                rc(), "bench_as", span_layers,
+                load_aware=True, update_period=0.5,
+            )
+
+        rng = np.random.default_rng(23)
+        embed_table = (
+            rng.standard_normal((VOCAB_EFF, spec.hidden_size)) * 0.02
+        ).astype(np.float32)
+        light_mgr, heavy_mgr = mk_manager(), mk_manager()
+        gaps: list[float] = []
+        counts = {"hard_failures": 0, "heavy_completed": 0}
+        stop = asyncio.Event()
+        measuring = asyncio.Event()
+
+        async def one_token(s):
+            nid = rng.integers(0, VOCAB_EFF, size=(1, 1))
+            await s.step(embed_table[nid], ids=nid)
+
+        async def light_loop():
+            while not stop.is_set():
+                s = InferenceSession(
+                    light_mgr, max_length=PROMPT + LIGHT_BUDGET + 4,
+                    batch_size=1, client_id="bench-autoscale-light",
+                )
+                try:
+                    async with s:
+                        ids = rng.integers(0, VOCAB_EFF, size=(1, PROMPT))
+                        await s.step(embed_table[ids], ids=ids)
+                        for _ in range(LIGHT_BUDGET):
+                            if stop.is_set():
+                                return
+                            t0 = time.perf_counter()
+                            await one_token(s)
+                            if measuring.is_set():
+                                gaps.append(
+                                    (time.perf_counter() - t0) * 1000.0
+                                )
+                except Exception:  # noqa: BLE001
+                    counts["hard_failures"] += 1
+                    await asyncio.sleep(0.2)
+
+        async def heavy_loop():
+            while not stop.is_set():
+                ids = rng.integers(0, VOCAB_EFF, size=(1, HEAVY))
+                s = InferenceSession(
+                    heavy_mgr, max_length=HEAVY + HEAVY_DEC + 4,
+                    batch_size=1, client_id="bench-autoscale-heavy",
+                )
+                try:
+                    async with s:
+                        await s.step(embed_table[ids], ids=ids)
+                        for _ in range(HEAVY_DEC):
+                            if stop.is_set():
+                                break
+                            await one_token(s)
+                    if measuring.is_set():
+                        counts["heavy_completed"] += 1
+                except Exception:  # noqa: BLE001
+                    counts["hard_failures"] += 1
+                    await asyncio.sleep(0.2)
+
+        try:
+            # compile the heavy prefill bucket on the primary up front so
+            # the first flood wave is not a compile wave
+            warm = rng.integers(0, VOCAB_EFF, size=(1, HEAVY))
+            ws = InferenceSession(
+                heavy_mgr, max_length=HEAVY + 4, batch_size=1
+            )
+            async with ws:
+                await ws.step(embed_table[warm], ids=warm)
+
+            async def timer():
+                await asyncio.sleep(WARMUP)
+                if elastic:
+                    # the promotion should have fired during warmup; give
+                    # it a bounded grace, then let the promoted standby
+                    # absorb its compile transient before measuring
+                    deadline = time.monotonic() + 15.0
+                    while (
+                        not standby._promoted
+                        and time.monotonic() < deadline
+                    ):
+                        await asyncio.sleep(0.2)
+                await asyncio.sleep(SETTLE)
+                measuring.set()
+                await asyncio.sleep(DURATION)
+                stop.set()
+
+            await asyncio.gather(
+                timer(),
+                *(light_loop() for _ in range(N_LIGHT)),
+                *(heavy_loop() for _ in range(N_HEAVY)),
+            )
+            xs = sorted(gaps)
+
+            def pct(p):
+                return xs[min(len(xs) - 1, round(p * (len(xs) - 1)))]
+
+            return {
+                "tbt_p50_ms": pct(0.50) if xs else 0.0,
+                "tbt_p95_ms": pct(0.95) if xs else 0.0,
+                "decode_steps": len(gaps),
+                "heavy_completed": counts["heavy_completed"],
+                "hard_failures": counts["hard_failures"],
+                "promotions": standby.promotions,
+                "demotions": standby.demotions,
+                "promoted_at_end": bool(standby._promoted),
+            }
+        finally:
+            for stopper in (primary.stop, standby.stop, reg.stop):
+                try:
+                    await asyncio.wait_for(stopper(), timeout=30.0)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    async def recovery_leg() -> dict:
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        keys = _jax.random.split(_jax.random.PRNGKey(29), 2)
+        client_params = {
+            "embed": _jax.random.normal(
+                keys[0], (VOCAB_EFF, spec.hidden_size), _jnp.float32
+            ) * 0.02,
+            "norm": _jnp.ones((spec.hidden_size,), _jnp.float32),
+            "lm_head": _jax.random.normal(
+                keys[1], (spec.hidden_size, VOCAB_EFF), _jnp.float32
+            ) * 0.02,
+        }
+        primary = _server(rc(), uid="bench_asr")
+        standby = _server(rc(), standby=True, uid="bench_asr")
+        await primary.start()
+        await standby.start()
+        rng = np.random.default_rng(31)
+        prompt = rng.integers(0, VOCAB_EFF, size=(1, 8))
+        K = 12 if smoke else 24
+
+        def mk_model():
+            m = DistributedModelForCausalLM(
+                spec, client_params,
+                RemoteSequenceManager(
+                    rc(), "bench_asr", span_layers, update_period=0.5
+                ),
+            )
+            # a generous retry budget: the dark window between primary
+            # death and standby promotion is a couple seconds here, and
+            # each retry attempt sleeps on its backoff schedule
+            m.config.max_retries = 12
+            return m
+
+        try:
+            ref = await mk_model().generate(
+                prompt, max_new_tokens=K, server_decode=False
+            )
+
+            # the kill lands after EXACTLY K//2 tokens — deterministic
+            # relative to generation progress, so the dark window always
+            # falls mid-flight (a wall-clock killer can miss a fast run
+            # entirely and trivially pass)
+            K1 = K // 2
+            m = mk_model()
+            sess = m.inference_session(
+                max_length=prompt.shape[1] + K + 2, batch_size=1
+            )
+            hard_failures = 0
+            got = None
+            stall_ms = 0.0
+            try:
+                async with sess:
+                    ids1 = await m.generate(
+                        prompt, max_new_tokens=K1, session=sess,
+                        server_decode=False,
+                    )
+                    await primary.stop()
+                    t0 = time.time()
+                    ids2 = await m.generate(
+                        ids1[:, -1:], max_new_tokens=K - K1, session=sess,
+                        server_decode=False,
+                    )
+                    stall_ms = (time.time() - t0) * 1000.0
+                got = np.concatenate(
+                    [np.asarray(ids1), np.asarray(ids2)[:, 1:]], axis=1
+                )
+            except Exception as e:  # noqa: BLE001
+                hard_failures = 1
+                log(f"autoscale recovery generation FAILED: {e!r}")
+            identical = got is not None and np.array_equal(
+                got, np.asarray(ref)
+            )
+            return {
+                "stall_ms": stall_ms,
+                "token_identical": identical,
+                "hard_failures": hard_failures,
+                "promotions": standby.promotions,
+            }
+        finally:
+            for stopper in (standby.stop, reg.stop):
+                try:
+                    await asyncio.wait_for(stopper(), timeout=30.0)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    elastic = asyncio.run(tbt_mode(True))
+    static = asyncio.run(tbt_mode(False))
+    recovery = asyncio.run(recovery_leg())
+    RESULTS["autoscale"] = {
+        "elastic": elastic,
+        "static": static,
+        "recovery": recovery,
+        "heavy_prefill_tokens": HEAVY,
+        "tbt_p95_speedup": (
+            static["tbt_p95_ms"] / max(elastic["tbt_p95_ms"], 1e-9)
+        ),
+    }
+    ok = (
+        recovery["token_identical"]
+        and recovery["hard_failures"] == 0
+        and recovery["promotions"] >= 1
+        and elastic["promotions"] >= 1
+    )
+    phase("autoscale", "ok" if ok else "failed: see autoscale ledger")
+    log(
+        f"autoscale ({N_LIGHT} light decoders vs {N_HEAVY}x{HEAVY}-token "
+        f"flood): elastic TBT p50 {elastic['tbt_p50_ms']:.1f} / p95 "
+        f"{elastic['tbt_p95_ms']:.1f} ms "
+        f"({elastic['promotions']} promotions, promoted_at_end="
+        f"{elastic['promoted_at_end']}) vs static p50 "
+        f"{static['tbt_p50_ms']:.1f} / p95 {static['tbt_p95_ms']:.1f} ms "
+        f"— {RESULTS['autoscale']['tbt_p95_speedup']:.2f}x; recovery "
+        f"stall {recovery['stall_ms']:.0f} ms, token_identical="
+        f"{recovery['token_identical']}, hard_failures="
+        f"{recovery['hard_failures']}"
     )
 
 

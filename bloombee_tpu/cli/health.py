@@ -39,12 +39,21 @@ def main(argv=None):
         from bloombee_tpu.swarm.spans import compute_spans
         from bloombee_tpu.wire.rpc import connect
 
+        from bloombee_tpu.swarm.data import ServerState
+
         reg = make_registry(args.registry)
         infos = await reg.get_module_infos(
             args.model_uid, range(args.num_blocks)
         )
-        spans = compute_spans(infos)
-        covered = {b for s in spans.values() for b in range(s.start, s.end)}
+        # JOINING included so warm standbys are operator-visible; coverage
+        # counts only servers routing can actually use (ONLINE/DRAINING)
+        spans = compute_spans(infos, min_state=ServerState.JOINING)
+        covered = {
+            b
+            for s in spans.values()
+            if s.server_info.state >= ServerState.ONLINE
+            for b in range(s.start, s.end)
+        }
         missing = [b for b in range(args.num_blocks) if b not in covered]
 
         print(f"model {args.model_uid}: {len(spans)} server(s)")
@@ -54,6 +63,10 @@ def main(argv=None):
                 f"  {sid}  blocks [{span.start}:{span.end})  "
                 f"{info.host}:{info.port}  throughput={info.throughput:.2f}"
             )
+            if info.state == ServerState.JOINING:
+                line += "  STANDBY"
+            if getattr(info, "promoted_standby", False):
+                line += "  PROMOTED"
             if info.cache_tokens_left is not None:
                 line += f"  cache_tokens_left={info.cache_tokens_left}"
             if getattr(info, "kv_repl", False):
@@ -147,6 +160,27 @@ def main(argv=None):
                         width = probe.get("mean_tree_batch_width")
                         if width:
                             line += f"  mean_tree_batch_width={width:.2f}"
+                    # elastic self-healing counters: standby promotions /
+                    # drain-backs and measured-load rebalance outcomes —
+                    # the control loop's every decision, probeable without
+                    # log access
+                    elastic = {
+                        k: probe[k]
+                        for k in (
+                            "promotions",
+                            "demotions",
+                            "promotions_yielded",
+                            "demotions_aborted",
+                            "rebalances_moved",
+                            "rebalances_failed",
+                            "rebalance_skipped_hysteresis",
+                        )
+                        if probe.get(k)
+                    }
+                    if elastic:
+                        line += "  " + " ".join(
+                            f"{k}={v}" for k, v in sorted(elastic.items())
+                        )
                     # session lease counters: are leases reaping abandoned
                     # sessions, are clients resuming instead of replaying,
                     # and is keepalive traffic flowing on idle conns

@@ -159,6 +159,35 @@ def main(argv=None):
                              "peer death) is detected instead of hanging "
                              "(0 disables; default follows "
                              "BBTPU_KEEPALIVE_S)")
+    parser.add_argument("--standby", action="store_true",
+                        help="start as a WARM STANDBY for the span: load "
+                             "weights and accept kv_put replication but "
+                             "announce JOINING (no routed traffic), then "
+                             "self-promote to a serving replica on "
+                             "sustained span overload or server loss and "
+                             "drain back when the span cools (watermarks "
+                             "via --promote-high-ms/--promote-low-ms; "
+                             "requires --blocks or --num-blocks matching "
+                             "the primary's span)")
+    parser.add_argument("--promote-high-ms", type=float, default=None,
+                        help="standby promotion high watermark: promote "
+                             "when the span's best serving server sustains "
+                             "this much predicted queue delay in ms "
+                             "(default follows BBTPU_PROMOTE_HIGH_MS)")
+    parser.add_argument("--promote-low-ms", type=float, default=None,
+                        help="demotion low watermark: a promoted standby "
+                             "drains back once other coverage sustains "
+                             "below this (default follows "
+                             "BBTPU_PROMOTE_LOW_MS)")
+    parser.add_argument("--promote-sustain-s", type=float, default=None,
+                        help="how long the hot/cool condition must hold "
+                             "before promoting/demoting (default follows "
+                             "BBTPU_PROMOTE_SUSTAIN_S)")
+    parser.add_argument("--promote-jitter-s", type=float, default=None,
+                        help="promotion-storm guard: random pre-promotion "
+                             "delay bound + re-check so N standbys don't "
+                             "all promote at once (default follows "
+                             "BBTPU_PROMOTE_JITTER_S)")
     parser.add_argument("--load-advert-s", type=float, default=None,
                         help="republish the live load snapshot at this "
                              "cadence (seconds) when faster than "
@@ -245,6 +274,11 @@ def main(argv=None):
             load_advert_s=args.load_advert_s,
             session_lease_s=args.session_lease_s,
             keepalive_s=args.keepalive_s,
+            standby=args.standby,
+            promote_high_ms=args.promote_high_ms,
+            promote_low_ms=args.promote_low_ms,
+            promote_sustain_s=args.promote_sustain_s,
+            promote_jitter_s=args.promote_jitter_s,
         )
         await server.start()
         if args.warmup_batches:

@@ -13,11 +13,23 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import logging
-import math
 import random
 import time
 
 from bloombee_tpu.swarm.data import RemoteSpanInfo, ServerState
+
+# load-advert interpretation lives in swarm/load.py now (servers use it
+# too: measured-load rebalancing, standby promotion); re-exported here
+# because this was its historical home and tests/callers import it from
+# the client package.
+from bloombee_tpu.swarm.load import (  # noqa: F401  (re-exports)
+    LOAD_DELAY_CAP_S,
+    LOAD_SHED_PENALTY_S,
+    LOAD_STALE_S,
+    _QUEUE_DEPTH_COST_S,
+    _finite_pos,
+    predicted_queue_delay_s,
+)
 from bloombee_tpu.swarm.ping import DEFAULT_RTT_S, PingAggregator
 from bloombee_tpu.swarm.spans import compute_spans
 
@@ -25,73 +37,6 @@ logger = logging.getLogger(__name__)
 
 DEFAULT_HOP_COST_S = DEFAULT_RTT_S  # until a peer has been measured
 CACHE_MISSING_PENALTY_S = 10.0  # reference: +10s if cache won't fit
-
-# load-aware routing: how the live ServerInfo.load advert is turned into a
-# predicted-queue-delay edge-cost term. The term is defensive by
-# construction — adverts are untrusted wire input.
-LOAD_STALE_S = 30.0  # advert age at which the load term decays to zero
-LOAD_DELAY_CAP_S = 10.0  # hard cap on the load term: a garbage/hostile
-# advert can inflate only its OWN server's cost, and only this far
-LOAD_SHED_PENALTY_S = 1.0  # an actively-shedding server would refuse new
-# work anyway; make it about as unattractive as a missing-cache server
-_QUEUE_DEPTH_COST_S = 0.05  # per queued task, a rough serialized-step cost
-
-
-def _finite_pos(x) -> float:
-    """Clamp an untrusted advert number to a finite value >= 0 (NaN, inf,
-    negatives, non-numbers all collapse to 0 = 'no load evidence')."""
-    try:
-        v = float(x)
-    except (TypeError, ValueError):
-        return 0.0
-    if not math.isfinite(v) or v < 0.0:
-        return 0.0
-    return v
-
-
-def predicted_queue_delay_s(server_info, now: float | None = None) -> float:
-    """Predicted extra queueing delay (seconds) at this server, derived
-    from its live load advert. Properties the router depends on (enforced
-    here, property-tested in tests/test_overload_routing.py):
-
-    - always finite, >= 0, <= LOAD_DELAY_CAP_S: added to a positive edge
-      cost, Dijkstra stays valid no matter what the advert claims;
-    - monotone non-decreasing in reported load (delay/p95/queue depth), so
-      a server cannot make itself MORE attractive by advertising load —
-      the no-advert baseline (0) is the floor, meaning a malicious advert
-      can only repel traffic from its own server, never capture it;
-    - staleness-discounted: the term decays linearly to zero by
-      LOAD_STALE_S of advert age (load["ts"], writer wall clock, falling
-      back to the registry record's writer-stamped stored_at), so a dead
-      server's last hot advert doesn't repel traffic forever and a stale
-      cool advert doesn't attract a stampede.
-    """
-    load = getattr(server_info, "load", None)
-    if not isinstance(load, dict):
-        return 0.0
-    if now is None:
-        now = time.time()
-    ts = load.get("ts")
-    if not isinstance(ts, (int, float)) or not math.isfinite(float(ts)):
-        ts = getattr(server_info, "advert_stored_at", None)
-    if isinstance(ts, (int, float)) and math.isfinite(float(ts)):
-        age = min(max(now - float(ts), 0.0), LOAD_STALE_S)
-    else:
-        age = 0.0  # unstamped advert: treat as fresh (only repels traffic
-        # from the advertiser itself, so assuming fresh is the safe side)
-    weight = 1.0 - age / LOAD_STALE_S
-    if weight <= 0.0:
-        return 0.0
-    delay = _finite_pos(load.get("delay_ms")) / 1000.0
-    wait = load.get("decode_wait_ms") or load.get("wait_ms")
-    if isinstance(wait, dict):
-        delay = max(delay, _finite_pos(wait.get("p95")) / 1000.0)
-    delay += _QUEUE_DEPTH_COST_S * min(
-        _finite_pos(load.get("queue_depth")), 100.0
-    )
-    if load.get("shedding"):
-        delay += LOAD_SHED_PENALTY_S
-    return weight * min(delay, LOAD_DELAY_CAP_S)
 
 
 class MissingBlocksError(RuntimeError):
@@ -155,6 +100,9 @@ class RemoteSequenceManager:
         self.blocked_servers = set(blocked_servers or ())
         self.active_adapter = active_adapter
         self.spans: dict[str, RemoteSpanInfo] = {}
+        # dedicated warm standbys (JOINING adverts): invisible to routing,
+        # visible to pick_standby as replication/failover targets
+        self.standby_spans: dict[str, RemoteSpanInfo] = {}
         self._bans: dict[str, _BanState] = {}
         # overload penalty class: same half-open state machine as fault
         # bans, but a separate map with shorter base/cap so "busy" never
@@ -175,6 +123,17 @@ class RemoteSequenceManager:
             self.model_uid, range(self.num_blocks)
         )
         self.spans = compute_spans(infos)
+        # JOINING servers are warm standbys (elastic self-healing): kept
+        # OUT of self.spans so no route ever lands on one, but tracked so
+        # pick_standby can ship them replicated KV — when one promotes,
+        # its next advert is ONLINE and it enters self.spans normally
+        self.standby_spans = {
+            pid: s
+            for pid, s in compute_spans(
+                infos, min_state=ServerState.JOINING
+            ).items()
+            if s.server_info.state == ServerState.JOINING
+        }
         self._last_update = now
         self._prune_bans()
         banned_now = {
@@ -364,8 +323,24 @@ class RemoteSequenceManager:
         None when the swarm has no eligible alternative (the caller
         degrades to plain full-replay recovery)."""
         info = span.server_info
+        now = time.monotonic()
+        pool = list(self._active_spans(overload_excludes=False))
+        # dedicated warm standbys (JOINING adverts) qualify too — they are
+        # what the elastic control loop promotes on failover, so they are
+        # exactly where this session's pages should be waiting
+        pool += [
+            s for s in self.standby_spans.values()
+            if not self._state_excludes(
+                self._bans, s.peer_id, now, self.probe_timeout, "banned"
+            )
+            and s.peer_id not in self.blocked_servers
+            and (
+                self.allowed_servers is None
+                or s.peer_id in self.allowed_servers
+            )
+        ]
         cands = [
-            s for s in self._active_spans(overload_excludes=False)
+            s for s in pool
             if s.peer_id != span.peer_id
             and s.peer_id not in (exclude or ())
             and s.server_info.kv_repl

@@ -19,7 +19,10 @@ import uuid
 
 import numpy as np
 
-from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+from bloombee_tpu.client.sequence_manager import (
+    MissingBlocksError,
+    RemoteSequenceManager,
+)
 from bloombee_tpu.swarm.data import RemoteSpanInfo
 from bloombee_tpu.utils import env
 from bloombee_tpu.wire.rpc import (
@@ -559,7 +562,10 @@ class InferenceSession:
                     await self._recover()
                     accept = None
                     accept_per_span = None
-                except (RpcError, OSError, asyncio.TimeoutError) as e2:
+                except (
+                    RpcError, OSError, asyncio.TimeoutError,
+                    MissingBlocksError,
+                ) as e2:
                     logger.warning("recovery after shed failed: %s", e2)
             except (RpcError, OSError, asyncio.TimeoutError) as e:
                 attempt += 1
@@ -594,7 +600,10 @@ class InferenceSession:
                     # speculative window, so a carried accept is stale
                     accept = None
                     accept_per_span = None
-                except (RpcError, OSError, asyncio.TimeoutError) as e2:
+                except (
+                    RpcError, OSError, asyncio.TimeoutError,
+                    MissingBlocksError,
+                ) as e2:
                     logger.warning("recovery attempt failed: %s", e2)
                     await asyncio.sleep(min(0.2 * attempt, 2.0))
 
@@ -972,7 +981,10 @@ class InferenceSession:
                     await self._recover()
                     self._needs_rebuild = False
                     self._check_decode_n_route()
-                except (RpcError, OSError, asyncio.TimeoutError) as e2:
+                except (
+                    RpcError, OSError, asyncio.TimeoutError,
+                    MissingBlocksError,
+                ) as e2:
                     logger.warning("recovery after shed failed: %s", e2)
                 continue
             except (RpcError, OSError, asyncio.TimeoutError) as e:
@@ -1004,7 +1016,10 @@ class InferenceSession:
                     # pending rebuild is satisfied
                     self._needs_rebuild = False
                     self._check_decode_n_route()
-                except (RpcError, OSError, asyncio.TimeoutError) as e2:
+                except (
+                    RpcError, OSError, asyncio.TimeoutError,
+                    MissingBlocksError,
+                ) as e2:
                     logger.warning("recovery attempt failed: %s", e2)
                     await asyncio.sleep(min(0.2 * attempt, 2.0))
                 continue
@@ -1289,7 +1304,13 @@ class InferenceSession:
             try:
                 await self._recover_once()
                 return
-            except (RpcError, OSError, asyncio.TimeoutError) as e:
+            except (
+                RpcError, OSError, asyncio.TimeoutError, MissingBlocksError,
+            ) as e:
+                # MissingBlocksError is retriable here: a span can go dark
+                # for a beat while the swarm self-heals (standby promoting
+                # after the primary died) — give the heal the same bounded
+                # retry budget a flaky peer gets
                 last_exc = e
                 await self.close()
                 logger.warning(

@@ -10,19 +10,59 @@ servers don't thrash).
 
 from __future__ import annotations
 
+import time
+from collections import deque
+
 import numpy as np
 
 from bloombee_tpu.swarm.data import ModuleInfo, RemoteSpanInfo
+from bloombee_tpu.swarm.load import predicted_queue_delay_s
+from bloombee_tpu.utils import env
 
 BALANCE_QUALITY = 0.75
 
+env.declare(
+    "BBTPU_MEASURED_REBALANCE", bool, True,
+    "weight the rebalance objective by live load adverts: a server's "
+    "contribution to per-block throughput is discounted by its predicted "
+    "queue delay (staleness-discounted, hostile-advert-bounded — same "
+    "term the client router uses), so chronically hot spans attract "
+    "movers and idle spans shed them. Servers without a load advert keep "
+    "their static throughput, so a swarm with no adverts reduces to the "
+    "static Petals objective (cold-start fallback). Off = static "
+    "objective always",
+)
 
-def block_throughputs(module_infos: list[ModuleInfo]) -> np.ndarray:
-    """Aggregate announced throughput per block."""
+
+def _effective_throughput(server, now: float | None) -> float:
+    """A server's load-discounted contribution to block throughput: the
+    static announced rate divided by (1 + predicted queue delay). The
+    delay term is the shared swarm/load.py reading of the advert —
+    bounded by LOAD_DELAY_CAP_S, so a hostile advert can shrink only its
+    OWN server's weight and only ~11x; absent/stale adverts contribute 0
+    delay, leaving the static throughput untouched."""
+    t = server.throughput or 0.0
+    return t / (1.0 + predicted_queue_delay_s(server, now))
+
+
+def block_throughputs(
+    module_infos: list[ModuleInfo],
+    measured: bool = False,
+    now: float | None = None,
+) -> np.ndarray:
+    """Aggregate announced throughput per block. With measured=True each
+    server's contribution is discounted by its live load advert (see
+    _effective_throughput); with no adverts in the swarm the result is
+    identical to the static aggregate."""
+    if measured and now is None:
+        now = time.time()
     out = np.zeros(len(module_infos))
     for i, info in enumerate(module_infos):
         for server in info.servers.values():
-            out[i] += server.throughput or 0.0
+            if measured:
+                out[i] += _effective_throughput(server, now)
+            else:
+                out[i] += server.throughput or 0.0
     return out
 
 
@@ -42,38 +82,117 @@ def choose_best_blocks(
     return best_start, best_start + num_blocks
 
 
+def _best_landing(
+    without: np.ndarray, n: int, t: float
+) -> tuple[float | None, int | None]:
+    """Best window of length `n` to add throughput `t` onto `without`:
+    returns (resulting bottleneck min, window start), maximizing the min.
+    O(blocks) — equivalent to copying the array per candidate start and
+    taking its min (the naive O(blocks^2) form this replaced; equivalence
+    is property-tested in tests/test_rebalance.py), because the candidate
+    min decomposes into min(prefix-min before the window, window-min + t,
+    suffix-min after), with window minima from one monotonic-deque sweep.
+    Ties keep the earliest start, matching the naive scan order."""
+    b = len(without)
+    if n <= 0 or n > b:
+        return None, None
+    inf = float("inf")
+    prefix = np.empty(b + 1)  # prefix[i] = min(without[:i])
+    prefix[0] = inf
+    np.minimum.accumulate(without, out=prefix[1:])
+    suffix = np.empty(b + 1)  # suffix[i] = min(without[i:])
+    suffix[b] = inf
+    suffix[:b] = np.minimum.accumulate(without[::-1])[::-1]
+    best, best_start = None, None
+    dq: deque[int] = deque()  # indices of increasing window candidates
+    for i in range(b):
+        while dq and without[dq[-1]] >= without[i]:
+            dq.pop()
+        dq.append(i)
+        start = i - n + 1
+        if dq[0] < start:
+            dq.popleft()
+        if start >= 0:
+            m = min(
+                float(prefix[start]),
+                float(without[dq[0]]) + t,
+                float(suffix[start + n]),
+            )
+            if best is None or m > best:
+                best, best_start = m, start
+    return best, best_start
+
+
+def _rebalance_decision(
+    peer_id: str,
+    module_infos: list[ModuleInfo],
+    spans: dict[str, RemoteSpanInfo],
+    measured: bool | None = None,
+    now: float | None = None,
+) -> tuple[tuple[int, int] | None, bool]:
+    """(target, skipped_by_hysteresis): the move decision plus whether a
+    strictly-better landing existed but fell inside the BALANCE_QUALITY
+    margin (surfaced as the rebalance_skipped_hysteresis counter)."""
+    my_span = spans.get(peer_id)
+    if my_span is None:
+        return None, False
+    if measured is None:
+        measured = bool(env.get("BBTPU_MEASURED_REBALANCE"))
+    if now is None:
+        now = time.time()
+    tput = block_throughputs(module_infos, measured=measured, now=now)
+    current_min = float(tput.min())
+
+    # simulate leaving: subtract the same contribution block_throughputs
+    # added for me (load-discounted in measured mode)
+    mine = (
+        _effective_throughput(my_span.server_info, now)
+        if measured
+        else (my_span.server_info.throughput or 0.0)
+    )
+    without = tput.copy()
+    without[my_span.start : my_span.end] -= mine
+    # best place to re-land. The mover lands with its STATIC throughput
+    # even in measured mode: moving drains its queue, so its current
+    # congestion should not follow it to the new span (that asymmetry is
+    # what makes hot spans attract movers and lets a hot mover escape).
+    n = my_span.length
+    best, best_start = _best_landing(
+        without, n, my_span.server_info.throughput or 0.0
+    )
+    if best is None or (best_start, best_start + n) == (
+        my_span.start, my_span.end
+    ):
+        # in measured mode "re-land where I am, minus my queue" can look
+        # like an improvement; staying put is never a move
+        return None, False
+    if best * BALANCE_QUALITY > current_min:
+        return (best_start, best_start + n), False
+    # a strictly better landing exists but not by enough to beat the
+    # thrash-guard margin
+    return None, best > current_min
+
+
 def rebalance_target(
     peer_id: str,
     module_infos: list[ModuleInfo],
     spans: dict[str, RemoteSpanInfo],
+    measured: bool | None = None,
+    now: float | None = None,
 ) -> tuple[int, int] | None:
     """The (start, end) this server should move its span to, or None when
     staying put is within the hysteresis margin. Simulates leaving and
     re-landing at every window, keeping the one that maximizes the swarm's
     bottleneck (minimum per-block) throughput; a move only wins if it
     beats the current bottleneck by more than BALANCE_QUALITY (reference
-    should_choose_other_blocks, block_selection.py:40-95)."""
-    my_span = spans.get(peer_id)
-    if my_span is None:
-        return None
-    tput = block_throughputs(module_infos)
-    current_min = float(tput.min())
-
-    # simulate leaving
-    without = tput.copy()
-    without[my_span.start : my_span.end] -= my_span.server_info.throughput or 0.0
-    # best place to re-land
-    n = my_span.length
-    best, best_start = None, None
-    for start in range(len(tput) - n + 1):
-        cand = without.copy()
-        cand[start : start + n] += my_span.server_info.throughput or 0.0
-        m = float(cand.min())
-        if best is None or m > best:
-            best, best_start = m, start
-    if best is not None and best * BALANCE_QUALITY > current_min:
-        return (best_start, best_start + n)
-    return None
+    should_choose_other_blocks, block_selection.py:40-95). With
+    measured=True (default via BBTPU_MEASURED_REBALANCE) per-server
+    throughput is discounted by live load adverts; a swarm with no
+    adverts degrades to the static objective."""
+    target, _ = _rebalance_decision(
+        peer_id, module_infos, spans, measured=measured, now=now
+    )
+    return target
 
 
 def should_choose_other_blocks(
@@ -135,11 +254,22 @@ def choose_num_blocks(
     return max(1, min(n, spec.num_hidden_layers))
 
 
+def _bump(server, counter: str) -> None:
+    """Increment an optional counter attribute (fake/minimal servers in
+    tests don't carry the counter surface; skip them silently)."""
+    try:
+        setattr(server, counter, getattr(server, counter, 0) + 1)
+    except (AttributeError, TypeError):
+        pass
+
+
 async def rebalance_if_needed(server) -> bool:
     """Periodic check driven by the server's supervisor loop: fetch swarm
     state, decide, and MOVE (drain, reload the new span, re-announce) via
     server.rebalance_to. Returns True when a move happened (reference
-    server.py:479-542 _should_choose_other_blocks + restart loop)."""
+    server.py:479-542 _should_choose_other_blocks + restart loop). Every
+    decision feeds a counter (rebalances_moved / rebalances_failed /
+    rebalance_skipped_hysteresis) surfaced via rpc_info + health --probe."""
     from bloombee_tpu.swarm.spans import compute_spans
 
     infos = await server.registry.get_module_infos(
@@ -147,10 +277,19 @@ async def rebalance_if_needed(server) -> bool:
     )
     # a DRAINING server is leaving: its span is not real coverage, so the
     # balance decision must see the post-departure swarm
-    target = rebalance_target(
+    target, skipped = _rebalance_decision(
         server.server_id, infos, compute_spans(infos, include_draining=False)
     )
+    if skipped:
+        _bump(server, "rebalance_skipped_hysteresis")
     if target is None or target == (server.start_block, server.end_block):
         return False
-    await server.rebalance_to(*target)
+    try:
+        await server.rebalance_to(*target)
+    except Exception:
+        # rebalance_to's own failure path re-announces the old span; the
+        # supervisor tick logs and retries next period
+        _bump(server, "rebalances_failed")
+        raise
+    _bump(server, "rebalances_moved")
     return True

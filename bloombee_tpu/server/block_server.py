@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import random
 import uuid
 from collections import OrderedDict
 
@@ -125,6 +126,33 @@ env.declare(
     "(and vice versa) into ONE ragged span dispatch, so a mid-stream "
     "prefill no longer costs decodes a whole dispatch each. Off = the "
     "decode-only batcher and per-chunk prefill tasks, byte-for-byte",
+)
+env.declare(
+    "BBTPU_PROMOTE_HIGH_MS", float, 1500.0,
+    "standby promotion high watermark: a standby promotes itself to a "
+    "serving replica when its span's best serving server has sustained "
+    "this much predicted queue delay (ms) — or immediately when the "
+    "span has NO live serving server (advert silence past the lease)",
+)
+env.declare(
+    "BBTPU_PROMOTE_LOW_MS", float, 200.0,
+    "standby demotion low watermark: a promoted standby drains back to "
+    "standby once the span's OTHER serving servers have sustained "
+    "predicted queue delay below this (ms) and cover every block — "
+    "the high/low gap is the promotion hysteresis band",
+)
+env.declare(
+    "BBTPU_PROMOTE_SUSTAIN_S", float, 10.0,
+    "how long the hot (cool) condition must hold before a standby "
+    "promotes (a promoted replica demotes); one flappy advert window "
+    "must not churn replicas",
+)
+env.declare(
+    "BBTPU_PROMOTE_JITTER_S", float, 2.0,
+    "promotion-storm guard: a standby sleeps uniform(0, this) seconds "
+    "and RE-CHECKS the trigger before declaring itself serving, so N "
+    "standbys watching one hot span don't all promote at once (a "
+    "peer's promotion clears the trigger for the rest)",
 )
 env.declare(
     "BBTPU_SPEC_BATCH", bool, False,
@@ -407,6 +435,21 @@ class BlockServer:
         # speculating session; falls back to solo tree steps on configs
         # the ragged tree step doesn't cover. None -> BBTPU_SPEC_BATCH
         # env; off = solo tree dispatches, byte-for-byte
+        standby: bool = False,  # start as a WARM STANDBY for this span:
+        # announce JOINING (holds weights + accepts kv_put replication but
+        # takes no routed traffic), watch the span's serving replicas, and
+        # self-promote to ONLINE on sustained overload or server loss —
+        # then drain back to standby when the span cools (the elastic
+        # self-healing control loop)
+        promote_high_ms: float | None = None,  # promotion high watermark
+        # in ms of the span's best serving server's predicted queue delay
+        # (None -> BBTPU_PROMOTE_HIGH_MS env)
+        promote_low_ms: float | None = None,  # demotion low watermark
+        # (None -> BBTPU_PROMOTE_LOW_MS env)
+        promote_sustain_s: float | None = None,  # hot/cool dwell before
+        # acting (None -> BBTPU_PROMOTE_SUSTAIN_S env)
+        promote_jitter_s: float | None = None,  # storm-guard jitter bound
+        # (None -> BBTPU_PROMOTE_JITTER_S env)
     ):
         self.model_dir = model_dir
         if weight_quant is None:
@@ -640,6 +683,41 @@ class BlockServer:
         # graceful shutdown: announces DRAINING (routing stops sending NEW
         # sessions), keeps serving in-flight sessions up to drain_timeout
         self._draining = False
+        # elastic self-healing: standby/promotion control-loop state. A
+        # standby announces JOINING (invisible to routing, visible to
+        # kv_put replication) and refuses session opens; _promotion_loop
+        # flips _standby/_promoted on sustained span overload or loss.
+        self._standby = bool(standby)
+        self._promoted = False
+        self.promote_high_ms = (
+            float(env.get("BBTPU_PROMOTE_HIGH_MS"))
+            if promote_high_ms is None else float(promote_high_ms)
+        )
+        self.promote_low_ms = (
+            float(env.get("BBTPU_PROMOTE_LOW_MS"))
+            if promote_low_ms is None else float(promote_low_ms)
+        )
+        self.promote_sustain_s = (
+            float(env.get("BBTPU_PROMOTE_SUSTAIN_S"))
+            if promote_sustain_s is None else float(promote_sustain_s)
+        )
+        self.promote_jitter_s = (
+            float(env.get("BBTPU_PROMOTE_JITTER_S"))
+            if promote_jitter_s is None else float(promote_jitter_s)
+        )
+        self._promotion_task: asyncio.Task | None = None
+        # seeded per server: the storm-guard jitter must differ across
+        # standbys even when they start in the same millisecond
+        self._promote_rng = random.Random(self.server_id)
+        # control-loop decision counters (rpc_info + health --probe):
+        # every promote/demote/rebalance outcome is operator-visible
+        self.promotions = 0
+        self.demotions = 0
+        self.promotions_yielded = 0
+        self.demotions_aborted = 0
+        self.rebalances_moved = 0
+        self.rebalances_failed = 0
+        self.rebalance_skipped_hysteresis = 0
         # work dropped because the client's deadline budget (meta
         # "deadline_s") expired before/while we would compute it; surfaced
         # via rpc_info for operators and the chaos tests
@@ -751,8 +829,12 @@ class BlockServer:
         if self.session_lease_s > 0:
             self._reaper_task = asyncio.create_task(self._lease_reaper_loop())
         if self.registry is not None:
-            await self._announce(ServerState.ONLINE)
+            await self._announce(self._advert_state())
             self._announce_task = asyncio.create_task(self._announce_loop())
+            if self._standby:
+                self._promotion_task = asyncio.create_task(
+                    self._promotion_loop()
+                )
             # the announce loop IS the liveness signal: if it dies, the
             # registry record expires and the swarm silently loses this
             # server — supervise and restart it (reference restarts whole
@@ -849,7 +931,8 @@ class BlockServer:
 
     async def stop(self) -> None:
         for task in (self._supervisor_task, self._warmup_task,
-                     self._throughput_task, self._reaper_task):
+                     self._throughput_task, self._reaper_task,
+                     self._promotion_task):
             if task is not None:
                 task.cancel()
         if self._announce_task is not None:
@@ -966,48 +1049,300 @@ class BlockServer:
         tick = max(1.0, min(self.announce_period, 15.0))
         while True:
             await asyncio.sleep(tick)
-            if self._announce_task is not None and self._announce_task.done():
-                exc = (
-                    None if self._announce_task.cancelled()
-                    else self._announce_task.exception()
-                )
-                logger.error(
-                    "announce loop died (%s); restarting — without it this "
-                    "server would silently expire from the registry", exc,
-                )
-                self._announce_task = asyncio.create_task(
-                    self._announce_loop()
-                )
-            for name in ("_warmup_task", "_throughput_task"):
-                task = getattr(self, name)
-                if task is not None and task.done():
-                    setattr(self, name, None)  # report once
-                    if not task.cancelled() and task.exception() is not None:
-                        logger.error(
-                            "%s failed: %s", name.strip("_"),
-                            task.exception(),
-                        )
-            if (
-                self.rebalance_period > 0
-                and not self._rebalancing
-                and self.rebalance_unsupported() is None
-                and _time.monotonic() - last_rebalance
-                >= self.rebalance_period
-            ):
-                last_rebalance = _time.monotonic()
-                from bloombee_tpu.server.block_selection import (
-                    rebalance_if_needed,
-                )
+            try:
+                self._supervisor_tick()
+                if (
+                    self.rebalance_period > 0
+                    and not self._rebalancing
+                    and not self._standby
+                    and self.rebalance_unsupported() is None
+                    and _time.monotonic() - last_rebalance
+                    >= self.rebalance_period
+                ):
+                    last_rebalance = _time.monotonic()
+                    from bloombee_tpu.server.block_selection import (
+                        rebalance_if_needed,
+                    )
 
-                try:
                     moved = await rebalance_if_needed(self)
                     if moved:
                         logger.info(
                             "rebalanced to [%d:%d)",
                             self.start_block, self.end_block,
                         )
-                except Exception as e:
-                    logger.warning("rebalance check failed: %s", e)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # a transient registry flap (fetch/announce/declare error)
+                # must never kill the supervisor — it is the task that
+                # restarts everything else. Log and retry next tick.
+                logger.warning("supervisor tick failed: %s", e)
+
+    def _supervisor_tick(self) -> None:
+        """One supervision pass: restart dead background loops, surface
+        one-shot task failures."""
+        if self._announce_task is not None and self._announce_task.done():
+            exc = (
+                None if self._announce_task.cancelled()
+                else self._announce_task.exception()
+            )
+            logger.error(
+                "announce loop died (%s); restarting — without it this "
+                "server would silently expire from the registry", exc,
+            )
+            self._announce_task = asyncio.create_task(
+                self._announce_loop()
+            )
+        if (
+            self._promotion_task is not None
+            and self._promotion_task.done()
+            and (self._standby or self._promoted)
+        ):
+            exc = (
+                None if self._promotion_task.cancelled()
+                else self._promotion_task.exception()
+            )
+            logger.error(
+                "promotion loop died (%s); restarting — without it a "
+                "standby never promotes and a promoted replica never "
+                "drains back", exc,
+            )
+            self._promotion_task = asyncio.create_task(
+                self._promotion_loop()
+            )
+        for name in ("_warmup_task", "_throughput_task"):
+            task = getattr(self, name)
+            if task is not None and task.done():
+                setattr(self, name, None)  # report once
+                if not task.cancelled() and task.exception() is not None:
+                    logger.error(
+                        "%s failed: %s", name.strip("_"),
+                        task.exception(),
+                    )
+
+    # --------------------------------------------- standby promotion loop
+    async def _promotion_loop(self) -> None:
+        """The standby side of elastic self-healing. While standby: watch
+        the span's serving replicas and promote on sustained overload
+        (best server past promote_high_ms for promote_sustain_s) or span
+        loss (a block with no live ONLINE server — advert silence past the
+        registry lease). While promoted: resolve promotion storms (all but
+        the lexicographically-smallest promoted replica yield) and drain
+        back to standby once the span's OTHER servers stay cool below
+        promote_low_ms for the sustain window — the high/low gap plus the
+        dwell time is the hysteresis that stops replica flapping."""
+        import time as _time
+
+        tick = max(
+            0.1,
+            min(self.announce_period, max(self.promote_sustain_s, 0.2) / 2),
+        )
+        hot_since: float | None = None
+        cool_since: float | None = None
+        while True:
+            await asyncio.sleep(tick)
+            if self._draining:
+                return
+            try:
+                if self._standby:
+                    cool_since = None
+                    reason = await self._span_needs_me()
+                    if reason is None:
+                        hot_since = None
+                        continue
+                    now = _time.monotonic()
+                    if reason == "hot":
+                        # sustained-overload dwell; a dead span promotes
+                        # without one (there is nobody left to flap with)
+                        if hot_since is None:
+                            hot_since = now
+                        if now - hot_since < self.promote_sustain_s:
+                            continue
+                    # storm guard: jittered delay, then RE-CHECK — a peer
+                    # standby that promoted during our sleep clears the
+                    # trigger (span covered again / best server cool)
+                    await asyncio.sleep(
+                        self._promote_rng.uniform(0, self.promote_jitter_s)
+                    )
+                    if await self._span_needs_me() is None:
+                        hot_since = None
+                        continue
+                    await self._promote(reason)
+                    hot_since = None
+                elif self._promoted:
+                    hot_since = None
+                    # post-declare re-check: concurrent promotions that
+                    # slipped past the jitter window resolve here
+                    if await self._resolve_promotion_storm():
+                        cool_since = None
+                        continue
+                    if await self._span_cooled():
+                        now = _time.monotonic()
+                        if cool_since is None:
+                            cool_since = now
+                        if now - cool_since >= self.promote_sustain_s:
+                            await self._demote()
+                            cool_since = None
+                    else:
+                        cool_since = None
+                else:
+                    return  # demoted back to plain standby duty is handled
+                    # by the _standby branch; a primary never runs this loop
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # registry flap: keep watching — a standby that gives up
+                # on a transient error is a standby that never fails over
+                logger.warning("promotion check failed: %s", e)
+
+    async def _span_pressure(self) -> float | None:
+        """Worst-case best-server queue delay (ms) across this span's
+        blocks, counting only OTHER ONLINE servers: for each block, the
+        minimum predicted queue delay over its live serving replicas (a
+        cool replica anywhere absorbs that block's traffic), maximized
+        over blocks (the hottest uncovered-by-cool-capacity block gates
+        the span). None = some block has no other live server at all.
+        Adverts are untrusted: the delay term is the shared bounded /
+        staleness-discounted swarm/load.py reading."""
+        from bloombee_tpu.swarm.load import predicted_queue_delay_s
+
+        infos = await self.registry.get_module_infos(
+            self.model_uid, range(self.start_block, self.end_block)
+        )
+        worst = 0.0
+        for info in infos:
+            servers = [
+                s for sid, s in (info.servers if info else {}).items()
+                if sid != self.server_id and s.state == ServerState.ONLINE
+            ]
+            if not servers:
+                return None
+            best = min(
+                predicted_queue_delay_s(s) * 1000.0 for s in servers
+            )
+            worst = max(worst, best)
+        return worst
+
+    async def _span_needs_me(self) -> str | None:
+        """Why this standby should promote right now: 'dead' (a span block
+        has no live server) / 'hot' (best coverage past the high
+        watermark) / None (span is fine)."""
+        pressure = await self._span_pressure()
+        if pressure is None:
+            return "dead"
+        if pressure >= self.promote_high_ms:
+            return "hot"
+        return None
+
+    async def _span_cooled(self) -> bool:
+        """Demotion trigger: every span block is covered by OTHER live
+        servers AND the worst best-server delay sits below the low
+        watermark — never drain back the span's sole coverage."""
+        pressure = await self._span_pressure()
+        return pressure is not None and pressure <= self.promote_low_ms
+
+    async def _promote(self, reason: str) -> None:
+        """Standby -> serving replica: flip the flags and declare the span
+        ONLINE. The replicated KV shipped to us via kv_put already sits in
+        the prefix pool as cached entries, so recovering sessions resume
+        off it (prefix probe) the moment routing can see us; nothing needs
+        re-installing."""
+        stats = self.manager.prefix_stats()
+        self._standby = False
+        self._promoted = True
+        self.promotions += 1
+        logger.warning(
+            "standby %s PROMOTING to serve %s[%d:%d) (%s; %d replicated "
+            "pages warm)", self.server_id, self.model_uid,
+            self.start_block, self.end_block, reason,
+            stats.get("repl_pages_installed", 0),
+        )
+        # declare immediately — the periodic announce loop may be most of
+        # a period away, and a dead span bleeds sessions every second. A
+        # registry flap here is non-fatal: we stay promoted and the
+        # announce loop's next pass re-declares.
+        try:
+            await self._announce(ServerState.ONLINE)
+        except Exception as e:
+            logger.warning("promotion announce failed (will retry): %s", e)
+
+    async def _resolve_promotion_storm(self) -> bool:
+        """After declaring, check for sibling promoted replicas of this
+        exact span: if any has a lexicographically smaller server_id, WE
+        yield (demote back) so N racing standbys converge on exactly one
+        promoted replica. Returns True when this server yielded."""
+        infos = await self.registry.get_module_infos(
+            self.model_uid, range(self.start_block, self.end_block)
+        )
+        siblings: set[str] = set()
+        for info in infos:
+            for sid, s in (info.servers if info else {}).items():
+                if (
+                    sid != self.server_id
+                    and s.state == ServerState.ONLINE
+                    and s.promoted_standby
+                    and s.start_block == self.start_block
+                    and s.end_block == self.end_block
+                ):
+                    siblings.add(sid)
+        if not siblings or min(siblings) > self.server_id:
+            return False
+        logger.warning(
+            "promotion storm: %s yields %s[%d:%d) to promoted sibling %s",
+            self.server_id, self.model_uid, self.start_block,
+            self.end_block, min(siblings),
+        )
+        await self._demote(yielded=True)
+        return True
+
+    async def _demote(self, yielded: bool = False) -> bool:
+        """Serving replica -> standby, gracefully: refuse NEW sessions at
+        once (standby flag + DRAINING advert), wait out open sessions up
+        to drain_timeout, then declare JOINING. If sessions outlive the
+        window the demotion ABORTS (re-announce ONLINE, retry later) —
+        drain-back must never strand live streams on an unroutable
+        server."""
+        import time as _time
+
+        self._standby = True  # session opens now refuse; open streams live
+        try:
+            await self._announce(ServerState.DRAINING)
+        except Exception as e:
+            logger.warning("demotion announce failed: %s", e)
+        deadline = _time.monotonic() + self.drain_timeout
+        while self._sessions and _time.monotonic() < deadline:
+            await asyncio.sleep(0.1)
+        if self._sessions and not yielded:
+            # a yielded storm-duplicate demotes regardless: its sibling
+            # serves the span, and any session that raced onto us replays
+            # there via the ordinary session_lost path
+            self._standby = False
+            self.demotions_aborted += 1
+            logger.warning(
+                "demotion aborted: %d session(s) outlived the %.0fs "
+                "drain; staying promoted", len(self._sessions),
+                self.drain_timeout,
+            )
+            try:
+                await self._announce(ServerState.ONLINE)
+            except Exception as e:
+                logger.warning("demotion-abort announce failed: %s", e)
+            return False
+        self._promoted = False
+        if yielded:
+            self.promotions_yielded += 1
+        else:
+            self.demotions += 1
+        logger.warning(
+            "replica %s demoted back to standby for %s[%d:%d)",
+            self.server_id, self.model_uid, self.start_block,
+            self.end_block,
+        )
+        try:
+            await self._announce(ServerState.JOINING)
+        except Exception as e:
+            logger.warning("standby announce failed: %s", e)
+        return True
 
     def rebalance_unsupported(self) -> str | None:
         """Why this server cannot move its span at runtime; None if it can."""
@@ -1108,6 +1443,22 @@ class BlockServer:
             self.spec = spec
             if self.registry is not None:
                 await self._announce(ServerState.ONLINE)
+        except Exception:
+            # mid-move crash: whatever span is actually loaded right now
+            # (the OLD one unless the swap already landed — the swap is
+            # atomic from the event loop's view) must get back into the
+            # registry IMMEDIATELY, not an announce period from now: the
+            # revoke above tombstoned it, so until a re-announce the swarm
+            # believes this server serves nothing
+            if self.registry is not None:
+                try:
+                    await self._announce(self._advert_state())
+                except Exception as e:
+                    logger.warning(
+                        "re-announce after failed rebalance ALSO failed "
+                        "(%s); the periodic announce loop will retry", e,
+                    )
+            raise
         finally:
             self._rebalancing = False
 
@@ -1149,13 +1500,25 @@ class BlockServer:
             ),
         }
 
+    def _advert_state(self) -> ServerState:
+        """The state this server should announce right now. JOINING is the
+        standby advert: below ONLINE, so routing/spans filters keep the
+        server invisible to traffic, while clients scanning for
+        replication targets (pick_standby) still see it — no new enum
+        value, so old peers parse standby adverts fine."""
+        if self._draining:
+            return ServerState.DRAINING
+        if self._standby:
+            return ServerState.JOINING
+        return ServerState.ONLINE
+
     def server_info(self) -> ServerInfo:
         return ServerInfo(
             load=self.load_snapshot(),
-            state=(
-                ServerState.DRAINING if self._draining
-                else ServerState.ONLINE
-            ),
+            state=self._advert_state(),
+            # promoted replicas yield in storm resolution and drain back
+            # first when the span cools; the primary never demotes
+            promoted_standby=self._promoted,
             host=self.public_host,
             port=self.port,
             throughput=self.throughput,
@@ -1210,10 +1573,7 @@ class BlockServer:
                 # announce FIRST (liveness must not wait on pings — a slow
                 # successor would expire our registry record); the pings
                 # measured after ride the NEXT announce
-                await self._announce(
-                    ServerState.DRAINING if self._draining
-                    else ServerState.ONLINE
-                )
+                await self._announce(self._advert_state())
                 if env.log_channel_enabled("transport"):
                     from bloombee_tpu.wire.tensor_codec import transport_stats
 
@@ -1277,6 +1637,18 @@ class BlockServer:
             # drain flag (also visible as state=DRAINING in server_info)
             "deadlines_expired": self.deadlines_expired,
             "draining": self._draining,
+            # elastic self-healing observability: standby/promoted role
+            # flags plus the control-loop decision counters (promotion
+            # storms resolve as promotions_yielded; drain-backs blocked by
+            # live sessions as demotions_aborted)
+            "standby": self._standby,
+            "promotions": self.promotions,
+            "demotions": self.demotions,
+            "promotions_yielded": self.promotions_yielded,
+            "demotions_aborted": self.demotions_aborted,
+            "rebalances_moved": self.rebalances_moved,
+            "rebalances_failed": self.rebalances_failed,
+            "rebalance_skipped_hysteresis": self.rebalance_skipped_hysteresis,
             # session lifecycle observability (leases/keepalives/resume):
             # leases reaped, parked sessions re-attached, retried steps
             # answered from the recorded reply, keepalive pings sent on
@@ -1578,6 +1950,14 @@ class BlockServer:
             # client racing a stale swarm view can still arrive — refuse
             # before allocating KV it could never finish using
             raise RuntimeError("server is draining; open a session elsewhere")
+        if self._standby:
+            # a standby (or a replica mid-drain-back) holds weights and
+            # replicated KV but is NOT serving: it announces JOINING, so
+            # only a client racing a stale swarm view lands here
+            raise RuntimeError(
+                "server is a standby for this span; open a session on a "
+                "serving replica"
+            )
         if meta.get("resume") is not None:
             # reconnect-resume: re-attach a parked session instead of
             # allocating anything — this handler only hands its fresh

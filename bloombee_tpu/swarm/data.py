@@ -66,6 +66,13 @@ class ServerInfo:
     # Old peers drop the whole field via from_wire unknown-field
     # filtering; old adverts leave it None (routing then adds no load term).
     load: dict | None = None
+    # True while this server is serving because it PROMOTED itself from a
+    # standby (elastic control loop). Promoted replicas are the ones that
+    # yield in promotion-storm resolution (lowest server_id keeps serving,
+    # the rest demote) and the first to drain back when the span cools —
+    # the span's primary server never demotes. Old peers drop the field on
+    # the wire (from_wire filtering); default False = primary.
+    promoted_standby: bool = False
 
     def to_wire(self) -> dict:
         d = dataclasses.asdict(self)

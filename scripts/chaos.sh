@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Chaos gate: replay the chaos-marked suite under a fixed seed matrix of
 # ambient wire faults (the BBTPU_CHAOS_* env plan). Each entry is
-# "SEED:DELAY_P:ADMIT:PARTITION_P:MIXED:SPEC" — mild delay-only ambient
+# "SEED:DELAY_P:ADMIT:PARTITION_P:MIXED:SPEC:REBALANCE" — mild delay-only ambient
 # chaos, so
 # the per-test seeded FaultPlans stay the dominant fault source while
 # connections opened before a test installs its plan still see injected
@@ -15,7 +15,12 @@
 # decode+prefill path and its solo-replay failure recovery run under the
 # same ambient jitter; SPEC=1 turns on batched tree-speculative
 # verification (BBTPU_SPEC_BATCH) so grouped tree-verify dispatches and
-# their rollback-then-solo-replay recovery run under ambient jitter too.
+# their rollback-then-solo-replay recovery run under ambient jitter too;
+# REBALANCE=1 turns on the elastic self-healing control loop — measured-
+# load rebalancing (BBTPU_MEASURED_REBALANCE) plus fast standby-promotion
+# watermarks (BBTPU_PROMOTE_*) — so promotion/demotion decisions and the
+# rebalance supervisor run against the same flaky-registry + wire jitter
+# the chaos plans inject.
 # Fixed seeds keep every run replayable bit-for-bit (wire/faults.py
 # contract).
 # Exits 0 when pytest is unavailable (mirrors scripts/lint.sh).
@@ -27,13 +32,15 @@ if ! python -c "import pytest" >/dev/null 2>&1; then
     exit 0
 fi
 
-MATRIX=("11:0.05:0:0:0:0" "23:0.1:0:0:0:0" "31:0.05:1:0:0:0"
-        "43:0.02:0:0.02:0:0" "57:0.05:0:0:1:0" "71:0.05:0:0:0:1")
+MATRIX=("11:0.05:0:0:0:0:0" "23:0.1:0:0:0:0:0" "31:0.05:1:0:0:0:0"
+        "43:0.02:0:0.02:0:0:0" "57:0.05:0:0:1:0:0" "71:0.05:0:0:0:1:0"
+        "83:0.05:0:0:0:0:1")
 for entry in "${MATRIX[@]}"; do
-    IFS=: read -r seed delay_p admit partition_p mixed spec <<<"${entry}"
+    IFS=: read -r seed delay_p admit partition_p mixed spec rebalance <<<"${entry}"
     partition_p="${partition_p:-0}"
     mixed="${mixed:-0}"
     spec="${spec:-0}"
+    rebalance="${rebalance:-0}"
     # partitioned conns go silent instead of erroring: a small keepalive
     # turns the blackhole into a prompt local abort so lease park/resume
     # (not a step_timeout expiry) is the recovery path under test
@@ -41,8 +48,17 @@ for entry in "${MATRIX[@]}"; do
     if [ "${partition_p}" != "0" ]; then
         keepalive_s=0.5
     fi
+    # the rebalance entry runs with hair-trigger promotion watermarks so
+    # the standby control loop actually fires inside short chaos tests
+    promote_high_ms=1500
+    promote_sustain_s=10
+    if [ "${rebalance}" != "0" ]; then
+        promote_high_ms=500
+        promote_sustain_s=0.3
+    fi
     echo "chaos: seed=${seed} delay_p=${delay_p} admit=${admit}" \
-         "partition_p=${partition_p} mixed=${mixed} spec=${spec}" >&2
+         "partition_p=${partition_p} mixed=${mixed} spec=${spec}" \
+         "rebalance=${rebalance}" >&2
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     BBTPU_CHAOS=1 \
     BBTPU_CHAOS_SEED="${seed}" \
@@ -54,6 +70,9 @@ for entry in "${MATRIX[@]}"; do
     BBTPU_ADMIT_HIGH_MS=400 \
     BBTPU_MIXED_BATCH="${mixed}" \
     BBTPU_SPEC_BATCH="${spec}" \
+    BBTPU_MEASURED_REBALANCE="${rebalance}" \
+    BBTPU_PROMOTE_HIGH_MS="${promote_high_ms}" \
+    BBTPU_PROMOTE_SUSTAIN_S="${promote_sustain_s}" \
     python -m pytest tests/ -q -m chaos \
         -p no:cacheprovider -p no:xdist -p no:randomly "$@"
 done

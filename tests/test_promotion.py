@@ -1,0 +1,340 @@
+"""Elastic self-healing: standby promotion / demotion control loop.
+
+A warm standby (--standby) announces JOINING — invisible to routing,
+visible to kv_put replication — and watches its span's serving replicas.
+It promotes itself to ONLINE on sustained overload past the high
+watermark or on span loss (advert silence past the registry lease), and
+drains back to standby once other coverage stays cool below the low
+watermark. Promotion storms (N standbys, one hot span) must converge to
+exactly ONE promoted replica via the jitter + re-check-after-declare
+guard.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.server.block_server import BlockServer
+from bloombee_tpu.swarm.data import ServerInfo, ServerState
+from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=3,
+        vocab_size=128,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(7)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = tmp_path_factory.mktemp("tiny_llama_promote")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model, config
+
+
+def _standby_server(model_dir, rc, **kw):
+    kw.setdefault("promote_high_ms", 500.0)
+    kw.setdefault("promote_low_ms", 100.0)
+    kw.setdefault("promote_sustain_s", 0.3)
+    kw.setdefault("promote_jitter_s", 0.4)
+    return BlockServer(
+        model_uid="tiny", start=0, end=3, model_dir=model_dir,
+        registry=rc, compute_dtype=jnp.float32, num_pages=64,
+        page_size=4, announce_period=0.3, standby=True,
+        drain_timeout=2.0, **kw,
+    )
+
+
+async def _wait_for(cond, timeout, what):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not cond():
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        await asyncio.sleep(0.1)
+
+
+def test_three_standbys_exactly_one_promotes(tiny_model_dir):
+    """The acceptance scenario: 3 standbys watching one chronically hot
+    span must end with EXACTLY one promoted serving replica — the
+    jittered pre-declare re-check plus the post-declare storm resolution
+    (lexicographically-smallest promoted id wins) de-duplicates the rest."""
+    model_dir, _, _ = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        # a fake hot primary: ONLINE record whose load advert pins the
+        # predicted queue delay at the cap (10s >> the 500ms watermark);
+        # ts fresh so the staleness discount stays above the watermark
+        # for the whole test
+        hot = ServerInfo(
+            state=ServerState.ONLINE, host="127.0.0.1", port=1,
+            throughput=1.0, start_block=0, end_block=3,
+            load={"ts": time.time(), "delay_ms": 1e9},
+        )
+        await rc().declare_blocks(
+            "tiny", "srv-hotprimary", range(3), hot, expiration=60.0
+        )
+
+        standbys = [_standby_server(model_dir, rc()) for _ in range(3)]
+        for s in standbys:
+            await s.start()
+        await _wait_for(
+            lambda: sum(s._promoted for s in standbys) >= 1, 25.0,
+            "any standby promotion",
+        )
+        # let the storm (if any) fully resolve, then require convergence
+        # to exactly one promoted replica, stable over several ticks
+        await asyncio.sleep(3.0)
+        for _ in range(5):
+            assert sum(s._promoted for s in standbys) == 1
+            assert sum(s._standby for s in standbys) == 2
+            await asyncio.sleep(0.3)
+        # every decision is operator-visible: the winner counted its
+        # promotion; any racer that also declared counted a yield
+        winner = next(s for s in standbys if s._promoted)
+        assert winner.promotions >= 1
+        assert winner._advert_state() == ServerState.ONLINE
+        assert winner.server_info().promoted_standby
+        for s in standbys:
+            if s is not winner:
+                assert s._advert_state() == ServerState.JOINING
+                assert s.promotions == s.promotions_yielded
+        for s in standbys:
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_standby_promotes_on_dead_span_and_serves(tiny_model_dir):
+    """Kill the span's only server: the standby must detect the silent
+    span, promote, and actually serve — a fresh client run through the
+    promoted replica matches HF greedy decoding exactly."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        primary = BlockServer(
+            model_uid="tiny", start=0, end=3, model_dir=model_dir,
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, announce_period=0.3,
+        )
+        standby = _standby_server(model_dir, rc())
+        await primary.start()
+        await standby.start()
+
+        # a standby is not a serving replica: a session opened directly
+        # against it must be refused before any KV is allocated
+        from bloombee_tpu.wire.rpc import RpcError, connect
+
+        conn = await connect("127.0.0.1", standby.port)
+        with pytest.raises(RpcError):
+            stream = await conn.open_stream(
+                "rpc_inference",
+                {"session_id": "s-refused", "batch_size": 1,
+                 "max_length": 8},
+            )
+            await stream.recv()
+        await conn.close()
+
+        # while the primary lives, the standby must not promote
+        await asyncio.sleep(2.0)
+        assert standby._standby and not standby._promoted
+
+        await primary.stop()  # tombstones the span: advert silence
+        await _wait_for(
+            lambda: standby._promoted, 20.0, "promotion after span loss"
+        )
+
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny"
+        )
+        rng = np.random.default_rng(11)
+        input_ids = rng.integers(0, config.vocab_size, size=(1, 4))
+        ids = await model.generate(
+            input_ids, max_new_tokens=5, server_decode=False
+        )
+        with torch.no_grad():
+            ref = hf_model.generate(
+                torch.tensor(input_ids), max_new_tokens=5, do_sample=False,
+                use_cache=True,
+            ).numpy()
+        np.testing.assert_array_equal(ids, ref)
+        assert standby.promotions == 1
+
+        await standby.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_promoted_replica_demotes_when_span_cools(tiny_model_dir):
+    """Hysteretic drain-back: once OTHER live coverage stays below the low
+    watermark for the sustain window, a promoted replica returns to
+    standby (JOINING) — and re-promotes when that coverage disappears
+    again. Never demotes while it is the span's sole coverage."""
+    model_dir, _, _ = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        standby = _standby_server(model_dir, rc())
+        await standby.start()
+        # no serving replica at all: the standby must promote...
+        await _wait_for(
+            lambda: standby._promoted, 20.0, "promotion of sole standby"
+        )
+        # ...and must NOT demote while it is the only coverage
+        await asyncio.sleep(1.5)
+        assert standby._promoted and standby.demotions == 0
+
+        # a healthy primary (re)appears, cool (no load advert = delay 0)
+        cool = ServerInfo(
+            state=ServerState.ONLINE, host="127.0.0.1", port=1,
+            throughput=1.0, start_block=0, end_block=3,
+        )
+
+        async def keep_cool_alive():
+            while True:
+                await rc().declare_blocks(
+                    "tiny", "srv-coolprimary", range(3), cool,
+                    expiration=2.0,
+                )
+                await asyncio.sleep(0.5)
+
+        alive = asyncio.create_task(keep_cool_alive())
+        await _wait_for(
+            lambda: not standby._promoted and standby._standby, 20.0,
+            "drain-back after the span cooled",
+        )
+        assert standby.demotions == 1
+        assert standby._advert_state() == ServerState.JOINING
+
+        # the primary dies again: the SAME standby must promote again
+        alive.cancel()
+        await rc().revoke_blocks(
+            "tiny", "srv-coolprimary", range(3), expiration=60.0
+        )
+        await _wait_for(
+            lambda: standby._promoted, 20.0, "re-promotion after re-loss"
+        )
+        assert standby.promotions == 2
+
+        await standby.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_client_update_sees_standby_spans(tiny_model_dir):
+    """The routing view must keep JOINING standbys OUT of self.spans (no
+    route may land on one) while exposing them in standby_spans so
+    pick_standby can target them for KV replication."""
+    model_dir, _, _ = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        primary = BlockServer(
+            model_uid="tiny", start=0, end=3, model_dir=model_dir,
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, announce_period=0.3, prefix_cache=True,
+        )
+        standby = _standby_server(model_dir, rc(), prefix_cache=True)
+        await primary.start()
+        await standby.start()
+
+        from bloombee_tpu.client.sequence_manager import (
+            RemoteSequenceManager,
+        )
+
+        mgr = RemoteSequenceManager(rc(), "tiny", 3)
+        await mgr.update(force=True)
+        assert primary.server_id in mgr.spans
+        assert standby.server_id not in mgr.spans
+        assert standby.server_id in mgr.standby_spans
+        # replication targeting: the standby qualifies for the primary's
+        # span even though it is invisible to routing
+        pick = mgr.pick_standby(mgr.spans[primary.server_id])
+        assert pick is not None and pick.peer_id == standby.server_id
+
+        await primary.stop()
+        await standby.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.chaos
+def test_promotion_survives_registry_chaos(tiny_model_dir):
+    """Chaos-marked: the promotion watcher must keep working through a
+    flaky registry (transient get_module_infos failures) — errors log
+    and retry, they never kill the control loop."""
+    model_dir, _, _ = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        class FlakyRegistry:
+            def __init__(self, inner, fail_every=3):
+                self._inner = inner
+                self._calls = 0
+                self._fail_every = fail_every
+
+            async def get_module_infos(self, *a, **kw):
+                self._calls += 1
+                if self._calls % self._fail_every == 0:
+                    raise RuntimeError("injected registry flap")
+                return await self._inner.get_module_infos(*a, **kw)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        standby = _standby_server(model_dir, rc())
+        standby.registry = FlakyRegistry(rc())
+        await standby.start()
+        await _wait_for(
+            lambda: standby._promoted, 25.0,
+            "promotion through registry chaos",
+        )
+        assert not standby._promotion_task.done()
+        await standby.stop()
+        await reg.stop()
+
+    asyncio.run(run())

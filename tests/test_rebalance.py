@@ -8,6 +8,7 @@ re-announce — no container restart.
 """
 
 import asyncio
+import time
 
 import numpy as np
 import pytest
@@ -16,7 +17,10 @@ import torch
 import jax.numpy as jnp
 
 from bloombee_tpu.client.model import DistributedModelForCausalLM
-from bloombee_tpu.server.block_selection import rebalance_target
+from bloombee_tpu.server.block_selection import (
+    _best_landing,
+    rebalance_target,
+)
 from bloombee_tpu.server.block_server import BlockServer
 from bloombee_tpu.swarm.data import ModuleInfo, ServerInfo
 from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
@@ -46,6 +50,100 @@ def test_rebalance_target_hysteresis_keeps_balanced_swarm():
     infos = _infos({"a": (0, 2, 1.0), "b": (2, 4, 1.0)}, 4)
     assert rebalance_target("a", infos, compute_spans(infos)) is None
     assert rebalance_target("b", infos, compute_spans(infos)) is None
+
+
+def _best_landing_naive(without, n, t):
+    """The O(blocks^2) per-candidate array-copy scan _best_landing
+    replaced; kept here as the property-test oracle."""
+    best, best_start = None, None
+    for start in range(len(without) - n + 1):
+        cand = without.copy()
+        cand[start : start + n] += t
+        m = float(cand.min())
+        if best is None or m > best:
+            best, best_start = m, start
+    return best, best_start
+
+
+def test_best_landing_matches_naive_property():
+    """Sliding-window landing scan must be EXACTLY equivalent (value and
+    tie-broken start) to the naive per-window copy over random arrays —
+    the min of (prefix, window+t, suffix) decomposition is lossless."""
+    rng = np.random.default_rng(1234)
+    for _ in range(300):
+        b = int(rng.integers(1, 40))
+        n = int(rng.integers(1, b + 1))
+        t = float(rng.uniform(0, 5))
+        without = rng.uniform(0, 10, size=b)
+        if rng.random() < 0.3:
+            # ties are the tiebreak-sensitive case: quantize so equal
+            # candidate minima actually occur
+            without = np.round(without)
+            t = round(t)
+        got = _best_landing(without, n, t)
+        want = _best_landing_naive(without, n, t)
+        assert got == want, (b, n, t, without)
+    # degenerate shapes
+    assert _best_landing(np.zeros(3), 4, 1.0) == (None, None)
+    assert _best_landing(np.zeros(3), 0, 1.0) == (None, None)
+
+
+def _hot(delay_ms=1e9):
+    """A fresh load advert pinning predicted queue delay at the cap."""
+    return {"ts": time.time(), "delay_ms": delay_ms}
+
+
+def test_measured_rebalance_attracts_mover_to_hot_span():
+    """a+c stacked on [0,2), b alone and CHRONICALLY HOT on [2,4): the
+    static objective sees a balanced-enough swarm (no move beats the
+    margin), but measured-load weighting discounts b's effective
+    throughput ~11x, so c must move to absorb the hot span."""
+    infos = _infos({"a": (0, 2, 1.0), "b": (2, 4, 1.0), "c": (0, 2, 1.0)}, 4)
+    for i in range(2, 4):
+        infos[i].servers["b"].load = _hot()
+    spans = compute_spans(infos)
+    assert rebalance_target("c", infos, spans, measured=False) is None
+    assert rebalance_target("c", infos, spans, measured=True) == (2, 4)
+
+
+def test_measured_rebalance_cold_start_falls_back_to_static():
+    """With no load adverts anywhere, the measured objective must be
+    byte-identical to the static one (automatic cold-start fallback)."""
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        n_blocks = int(rng.integers(2, 10))
+        spans_cfg = {}
+        for sid in "abcde"[: int(rng.integers(2, 5))]:
+            n = int(rng.integers(1, n_blocks + 1))
+            s = int(rng.integers(0, n_blocks - n + 1))
+            spans_cfg[sid] = (s, s + n, float(rng.uniform(0.5, 3.0)))
+        infos = _infos(spans_cfg, n_blocks)
+        spans = compute_spans(infos)
+        for sid in spans_cfg:
+            assert rebalance_target(
+                sid, infos, spans, measured=True
+            ) == rebalance_target(sid, infos, spans, measured=False)
+
+
+def test_measured_rebalance_bounds_hostile_adverts():
+    """A garbage advert (NaN/inf/negative delay) must leave the decision
+    identical to no advert at all — the shared sanitized load term is the
+    only reading of the wire data."""
+    base = _infos({"a": (0, 2, 1.0), "b": (2, 4, 1.0)}, 4)
+    for garbage in (
+        {"ts": time.time(), "delay_ms": float("nan")},
+        {"ts": time.time(), "delay_ms": float("inf")},
+        {"ts": time.time(), "delay_ms": -5.0},
+        {"ts": time.time(), "queue_depth": "wat"},
+    ):
+        infos = _infos({"a": (0, 2, 1.0), "b": (2, 4, 1.0)}, 4)
+        for i in range(2, 4):
+            infos[i].servers["b"].load = garbage
+        assert rebalance_target(
+            "a", infos, compute_spans(infos), measured=True
+        ) == rebalance_target(
+            "a", base, compute_spans(base), measured=True
+        )
 
 
 @pytest.fixture(scope="module")
@@ -121,6 +219,70 @@ def test_e2e_pathological_split_converges(tiny_model_dir):
         await asyncio.sleep(2.5)
         assert (s_b.start_block, s_b.end_block) == (1, 3)
         assert (s_a.start_block, s_a.end_block) == (0, 2)
+
+        await s_a.stop()
+        await s_b.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_supervisor_survives_registry_flaps(tiny_model_dir):
+    """Satellite regression: transient registry errors during the periodic
+    rebalance check must log-and-retry, not kill the supervisor — the
+    pathological split still converges through a registry that fails every
+    other get_module_infos, and the supervisor task stays alive after."""
+    model_dir, _, config = tiny_model_dir
+
+    class FlakyRegistry:
+        def __init__(self, inner, fail_every=2):
+            self._inner = inner
+            self._calls = 0
+            self._fail_every = fail_every
+
+        async def get_module_infos(self, *a, **kw):
+            self._calls += 1
+            if self._calls % self._fail_every == 0:
+                raise RuntimeError("injected registry flap")
+            return await self._inner.get_module_infos(*a, **kw)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        s_a = BlockServer(
+            model_uid="tiny", start=0, end=2, model_dir=model_dir,
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, announce_period=0.5,
+        )
+        s_b = BlockServer(
+            model_uid="tiny", start=0, end=2, model_dir=model_dir,
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, announce_period=0.5, rebalance_period=1.0,
+            drain_timeout=2.0,
+        )
+        flaky = FlakyRegistry(rc())
+        s_b.registry = flaky
+        await s_a.start()
+        await s_b.start()
+        deadline = asyncio.get_event_loop().time() + 30.0
+        while (s_b.start_block, s_b.end_block) == (0, 2):
+            if asyncio.get_event_loop().time() > deadline:
+                raise AssertionError(
+                    "rebalance never happened through registry flaps"
+                )
+            await asyncio.sleep(0.25)
+        assert (s_b.start_block, s_b.end_block) == (1, 3)
+        # the supervisor saw real injected failures and is still alive
+        assert flaky._calls >= flaky._fail_every
+        assert not s_b._supervisor_task.done()
+        assert s_b.rebalances_moved == 1
 
         await s_a.stop()
         await s_b.stop()

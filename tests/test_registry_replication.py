@@ -6,13 +6,14 @@ import asyncio
 
 import pytest
 
-from bloombee_tpu.swarm.data import ServerInfo
+from bloombee_tpu.swarm.data import ServerInfo, ServerState
 from bloombee_tpu.swarm.registry import (
     RegistryClient,
     RegistryServer,
     ReplicatedRegistry,
     make_registry,
 )
+from bloombee_tpu.swarm.spans import compute_spans
 
 
 def make_info(port=1234):
@@ -196,6 +197,104 @@ def test_records_carry_writer_stamps_not_replica_clocks():
     async def rep_cleanup(regs, solo):
         for s in solo:
             await s.close()
+        for r in regs:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_promotion_churn_survives_replica_restart(tmp_path):
+    """The standby promote -> demote -> re-promote lifecycle is a rapid
+    same-subkey state churn; a persisted replica that restarts mid-cycle
+    restores a STALE state record from its snapshot. Latest-write-wins
+    must keep the merged view showing exactly one span per server with
+    the newest declared state — no duplicate records, no resurrected
+    (orphaned) stale state, and a final revoke leaves nothing behind."""
+
+    def _state_info(state):
+        return ServerInfo(
+            host="127.0.0.1", port=9999, throughput=1.0,
+            start_block=0, end_block=2, state=state,
+            promoted_standby=(state == ServerState.ONLINE),
+        )
+
+    async def assert_single_span(reg, state):
+        infos = await reg.get_module_infos("m", range(0, 2))
+        for m in infos:
+            assert list(m.servers) == ["srv-sb"], (
+                f"duplicate/orphan records: {sorted(m.servers)}"
+            )
+            assert m.servers["srv-sb"].state == state
+        spans = compute_spans(infos, min_state=ServerState.JOINING)
+        assert set(spans) == {"srv-sb"}
+        assert (spans["srv-sb"].start, spans["srv-sb"].end) == (0, 2)
+
+    async def run():
+        persist = str(tmp_path / "replica0.json")
+        regs = [
+            RegistryServer(
+                host="127.0.0.1", persist_path=persist, persist_period=0.2
+            ),
+            RegistryServer(host="127.0.0.1"),
+        ]
+        for r in regs:
+            await r.start()
+        port0 = regs[0].port
+        rep = ReplicatedRegistry(
+            [RegistryClient("127.0.0.1", r.port) for r in regs],
+            timeout=3.0,
+        )
+
+        # standby appears (JOINING), then promotes (ONLINE)
+        await rep.declare_blocks(
+            "m", "srv-sb", range(0, 2), _state_info(ServerState.JOINING)
+        )
+        await assert_single_span(rep, ServerState.JOINING)
+        await asyncio.sleep(0.02)
+        await rep.declare_blocks(
+            "m", "srv-sb", range(0, 2), _state_info(ServerState.ONLINE)
+        )
+        await assert_single_span(rep, ServerState.ONLINE)
+
+        # replica 0 snapshots the ONLINE record and goes down; the demote
+        # (drain-back to JOINING) lands only on replica 1
+        await regs[0].stop()
+        await asyncio.sleep(0.02)
+        await rep.declare_blocks(
+            "m", "srv-sb", range(0, 2), _state_info(ServerState.JOINING)
+        )
+
+        # replica 0 restarts from its snapshot: it restores the stale
+        # ONLINE record, but the merged view must show the newer JOINING
+        regs[0] = RegistryServer(
+            host="127.0.0.1", port=port0, persist_path=persist,
+            persist_period=0.2,
+        )
+        await regs[0].start()
+        solo0 = RegistryClient("127.0.0.1", port0)
+        infos0 = await solo0.get_module_infos("m", range(0, 2))
+        assert infos0[0].servers["srv-sb"].state == ServerState.ONLINE, (
+            "restart precondition: the snapshot should hold stale state"
+        )
+        await solo0.close()
+        await assert_single_span(rep, ServerState.JOINING)
+
+        # re-promotion (lands on both replicas) wins over everything
+        await asyncio.sleep(0.02)
+        await rep.declare_blocks(
+            "m", "srv-sb", range(0, 2), _state_info(ServerState.ONLINE)
+        )
+        await assert_single_span(rep, ServerState.ONLINE)
+
+        # final drain-away: revoke must leave no orphaned span anywhere
+        await asyncio.sleep(0.02)
+        await rep.revoke_blocks("m", "srv-sb", range(0, 2))
+        infos = await rep.get_module_infos("m", range(0, 2))
+        for m in infos:
+            assert "srv-sb" not in m.servers, "orphaned span record"
+        assert compute_spans(infos, min_state=ServerState.JOINING) == {}
+
+        await rep.close()
         for r in regs:
             await r.stop()
 
