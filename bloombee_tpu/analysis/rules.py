@@ -1,4 +1,4 @@
-"""bbtpu-lint rules BB001–BB006.
+"""bbtpu-lint rules BB001–BB007.
 
 Each rule encodes one invariant this codebase has already been burned by
 (see ARCHITECTURE.md "Invariants"). Rules are plugin classes over the
@@ -523,6 +523,105 @@ class CounterSurfacingRule(Rule):
         return out
 
 
+class ExactTensorCompareRule(Rule):
+    """BB007: no exact equality on hidden-state tensors in client/server
+    verification paths.
+
+    Honest replicas differ in ulps: float reductions are batch-width
+    dependent (a server batching our rows with a stranger's sums in a
+    different order), so `lie == truth`-style checks convict honest
+    peers — the exact trap the integrity layer's `tensors_close`
+    (client/integrity.py) exists to avoid. Byte-exact digests over the
+    SAME serialized array (kv/prefix.out_digest) are a different thing
+    and stay quiet: the rule only fires on float-compare calls
+    (np.array_equal & co.) and on `==`/`!=` where BOTH sides are
+    hidden-state expressions. Shape/dtype/index comparisons are excluded
+    by token.
+    """
+
+    code = "BB007"
+    name = "exact-float-tensor-compare"
+    summary = "exact equality compare on hidden-state tensors"
+
+    EQ_CALLS = {"array_equal", "array_equiv", "assert_array_equal"}
+    HIDDENISH = ("hidden", "activation", "logits")
+    # any of these underscore-separated name parts anywhere in the
+    # expression means it is NOT a float-tensor payload (geometry,
+    # bookkeeping, identifiers). Matched per-part, not per-substring:
+    # "hidden" must not be excluded just because it contains "id"
+    EXCLUDE = {
+        "shape", "dtype", "size", "dim", "dims", "len", "count", "num",
+        "idx", "index", "id", "ids", "step", "pos", "digest", "token",
+        "tokens",
+    }
+
+    def _in_scope(self, path: str) -> bool:
+        return (
+            "/client/" in path
+            or "/server/" in path
+            or path.startswith(("client/", "server/"))
+        )
+
+    @staticmethod
+    def _tokens(node: ast.AST) -> list[str]:
+        toks = []
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                toks.append(n.id.lower())
+            elif isinstance(n, ast.Attribute):
+                toks.append(n.attr.lower())
+        return toks
+
+    def _hiddenish(self, node: ast.AST) -> bool:
+        toks = self._tokens(node)
+        if any(p in self.EXCLUDE for t in toks for p in t.split("_")):
+            return False
+        for t in toks:
+            if any(h in t for h in self.HIDDENISH):
+                return True
+            # span outputs are conventionally named out / outs / *_out
+            if any(p in ("out", "outs", "outputs") for p in t.split("_")):
+                return True
+        return False
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        if not self._in_scope(sf.path):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            bad = None
+            if isinstance(node, ast.Call):
+                if _call_name(node) in self.EQ_CALLS and any(
+                    self._hiddenish(a) for a in node.args
+                ):
+                    bad = f"`{_call_name(node)}(...)`"
+            elif isinstance(node, ast.Compare):
+                if (
+                    all(
+                        isinstance(op, (ast.Eq, ast.NotEq))
+                        for op in node.ops
+                    )
+                    and self._hiddenish(node.left)
+                    and all(
+                        self._hiddenish(c) for c in node.comparators
+                    )
+                ):
+                    bad = f"`{_expr_text(node)}`"
+            if bad is None:
+                continue
+            f = sf.finding(
+                self.code,
+                node,
+                f"exact equality {bad} on hidden-state tensors convicts "
+                "honest replicas over ulp drift (float reductions are "
+                "batch-width dependent); use the dtype-aware "
+                "tensors_close (client/integrity.py) instead",
+            )
+            if f:
+                out.append(f)
+        return out
+
+
 def make_rules() -> list[Rule]:
     """Fresh rule instances (BB006 keeps cross-file state)."""
     return [
@@ -532,6 +631,7 @@ def make_rules() -> list[Rule]:
         WireCompatRule(),
         EnvRegistryRule(),
         CounterSurfacingRule(),
+        ExactTensorCompareRule(),
     ]
 
 

@@ -181,6 +181,24 @@ def main(argv=None):
                         line += "  " + " ".join(
                             f"{k}={v}" for k, v in sorted(elastic.items())
                         )
+                    # integrity counters: digest stamps emitted, audit
+                    # re-executions served, liar-hook lies injected (test
+                    # swarms only — nonzero here in production is an
+                    # incident), and silent prefix hash-chain failures
+                    integ = {
+                        k: probe[k]
+                        for k in (
+                            "out_digests_sent",
+                            "audit_forwards",
+                            "liar_steps",
+                            "seq_hash_extend_failures",
+                        )
+                        if probe.get(k)
+                    }
+                    if integ:
+                        line += "  " + " ".join(
+                            f"{k}={v}" for k, v in sorted(integ.items())
+                        )
                     # session lease counters: are leases reaping abandoned
                     # sessions, are clients resuming instead of replaying,
                     # and is keepalive traffic flowing on idle conns

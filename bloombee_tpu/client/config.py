@@ -94,3 +94,22 @@ class ClientConfig:
     # server is detected without waiting out step_timeout; None ->
     # BBTPU_KEEPALIVE_S env, 0 disables
     keepalive_s: float | None = None
+    # Byzantine-robust serving (opt-in; off = byte-for-byte legacy
+    # behavior): every received span output passes an inline sanity gate
+    # (all-finite + activation-RMS envelope) plus out_digest verification
+    # against digest-advertising servers; rejects strike the peer and heal
+    # via the existing reroute+replay recovery. None -> BBTPU_INTEGRITY env
+    integrity: bool | None = None
+    # per-step probability of re-executing a recorded span step on a
+    # DIFFERENT server covering the same blocks and tolerance-comparing
+    # the outputs (never exact equality — honest replicas differ in ulps);
+    # a confirmed mismatch triggers a third-replica tiebreak and the
+    # outvoted peer enters quarantine. > 0 implies integrity for the
+    # session. None -> BBTPU_AUDIT_P env
+    audit_p: float | None = None
+    # quarantine penalty class (integrity convictions): base/cap backoff
+    # seconds — deliberately the longest class (a peer that LIED, vs
+    # crashed) — and how many sanity-gate strikes convict
+    quarantine_timeout: float = 600.0
+    quarantine_max: float = 3600.0
+    integrity_strike_limit: int = 2

@@ -1,0 +1,149 @@
+"""Client-side integrity layer: sanity gate, tolerance compare, audits.
+
+Petals names the gap this closes: in a public swarm, peers may return
+incorrect outputs — maliciously or via bad hardware — and the client feeds
+whatever hidden states a server returns straight into the next span. This
+module holds the pure pieces of the defense in depth:
+
+- ``SanityGate``: a cheap O(B*D) inline check every received span output
+  passes before entering the next span — all-finite plus a per-span running
+  activation-RMS envelope. It catches the loud lies (NaN poison, large
+  scaling) at the step they happen, BEFORE the token commits, so recovery
+  replays from clean history and the final generation stays token-identical
+  to a clean run.
+- ``tensors_close``: the dtype-aware tolerance compare used by audits.
+  NEVER exact equality: honest replicas differ in ulps because float
+  reductions are batch-width dependent (a server batching our row with a
+  stranger's sums in a different order). Exact compares convict honest
+  peers; bbtpu-lint BB007 flags them.
+- ``IntegrityError``: raised into the existing reroute+replay recovery
+  path when a check fails — integrity rejects heal exactly like crashes.
+
+Everything here is opt-in (``ClientConfig.integrity`` / ``BBTPU_INTEGRITY``,
+``BBTPU_AUDIT_P``); off means byte-for-byte pre-integrity behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import ml_dtypes
+import numpy as np
+
+from bloombee_tpu.utils import env
+from bloombee_tpu.wire.rpc import RpcError
+
+logger = logging.getLogger(__name__)
+
+env.declare(
+    "BBTPU_INTEGRITY", bool, False,
+    "enable the client integrity layer (inline sanity gate on every span "
+    "output + out_digest verification) and server-side digest adverts",
+)
+env.declare(
+    "BBTPU_AUDIT_P", float, 0.0,
+    "per-step probability of re-executing a recorded span step on a "
+    "different replica and tolerance-comparing the outputs (0 disables "
+    "audits; implies the integrity layer for the session when > 0)",
+)
+
+
+class IntegrityError(RpcError):
+    """A span output failed an integrity check. Subclasses RpcError so the
+    session's existing retry loop heals it via reroute+replay — but the
+    session skips the resume fast-path for it (resuming would retransmit
+    to the same lying peer)."""
+
+
+_BF16 = np.dtype(ml_dtypes.bfloat16)
+
+
+def _as_f32(arr) -> np.ndarray:
+    a = np.asarray(arr)
+    if a.dtype != np.float32:
+        a = a.astype(np.float32)
+    return a
+
+
+def rtol_for(dtype) -> float:
+    """Audit comparison tolerance for a wire dtype (numpy dtype or wire
+    name like "bf16"). Generous on purpose: the question is "is this peer
+    lying", not "are these bit-identical" — honest cross-replica ulp
+    drift must never convict."""
+    if isinstance(dtype, str):
+        from bloombee_tpu.wire.tensor_codec import dtype_for_name
+
+        dt = dtype_for_name(dtype)
+    else:
+        dt = np.dtype(dtype)
+    if dt in (_BF16, np.dtype(np.float16)):
+        return 0.1
+    if dt == np.dtype(np.float32):
+        return 0.02
+    return 1e-6
+
+
+def tensors_close(a, b, dtype=None) -> bool:
+    """Dtype-aware tolerance compare of two span outputs.
+
+    ``dtype`` is the wire dtype the activations travelled in (defaults to
+    the coarser of the two inputs' dtypes); the absolute floor scales with
+    the reference RMS so near-zero channels don't demand absolute
+    precision the format can't express."""
+    aa, bb = np.asarray(a), np.asarray(b)
+    if aa.shape != bb.shape:
+        return False
+    if dtype is None:
+        dtype = max(
+            (aa.dtype, bb.dtype),
+            key=lambda d: rtol_for(d),
+        )
+    rtol = rtol_for(dtype)
+    a32, b32 = _as_f32(aa), _as_f32(bb)
+    rms = float(np.sqrt(np.mean(np.square(a32)))) if a32.size else 0.0
+    atol = rtol * max(rms, 1e-6)
+    return bool(np.allclose(a32, b32, rtol=rtol, atol=atol))
+
+
+class SanityGate:
+    """Per-span running activation-norm envelope plus all-finite check.
+
+    Keyed by span block range (start, end) — not by peer — so a rerouted
+    replacement server is judged against the same envelope its predecessor
+    established. The envelope is high-side only with a generous margin:
+    ulp-level drift between honest replicas is orders of magnitude below
+    it, so a clean swarm never trips the gate (the false-positive suite
+    pins this). Warmup observations are accepted unconditionally; stats
+    update only on accepted outputs so one lie can't stretch the envelope
+    for the next."""
+
+    def __init__(self, margin: float = 4.0, warmup: int = 3):
+        self.margin = float(margin)
+        self.warmup = int(warmup)
+        # span key -> [observations, max accepted per-position RMS]
+        self._stats: dict[tuple, list] = {}
+
+    def check(self, key, arr) -> str | None:
+        """Returns None when `arr` passes, else a short reject reason."""
+        a32 = _as_f32(arr)
+        if not np.isfinite(a32).all():
+            return "nonfinite"
+        if a32.size == 0:
+            return None
+        # O(B*T*D): per-position RMS over the feature dim, worst position.
+        # f64 accumulator: a x64-scaled bf16 lie squares past f32 range,
+        # and an inf RMS accepted during warmup would poison the envelope
+        rms = np.sqrt(np.mean(np.square(a32, dtype=np.float64), axis=-1))
+        worst = float(rms.max())
+        st = self._stats.get(key)
+        if st is None:
+            st = [0, 0.0]
+            self._stats[key] = st
+        if st[0] >= self.warmup and worst > self.margin * max(st[1], 1e-6):
+            return (
+                f"rms-envelope: {worst:.3g} > {self.margin:g}x"
+                f" {st[1]:.3g}"
+            )
+        st[0] += 1
+        st[1] = max(st[1], worst)
+        return None

@@ -108,6 +108,9 @@ class DistributedModelForCausalLM:
             manager.load_aware = config.load_aware_routing
             manager.overload_timeout = config.overload_timeout
             manager.overload_max = config.overload_max
+            manager.quarantine_timeout = config.quarantine_timeout
+            manager.quarantine_max = config.quarantine_max
+            manager.integrity_strike_limit = config.integrity_strike_limit
         self.config = config or ClientConfig(use_push=use_push)
         self.use_push = self.config.use_push
 
@@ -146,6 +149,9 @@ class DistributedModelForCausalLM:
             load_aware=config.load_aware_routing,
             overload_timeout=config.overload_timeout,
             overload_max=config.overload_max,
+            quarantine_timeout=config.quarantine_timeout,
+            quarantine_max=config.quarantine_max,
+            integrity_strike_limit=config.integrity_strike_limit,
         )
         return cls(spec, params, manager, config=config)
 
@@ -202,6 +208,8 @@ class DistributedModelForCausalLM:
             resume=cfg.resume,
             resume_timeout=cfg.resume_timeout,
             keepalive_s=cfg.keepalive_s,
+            integrity=cfg.integrity,
+            audit_p=cfg.audit_p,
         )
 
     # --------------------------------------------------------------- generate

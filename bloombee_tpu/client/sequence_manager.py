@@ -82,6 +82,12 @@ class RemoteSequenceManager:
         # overloaded shed — a distinct, much shorter penalty class than
         # fault bans (the server is healthy, just busy right now)
         overload_max: float = 15.0,  # overload-avoid cap (faults: ban_max)
+        quarantine_timeout: float = 600.0,  # base exile after an
+        # integrity conviction — a peer that LIED (vs crashed) gets the
+        # longest penalty class: minutes, not seconds
+        quarantine_max: float = 3600.0,
+        integrity_strike_limit: int = 2,  # sanity-gate rejects before a
+        # peer tips from "suspicious" into quarantine
     ):
         self.registry = registry
         self.model_uid = model_uid
@@ -108,6 +114,18 @@ class RemoteSequenceManager:
         # bans, but a separate map with shorter base/cap so "busy" never
         # escalates into the minutes-long exile reserved for failures
         self._hot: dict[str, _BanState] = {}
+        # integrity penalty class (Byzantine, not crash, faults): same
+        # half-open machine, much longer base/cap, and — unlike bans —
+        # escalation survives a successful probe (a liar that behaves for
+        # one probe step re-enters at the doubled backoff next conviction)
+        self.quarantine_timeout = quarantine_timeout
+        self.quarantine_max = quarantine_max
+        self.quarantine_probe_timeout = 60.0
+        self.integrity_strike_limit = integrity_strike_limit
+        self._quarantine: dict[str, _BanState] = {}
+        self._quarantine_history: dict[str, int] = {}  # strikes survive readmit
+        self._integrity_strikes: dict[str, int] = {}
+        self.peers_quarantined = 0  # counter: quarantine events (bench/health)
         self._last_update = 0.0
         self._rng = rng or random.Random()
         # measured client->server RTTs (reference ping.py PingAggregator);
@@ -198,24 +216,88 @@ class RemoteSequenceManager:
             backoff, state.strikes,
         )
 
+    def note_integrity_strike(self, peer_id: str) -> bool:
+        """An integrity check (sanity gate, digest, audit suspicion)
+        rejected this peer's output. Strikes accumulate for the life of
+        the session — ordinary successes do NOT clear them, because a lie
+        is evidence of Byzantine behavior, not a transient fault. Returns
+        True when the strike tipped the peer into quarantine."""
+        n = self._integrity_strikes.get(peer_id, 0) + 1
+        self._integrity_strikes[peer_id] = n
+        logger.warning(
+            "integrity strike %d/%d against peer %s", n,
+            self.integrity_strike_limit, peer_id,
+        )
+        if n >= self.integrity_strike_limit:
+            self.quarantine_peer(peer_id)
+            return True
+        return False
+
+    def quarantine_peer(self, peer_id: str) -> None:
+        """Integrity conviction: exile the peer with the longest penalty
+        class. Same exponential backoff + half-open probe machinery as
+        fault bans, but escalation is restored from `_quarantine_history`
+        so a readmitted liar that re-offends starts from the doubled
+        backoff, not from scratch. The accumulated sanity strikes reset:
+        after readmission, fresh evidence is required to re-convict."""
+        state = self._quarantine.setdefault(peer_id, _BanState())
+        state.strikes = max(
+            state.strikes, self._quarantine_history.get(peer_id, 0)
+        )
+        state.probing = False
+        state.strikes += 1
+        self._quarantine_history[peer_id] = state.strikes
+        backoff = min(
+            self.quarantine_timeout * (2.0 ** (state.strikes - 1)),
+            self.quarantine_max,
+        )
+        backoff *= 0.75 + 0.5 * self._rng.random()
+        state.banned_until = time.monotonic() + backoff
+        self._integrity_strikes.pop(peer_id, None)
+        self.peers_quarantined += 1
+        self.pinger.forget(peer_id)
+        logger.warning(
+            "QUARANTINED peer %s for %.0fs (conviction %d): excluded from "
+            "routing and standby selection", peer_id, backoff, state.strikes,
+        )
+
     def note_peer_ok(self, peer_id: str) -> None:
         """A request through this peer succeeded: the half-open trial (or
         any lingering strike/overload history) is cleared so the next
-        failure starts from the base backoff again."""
+        failure starts from the base backoff again. A quarantined peer
+        that passes its probe is readmitted, but its escalation history
+        survives in `_quarantine_history` (and its sanity strikes were
+        already reset at conviction) — liars don't earn a clean slate."""
         if self._bans.pop(peer_id, None) is not None:
             logger.info("peer %s recovered; ban history reset", peer_id)
         self._hot.pop(peer_id, None)
+        if self._quarantine.pop(peer_id, None) is not None:
+            logger.info(
+                "quarantined peer %s passed its half-open probe; readmitted "
+                "(escalation history retained)", peer_id,
+            )
 
     def _ban_excludes(self, peer_id: str, now: float) -> bool:
-        """True when bans OR overload-avoidance keep this peer out of
-        routing right now. An expired entry admits exactly ONE route as the
-        half-open probe; other routes keep avoiding the peer until the
-        probe resolves."""
+        """True when bans, overload-avoidance OR quarantine keep this peer
+        out of routing right now. An expired entry admits exactly ONE
+        route as the half-open probe; other routes keep avoiding the peer
+        until the probe resolves."""
         return self._state_excludes(
             self._bans, peer_id, now, self.probe_timeout, "banned"
         ) or self._state_excludes(
             self._hot, peer_id, now, self.overload_probe_timeout,
             "overloaded",
+        ) or self._integrity_excludes(peer_id, now)
+
+    def _integrity_excludes(self, peer_id: str, now: float) -> bool:
+        """Quarantine exclusion (half-open like the other classes, with
+        the long probe lease). Checked in EVERY pool construction — normal
+        routing, the degraded standby pool, and the warm-standby list —
+        because a lying peer must never be handed work or replicated KV,
+        however desperate the swarm is."""
+        return self._state_excludes(
+            self._quarantine, peer_id, now, self.quarantine_probe_timeout,
+            "quarantined",
         )
 
     @staticmethod
@@ -252,8 +334,14 @@ class RemoteSequenceManager:
         swarm view, and long-expired bans whose peer was never re-routed
         (without this the maps grow monotonically with churn)."""
         now = time.monotonic()
+        if self.spans:
+            for d in (self._quarantine_history, self._integrity_strikes):
+                for pid in list(d):
+                    if pid not in self.spans:
+                        del d[pid]
         for states, cap in ((self._bans, self.ban_max),
-                            (self._hot, self.overload_max)):
+                            (self._hot, self.overload_max),
+                            (self._quarantine, self.quarantine_max)):
             for pid in list(states):
                 state = states[pid]
                 gone = self.spans and pid not in self.spans
@@ -278,8 +366,12 @@ class RemoteSequenceManager:
             and not (
                 self._ban_excludes(s.peer_id, now)
                 if overload_excludes
-                else self._state_excludes(
-                    self._bans, s.peer_id, now, self.probe_timeout, "banned"
+                else (
+                    self._state_excludes(
+                        self._bans, s.peer_id, now, self.probe_timeout,
+                        "banned",
+                    )
+                    or self._integrity_excludes(s.peer_id, now)
                 )
             )
             and s.peer_id not in self.blocked_servers
@@ -333,6 +425,7 @@ class RemoteSequenceManager:
             if not self._state_excludes(
                 self._bans, s.peer_id, now, self.probe_timeout, "banned"
             )
+            and not self._integrity_excludes(s.peer_id, now)
             and s.peer_id not in self.blocked_servers
             and (
                 self.allowed_servers is None

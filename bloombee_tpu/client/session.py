@@ -19,6 +19,11 @@ import uuid
 
 import numpy as np
 
+from bloombee_tpu.client.integrity import (
+    IntegrityError,
+    SanityGate,
+    tensors_close,
+)
 from bloombee_tpu.client.sequence_manager import (
     MissingBlocksError,
     RemoteSequenceManager,
@@ -146,6 +151,14 @@ class InferenceSession:
         # before giving up on the cheap path (the lease clock is running)
         keepalive_s: float | None = None,  # client-side wire keepalive for
         # span connections (None -> BBTPU_KEEPALIVE_S env; 0 disables)
+        integrity: bool | None = None,  # Byzantine-robust mode: inline
+        # sanity gate + out_digest verification on every received span
+        # output; rejects strike the peer and heal via the existing
+        # reroute+replay recovery (None -> BBTPU_INTEGRITY env)
+        audit_p: float | None = None,  # per-step probability of
+        # re-executing a recorded span step on a different replica and
+        # tolerance-comparing the outputs (None -> BBTPU_AUDIT_P env;
+        # > 0 implies integrity for this session)
     ):
         self.manager = manager
         self.adapter = adapter
@@ -162,6 +175,28 @@ class InferenceSession:
         )
         self.resume_timeout = float(resume_timeout)
         self.keepalive_s = keepalive_s
+        # integrity layer (opt-in; off = byte-for-byte legacy behavior)
+        self.audit_p = (
+            float(env.get("BBTPU_AUDIT_P")) if audit_p is None
+            else float(audit_p)
+        )
+        self.integrity = (
+            bool(env.get("BBTPU_INTEGRITY")) if integrity is None
+            else bool(integrity)
+        ) or self.audit_p > 0
+        self._gate = SanityGate() if self.integrity else None
+        # integrity observability (bench + tests read these)
+        self.sanity_rejects = 0
+        self.audits_run = 0
+        self.audit_mismatches = 0
+        self.integrity_reroutes = 0
+        self._audit_rng = random.Random()
+        # audit input records: span 0 re-embeds its full input from the id
+        # history, spans > 0 accumulate their relay-mode input chunks here;
+        # None = invalidated (push-mode multi-span, prefix skip, reroute,
+        # decode_n/spec commits) — audits then cover span 0 only
+        self._span_in: list[list[np.ndarray]] | None = None
+        self._last_span_outs: list = []
         # reconnect-resume observability: streams re-attached without
         # replay, resumes the servers declined (fell back to recovery),
         # and the (step_id, prefix_skip) of the last transmitted step so a
@@ -532,7 +567,21 @@ class InferenceSession:
                     commit_lens, prefix_skip=skip, step_id=step_id,
                     rows=rows,
                 )
+                if (
+                    self._gate is not None
+                    and self.audit_p > 0
+                    and commit
+                    and tree_mask is None
+                    and rows is None
+                    and self._audit_rng.random() < self.audit_p
+                ):
+                    # BEFORE the commit: a convicted primary raises here
+                    # and the retry loop re-executes the step on an honest
+                    # chain, so the lying output never reaches the caller
+                    # and the committed history stays clean
+                    await self._audit_step(out, ids, skip)
                 if commit and tree_mask is None:
+                    self._record_span_inputs(skip)
                     if ids is not None and self.embed_fn is not None:
                         for i, row in enumerate(np.asarray(ids)):
                             self._id_rows[i].extend(int(t) for t in row)
@@ -576,6 +625,12 @@ class InferenceSession:
                     and self._last_sent is not None
                     and prune is None
                     and accept_per_span is None
+                    # resume would retransmit to the SAME peer whose output
+                    # an integrity check just rejected — and a lying
+                    # server's at-most-once dedup would replay the recorded
+                    # lie verbatim. Integrity rejects always take the full
+                    # reroute+replay path.
+                    and not isinstance(e, IntegrityError)
                 ):
                     # cheap path first: re-attach the lease-parked sessions
                     # on fresh streams and retransmit the failed step under
@@ -612,6 +667,258 @@ class InferenceSession:
         (half-open probes resolve to healthy; backoff resets to base)."""
         for s in self._spans:
             self.manager.note_peer_ok(s.span.peer_id)
+
+    # ------------------------------------------------------------- integrity
+    def _check_span_output(self, span_sess, resp_meta, chunk) -> None:
+        """Inline checks on one received span-output chunk, run BEFORE the
+        chunk enters the output buffer or gets relayed to the next span.
+        Digest first (exact: the server hashed the exact bytes it
+        serialized, so any in-flight corruption mismatches — this is a
+        same-bytes check, never a cross-replica float compare), then the
+        O(B*D) sanity gate (all-finite + activation-RMS envelope)."""
+        span = span_sess.span
+        digest = resp_meta.get("out_digest")
+        verified = False
+        if digest is not None:
+            from bloombee_tpu.kv.prefix import out_digest
+
+            if out_digest(chunk) != digest:
+                # bytes changed BETWEEN serialization and us: that is
+                # evidence against the wire, not the peer (a liar's digest
+                # matches its lie) — ordinary short ban, no quarantine
+                # strike, so ambient chaos corruption never convicts an
+                # honest server of lying
+                self._integrity_reject(
+                    span.peer_id,
+                    "out_digest mismatch (in-flight corruption)",
+                    strike=False, ban=True,
+                )
+            verified = True
+        reason = self._gate.check((span.start, span.end), chunk)
+        if reason is not None:
+            # a digest-VERIFIED gate reject is the peer's own computation
+            # (the wire is ruled out): count a strike but do NOT ban, so
+            # routing re-picks the peer and its next lie convicts it at
+            # the strike limit — conviction needs repeat evidence, never
+            # a single sample. Without a digest the wire could be at
+            # fault, so the reroute also takes the safe short ban.
+            self._integrity_reject(
+                span.peer_id, reason, strike=True, ban=not verified
+            )
+
+    def _integrity_reject(
+        self, peer_id: str, reason: str, strike: bool, ban: bool
+    ) -> None:
+        """An integrity check failed: raise into the session retry loop —
+        integrity rejects heal exactly like crash faults (reroute +
+        replay), they just never silently propagate a poisoned activation
+        downstream. `strike=True` (the digest passed or was absent, yet
+        the numbers are wrong: the peer COMPUTED garbage) counts a
+        quarantine strike, tipping a repeat offender into quarantine;
+        `ban` additionally takes the ordinary short fault ban so the
+        rebuilt route avoids the peer right now."""
+        self.sanity_rejects += 1
+        self.integrity_reroutes += 1
+        if strike:
+            self.manager.note_integrity_strike(peer_id)
+        if ban:
+            self.manager.ban_peer(peer_id)
+        logger.warning(
+            "integrity reject: %s from peer %s; rerouting", reason, peer_id
+        )
+        raise IntegrityError(f"span output rejected ({reason})")
+
+    def _record_span_inputs(self, skip) -> None:
+        """Accumulate per-span input history for cross-replica audits.
+        Relay-mode chunks are exactly span i+1's inputs, so recording them
+        costs nothing extra; span 0 never records (its input re-embeds
+        from the id history on demand). Anything that breaks completeness
+        — prefix skip, push-mode multi-span hops the client never sees, a
+        rerouted chain — invalidates the record and audits fall back to
+        span 0 only."""
+        if self._gate is None or self.audit_p <= 0 or len(self._spans) <= 1:
+            return
+        outs = self._last_span_outs
+        if (
+            skip
+            or self.use_push
+            or len(outs) != len(self._spans)
+            or any(o is None for o in outs[:-1])
+            or (
+                self._span_in is not None
+                and len(self._span_in) != len(self._spans)
+            )
+        ):
+            self._span_in = None
+            return
+        if self._span_in is None:
+            if self.position != 0:
+                return  # history started before recording did: incomplete
+            self._span_in = [[] for _ in self._spans]
+        for i in range(1, len(self._spans)):
+            self._span_in[i].append(outs[i - 1])
+
+    def _find_covering(self, start: int, end: int, exclude: set):
+        """Active (non-banned, non-quarantined) spans whose server covers
+        [start, end), deterministically ordered."""
+        spans = [
+            s for s in self.manager._active_spans()
+            if s.peer_id not in exclude and s.start <= start and s.end >= end
+        ]
+        spans.sort(key=lambda s: s.peer_id)
+        return spans
+
+    async def _remote_forward(self, span, start, end, hidden):
+        """Re-execute blocks [start, end) over the full recorded input on
+        `span`'s server via the sessionless rpc_forward plane. Returns the
+        f32 output, or None when the server is unreachable or declines
+        (hetero/host-offload spans have no training path) — an absent
+        auditor is never evidence against anyone."""
+        try:
+            conn = await connect(
+                span.server_info.host, span.server_info.port,
+                keepalive_s=self.keepalive_s,
+            )
+        except (OSError, RpcError, asyncio.TimeoutError):
+            return None
+        try:
+            meta = {"start": int(start), "end": int(end), "audit": True}
+            if self.adapter:
+                meta["adapter"] = self.adapter
+            resp, tensors = await conn.call(
+                "rpc_forward", meta,
+                [np.ascontiguousarray(hidden, dtype=np.float32)],
+                timeout=self.step_timeout,
+            )
+            if not resp.get("ok") or not tensors:
+                return None
+            return np.asarray(tensors[0], dtype=np.float32)
+        except (OSError, RpcError, asyncio.TimeoutError):
+            return None
+        finally:
+            try:
+                await conn.close()
+            except Exception:
+                pass
+
+    async def _audit_step(self, out, ids, skip) -> None:
+        """Probabilistic activation audit: re-execute the step just
+        received for one span S on a DIFFERENT server covering S, over
+        S's full recorded input history (attention needs every previous
+        position — a single-step re-execution would compare garbage), and
+        tolerance-compare the last step's positions.
+
+        NEVER exact equality: honest replicas differ in ulps because
+        float reductions are batch-width dependent (the primary may have
+        batched our rows with another session's). A digest fast-path
+        short-circuits the compare when the replicas happen to agree
+        bitwise; a mismatch escalates to the dtype-aware tolerance
+        compare, never straight to a verdict. Disagreement within
+        tolerance triggers a third-replica tiebreak when one exists; the
+        outvoted peer is quarantined. No quorum -> suspicion strikes for
+        both, conviction for neither."""
+        from bloombee_tpu.kv.prefix import out_digest
+
+        # choose an auditable span: 0 when the id history re-embeds
+        # cleanly, plus any span with a complete relay input record
+        candidates: list[int] = []
+        if (
+            self.embed_fn is not None
+            and not self._history
+            and ids is not None
+            and len({len(r) for r in self._id_rows}) == 1
+        ):
+            candidates.append(0)
+        if self._span_in is not None and len(self._span_in) == len(self._spans):
+            candidates.extend(range(1, len(self._spans)))
+        if not candidates:
+            return
+        i = candidates[self._audit_rng.randrange(len(candidates))]
+        span_sess = self._spans[i]
+        span = span_sess.span
+        outs = self._last_span_outs
+        primary_out = outs[i] if i < len(outs) else None
+        if primary_out is None:
+            return
+        peers = self._find_covering(span.start, span.end, {span.peer_id})
+        if not peers:
+            return  # no alternative replica covers S on this topology
+        # reconstruct span S's full input history (this step included —
+        # the audit runs before the commit, so the id rows don't hold this
+        # step's ids yet)
+        if i == 0:
+            rows = [
+                list(r) + [int(t) for t in step_row]
+                for r, step_row in zip(self._id_rows, np.asarray(ids))
+            ]
+            if len({len(r) for r in rows}) != 1:
+                return
+            full_in = np.asarray(
+                self.embed_fn(np.asarray(rows, dtype=np.int64)),
+                dtype=np.float32,
+            )
+        else:
+            prev = outs[i - 1]
+            if prev is None:
+                return
+            full_in = np.concatenate(self._span_in[i] + [prev], axis=1)
+        self.audits_run += 1
+        aud_out = await self._remote_forward(
+            peers[0], span.start, span.end, full_in
+        )
+        if aud_out is None or aud_out.shape[1] < primary_out.shape[1]:
+            return  # auditor unavailable: not evidence against the primary
+        t_step = primary_out.shape[1]
+        aud_tail = np.ascontiguousarray(aud_out[:, -t_step:])
+        wire_dt = span.server_info.wire_dtype
+        if out_digest(aud_tail) == out_digest(
+            np.ascontiguousarray(primary_out)
+        ):
+            return  # bitwise agreement: cheap fast-path, nothing to judge
+        if tensors_close(aud_tail, primary_out, dtype=wire_dt):
+            return  # within tolerance: ulp drift, both honest
+        self.audit_mismatches += 1
+        third = self._find_covering(
+            span.start, span.end, {span.peer_id, peers[0].peer_id}
+        )
+        third_out = (
+            await self._remote_forward(
+                third[0], span.start, span.end, full_in
+            ) if third else None
+        )
+        if third_out is None or third_out.shape[1] < t_step:
+            # no quorum: suspicion (not conviction) strikes both sides
+            logger.warning(
+                "audit mismatch on span [%d,%d) with no tiebreak replica: "
+                "striking %s and %s", span.start, span.end, span.peer_id,
+                peers[0].peer_id,
+            )
+            self.manager.note_integrity_strike(span.peer_id)
+            self.manager.note_integrity_strike(peers[0].peer_id)
+            return
+        third_tail = np.ascontiguousarray(third_out[:, -t_step:])
+        agrees_primary = tensors_close(third_tail, primary_out, dtype=wire_dt)
+        agrees_auditor = tensors_close(third_tail, aud_tail, dtype=wire_dt)
+        if agrees_primary and not agrees_auditor:
+            logger.warning(
+                "audit tiebreak: auditor %s outvoted; quarantining it",
+                peers[0].peer_id,
+            )
+            self.manager.quarantine_peer(peers[0].peer_id)
+            return
+        if agrees_auditor and not agrees_primary:
+            # primary convicted: quarantine and re-execute the step on an
+            # honest chain (we ran before the commit, so history is clean)
+            self.manager.quarantine_peer(span.peer_id)
+            self.integrity_reroutes += 1
+            raise IntegrityError(
+                f"audit convicted span peer {span.peer_id} "
+                f"(outvoted 2-to-1 on blocks [{span.start},{span.end}))"
+            )
+        # three-way disagreement: something is deeply wrong, but there is
+        # no majority — strike everyone, convict no one
+        for pid in (span.peer_id, peers[0].peer_id, third[0].peer_id):
+            self.manager.note_integrity_strike(pid)
 
     async def _step_pruned(
         self, hidden, tree_mask, depths, prune, accept_per_span
@@ -674,6 +981,8 @@ class InferenceSession:
             self._raise_if_shed(resp_meta, span_sess.span.peer_id)
             compute_ms.append(resp_meta.get("t_compute_ms"))
             chunk = resp_tensors[0]
+            if self._gate is not None:
+                self._check_span_output(span_sess, resp_meta, chunk)
             if i == 0 and resp_meta.get("keep") is not None:
                 from bloombee_tpu.spec.tree import pruned_step_arrays
 
@@ -808,6 +1117,9 @@ class InferenceSession:
         out = np.zeros(hidden.shape, dtype=np.float32)
         got_tensor = False
         compute_ms = []
+        # per-span outputs this step (audit records): span i's tensor
+        # chunks land on span i's stream in both relay and push mode
+        span_outs: list = [None] * len(self._spans)
         for i, span_sess in enumerate(self._spans):
             span_ms = 0.0
             for _ in range(mb):
@@ -833,10 +1145,23 @@ class InferenceSession:
                     continue
                 lo, hi = resp_meta.get("rows") or (row_base, row_base + b)
                 chunk = resp_tensors[0]
+                if self._gate is not None:
+                    # inline integrity: digest + sanity gate BEFORE this
+                    # chunk enters `out` or gets forwarded to the next span
+                    self._check_span_output(span_sess, resp_meta, chunk)
                 out[lo - row_base:hi - row_base] = np.asarray(
                     chunk, dtype=np.float32
                 )
                 got_tensor = True
+                if self._gate is not None and self.audit_p > 0:
+                    buf = span_outs[i]
+                    if buf is None:
+                        buf = span_outs[i] = np.zeros(
+                            hidden.shape, dtype=np.float32
+                        )
+                    buf[lo - row_base:hi - row_base] = np.asarray(
+                        chunk, dtype=np.float32
+                    )
                 if not self.use_push and i + 1 < len(self._spans):
                     # relay mode: forward each chunk as it lands so the next
                     # span starts while this span computes the next chunk
@@ -852,6 +1177,7 @@ class InferenceSession:
                     )
             compute_ms.append(span_ms)
         assert got_tensor, "no span returned a tensor"
+        self._last_span_outs = span_outs
         self._note_spans_ok()
         total_ms = (time.perf_counter() - t_start) * 1000.0
         self.timings.append(
@@ -1028,6 +1354,7 @@ class InferenceSession:
             for i, row in enumerate(written):
                 self._id_rows[i].extend(int(t) for t in row)
             self.position += n
+            self._span_in = None  # server-side hops: no relay record
             await self._maybe_replicate()
             return toks
 
@@ -1185,6 +1512,7 @@ class InferenceSession:
         self.position -= n_drop
         # incremental chains cover tokens that no longer exist: rehash
         self._chains_by_ps.clear()
+        self._span_in = None  # relay records cover dropped tokens too
         self._needs_rebuild = True
 
     def record_history_ids(self, rows: list[list[int]]) -> None:
@@ -1198,6 +1526,8 @@ class InferenceSession:
             )
         for i, row in enumerate(rows):
             self._id_rows[i].extend(int(t) for t in row)
+        # committed via the speculative window: no relay input record
+        self._span_in = None
 
     # -------------------------------------------------------------- recovery
     async def _try_resume(self) -> bool:
@@ -1352,6 +1682,11 @@ class InferenceSession:
                 await sp.close()
             raise
         self._spans = spans
+        # the rebuilt chain may have different span boundaries and replays
+        # skip relay recording: spans > 0 lose auditability (span 0 keeps
+        # it — its input always re-embeds from the id history)
+        self._span_in = None
+        self._last_span_outs = []
         try:
             if self.embed_fn is not None and any(self._id_rows):
                 # token-id replay (ragged rows): right-pad to a rectangle,

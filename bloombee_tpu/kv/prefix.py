@@ -23,6 +23,27 @@ _CHAIN_VERSION = b"bbtpu-prefix-v1"
 # hidden-state sessions (no token ids) hash raw activations instead; a
 # distinct root guarantees a hidden chain can never alias an id chain
 _HIDDEN_VERSION = b"bbtpu-hidden-v1"
+# span-output digests (integrity layer): one-shot, not chained — each step's
+# output stands alone so a single corrupted reply can't invalidate the rest
+_DIGEST_VERSION = b"bbtpu-outdigest-v1"
+
+
+def out_digest(arr) -> str:
+    """blake2b hex digest over a span output's exact bytes.
+
+    Canonicalizes only layout (C-contiguous), never dtype: the digest
+    covers the bytes the server actually serialized, so the client can
+    recompute it over the received array and detect *in-flight* corruption
+    exactly. It is NOT a cross-replica equality check — honest replicas
+    differ in ulps (batch-width-dependent float reductions), so two
+    replicas' digests matching is a fast-path only; a mismatch must
+    escalate to a tolerance compare, never straight to a verdict."""
+    a = np.ascontiguousarray(arr)
+    h = hashlib.blake2b(_DIGEST_VERSION, digest_size=16)
+    h.update(str(a.dtype).encode("ascii"))
+    h.update(str(a.shape).encode("ascii"))
+    h.update(a.tobytes())
+    return h.hexdigest()
 
 
 def _extend_chain(

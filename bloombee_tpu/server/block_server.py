@@ -166,6 +166,20 @@ env.declare(
     "spans, top-k attention, sliding-window layers). Off = every "
     "tree-verify step dispatches solo, byte-for-byte",
 )
+env.declare(
+    "BBTPU_LIAR_P", float, 0.0,
+    "TEST HOOK (Byzantine fault injection): per-step probability this "
+    "server perturbs its span-output hidden states BEFORE serialization "
+    "— a well-formed reply carrying wrong numbers, the lie the client "
+    "integrity layer (BBTPU_INTEGRITY / BBTPU_AUDIT_P) exists to catch. "
+    "Seeded by BBTPU_LIAR_SEED for reproducible chaos runs; never enable "
+    "in real serving",
+)
+env.declare(
+    "BBTPU_LIAR_SEED", int, 0,
+    "seed for the BBTPU_LIAR_P perturbation RNG (which steps lie and "
+    "how), so integrity chaos/bench runs are reproducible",
+)
 
 
 class _ChainError(RuntimeError):
@@ -450,6 +464,16 @@ class BlockServer:
         # acting (None -> BBTPU_PROMOTE_SUSTAIN_S env)
         promote_jitter_s: float | None = None,  # storm-guard jitter bound
         # (None -> BBTPU_PROMOTE_JITTER_S env)
+        integrity: bool | None = None,  # stamp an out_digest (blake2b over
+        # the exact serialized span-output bytes) into every step reply and
+        # advertise it, so integrity-enabled clients get a deterministic
+        # in-flight-corruption check (None -> BBTPU_INTEGRITY env)
+        liar_p: float | None = None,  # TEST HOOK: per-step probability of
+        # perturbing span outputs before serialization — the Byzantine
+        # "liar" the client audits exist to convict (None -> BBTPU_LIAR_P
+        # env; never enable in real serving)
+        liar_seed: int | None = None,  # RNG seed for the liar hook
+        # (None -> BBTPU_LIAR_SEED env)
     ):
         self.model_dir = model_dir
         if weight_quant is None:
@@ -800,6 +824,30 @@ class BlockServer:
         self.steps_deduped = 0
         self.pushes_dropped = 0
         self._reaper_task: asyncio.Task | None = None
+        # integrity layer (server half): digest stamping + the liar test
+        # hook. seq_hash_extend_failures surfaces the previously
+        # debug-swallowed prefix-hash-chain extension errors (each one
+        # silently degrades shared-prefix reuse for later sessions)
+        self.integrity = (
+            bool(env.get("BBTPU_INTEGRITY"))
+            if integrity is None else bool(integrity)
+        )
+        self.liar_p = (
+            float(env.get("BBTPU_LIAR_P")) if liar_p is None
+            else float(liar_p)
+        )
+        self._liar_rng = random.Random(
+            env.get("BBTPU_LIAR_SEED") if liar_seed is None else liar_seed
+        )
+        if self.liar_p > 0:
+            logger.warning(
+                "BYZANTINE LIAR TEST HOOK ENABLED (liar_p=%.3g): this "
+                "server will return corrupted span outputs", self.liar_p,
+            )
+        self.out_digests_sent = 0
+        self.audit_forwards = 0
+        self.liar_steps = 0
+        self.seq_hash_extend_failures = 0
         self._kv_quant = kv_quant
         self._num_pages = num_pages
         self._adapter_dirs = adapter_dirs
@@ -1539,6 +1587,9 @@ class BlockServer:
             # pages; a draining server is about to leave the swarm and
             # must not attract fresh replication traffic
             kv_repl=self.manager.repl_supported and not self._draining,
+            # integrity-enabled clients verify our replies' out_digest
+            # stamps; old clients drop the field (from_wire filtering)
+            out_digest=self.integrity,
         )
 
     async def _announce(self, state: ServerState) -> None:
@@ -1742,6 +1793,16 @@ class BlockServer:
             "repl_pages_sent": self.repl_pages_sent,
             "repl_lag_pages": self._repl_lag(),
             "failover_replayed_tokens": self.failover_replayed_tokens,
+            # integrity observability: digest stamps emitted, audit
+            # re-executions served to verifying clients, liar-hook
+            # perturbations injected (test runs only), and prefix
+            # hash-chain extensions that failed (silent shared-prefix
+            # degradation until this surfaced it)
+            "integrity": self.integrity,
+            "out_digests_sent": self.out_digests_sent,
+            "audit_forwards": self.audit_forwards,
+            "liar_steps": self.liar_steps,
+            "seq_hash_extend_failures": self.seq_hash_extend_failures,
             # overload observability: shed/admit counters, retry_after
             # histogram, and per-client fair-share debt (None with the
             # admission controller off; the live load snapshot itself rides
@@ -1832,7 +1893,14 @@ class BlockServer:
         try:
             self.manager.extend_seq_hashes(session.handle, chains)
         except Exception as e:
-            logger.debug("extend_seq_hashes failed: %s", e)
+            # non-fatal (replication still runs on the client's chains) but
+            # NOT silent: each failure quietly degrades shared-prefix reuse
+            # for every later session, so surface it via rpc_info/--probe
+            self.seq_hash_extend_failures += 1
+            logger.warning(
+                "extend_seq_hashes failed (%d so far): %s",
+                self.seq_hash_extend_failures, e,
+            )
         task = asyncio.create_task(self._replicate_session(session))
         # step_tasks membership matters: the session loop gathers these
         # before the allocate context frees the pages a sweep is exporting
@@ -2406,6 +2474,28 @@ class BlockServer:
 
         return deadline is not None and _time.monotonic() > deadline
 
+    def _liar_perturb(self, out: np.ndarray) -> np.ndarray:
+        """TEST HOOK (liar_p): return a perturbed copy of a span output —
+        the Byzantine lie the client integrity layer exists to convict.
+        Deliberately LOUD (NaN poison / x64 scale / exponent bit-flip):
+        the point is exercising detection+quarantine end to end, not
+        probing the envelope's sensitivity floor."""
+        arr = np.array(out, copy=True)
+        if arr.size == 0:
+            return out
+        mode = ("nan", "scale", "bitflip")[self._liar_rng.randrange(3)]
+        flat = arr.reshape(-1)
+        idx = self._liar_rng.randrange(flat.size)
+        if mode == "nan":
+            flat[idx] = float("nan")
+        elif mode == "scale":
+            np.multiply(arr, arr.dtype.type(64), out=arr)
+        else:
+            view = flat.view(np.uint8)
+            byte = idx * arr.dtype.itemsize + (arr.dtype.itemsize - 1)
+            view[byte] ^= 0x40
+        return arr
+
     def _note_deadline_expired(self, meta: dict, where: str) -> None:
         self.deadlines_expired += 1
         logger.info(
@@ -2667,6 +2757,13 @@ class BlockServer:
         t0 = _time.perf_counter()
         out = await asyncio.to_thread(self.executor.fetch, out_dev)
         t_fetch_ms = (_time.perf_counter() - t0) * 1000.0
+        if self.liar_p > 0 and self._liar_rng.random() < self.liar_p:
+            # TEST HOOK: lie BEFORE the digest/serialization below, so the
+            # reply is a well-formed frame whose digest matches the lie —
+            # only the client's sanity gate / cross-replica audits can
+            # catch it (exactly the threat model they exist for)
+            out = self._liar_perturb(out)
+            self.liar_steps += 1
         t_compute_ms = t_dispatch_ms + t_fetch_ms
         timing_meta = {
             "t_compute_ms": t_compute_ms,
@@ -2765,6 +2862,14 @@ class BlockServer:
                     resp[key] = meta[key]
             if keep is not None:
                 resp["keep"] = keep.tolist()
+            if self.integrity:
+                # digest over the exact array we serialize next: integrity
+                # clients recompute it on the deserialized chunk, so ANY
+                # in-flight byte corruption is caught deterministically
+                from bloombee_tpu.kv.prefix import out_digest
+
+                resp["out_digest"] = out_digest(out)
+                self.out_digests_sent += 1
             # record-then-send: the KV commit already happened at dispatch,
             # so this reply is the step's only at-most-once fence
             self._record_reply(session, meta, resp, [out])
@@ -4374,6 +4479,10 @@ class BlockServer:
     async def _rpc_forward(self, meta: dict, tensors):
         """Span forward without a session (training / one-shot),
         reference block_functions.py:247 run_rpc_forward."""
+        if meta.get("audit"):
+            # an integrity client re-executing another replica's recorded
+            # step through us; count it so operators can see audit load
+            self.audit_forwards += 1
         if self.training is None:
             raise RuntimeError("training path unavailable for this family")
         hidden = np.asarray(tensors[0], dtype=np.float32)
@@ -4387,6 +4496,12 @@ class BlockServer:
             PRIORITY_TRAINING, self.training.forward, hidden, layers, prompts,
             meta.get("adapter"),
         )
+        if self.liar_p > 0 and self._liar_rng.random() < self.liar_p:
+            # TEST HOOK: a Byzantine server lies on every plane — including
+            # when another client drafts it as an audit replica (a lying
+            # auditor must get outvoted by the tiebreak, not trusted)
+            out = self._liar_perturb(out)
+            self.liar_steps += 1
         return {"ok": True}, [out]
 
     async def _rpc_backward(self, meta: dict, tensors):

@@ -73,6 +73,12 @@ class ServerInfo:
     # the span's primary server never demotes. Old peers drop the field on
     # the wire (from_wire filtering); default False = primary.
     promoted_standby: bool = False
+    # True when this server stamps an out_digest (blake2b over the exact
+    # span-output bytes it serialized) into every step reply — the
+    # integrity layer's cheap in-flight-corruption fast path. Old peers
+    # drop the field via from_wire filtering and default False, so clients
+    # simply skip digest checks against them (audits still work).
+    out_digest: bool = False
 
     def to_wire(self) -> dict:
         d = dataclasses.asdict(self)

@@ -17,6 +17,12 @@ Actions:
 - ``stall``  — on read: swallow the frame and never deliver it (wedged peer);
   on send: sleep until the connection dies (stalled writer)
 - ``drop``   — on read: silently discard the frame (lost packet)
+- ``corrupt`` — on send: perturb the first float tensor payload in-flight
+  (seeded pick of NaN-poison, large scale, or an exponent bit-flip), then
+  re-serialize so the frame stays *well-formed* — header, sizes and codec
+  all valid, only the numbers are wrong. Unlike every omission action
+  above, nothing at the transport layer ever notices; only the integrity
+  layer (client sanity gate / out_digest / audits) can.
 - ``partition`` — once triggered, blackhole the connection in BOTH
   directions forever: every later send is silently discarded before the
   wire and every later read is swallowed, with no FIN/RST ever delivered.
@@ -36,6 +42,9 @@ import dataclasses
 import logging
 import random
 from typing import Callable, Optional
+
+import ml_dtypes
+import numpy as np
 
 from bloombee_tpu.utils import env
 
@@ -72,6 +81,12 @@ env.declare(
     "per-frame probability of partitioning the connection: a permanent "
     "both-direction blackhole with no FIN/RST (detected only by keepalives)",
 )
+env.declare(
+    "BBTPU_CHAOS_CORRUPT_P", float, 0.0,
+    "per-frame probability of corrupting a span-output reply tensor "
+    "in-flight (well-formed frame, wrong numbers); only the integrity "
+    "layer can detect it, so pair with BBTPU_INTEGRITY=1",
+)
 
 
 class InjectedFault(ConnectionResetError):
@@ -87,7 +102,8 @@ class FaultRule:
     following ``count - 1`` matches (count=0 -> every match from nth on)."""
 
     site: str  # "send" | "read"
-    action: str  # "delay" | "reset" | "close" | "stall" | "drop" | "partition"
+    # "delay" | "reset" | "close" | "stall" | "drop" | "partition" | "corrupt"
+    action: str
     method: str | None = None  # frame's "m" (rpc method) or "t" (frame type)
     port: int | None = None  # remote peer port (targets one server)
     nth: int = 1
@@ -141,10 +157,13 @@ class FaultPlan:
                 return rule
         return None
 
-    async def on_send(self, conn, header: dict) -> str | None:
+    async def on_send(self, conn, header: dict,
+                      blobs: list[bytes] | None = None) -> str | None:
         """Consulted by Connection._send before the frame hits the wire.
-        May sleep, raise InjectedFault after aborting the transport, or
-        return "drop" to silently discard the frame (partition)."""
+        May sleep, raise InjectedFault after aborting the transport,
+        mutate ``header``/``blobs`` in place (corrupt — the caller encodes
+        the frame afterwards, so sizes are recomputed), or return "drop"
+        to silently discard the frame (partition)."""
         if getattr(conn, "_bbtpu_partitioned", False):
             return "drop"
         rule = self._pick("send", conn.peer, header)
@@ -154,6 +173,9 @@ class FaultPlan:
         if rule.action == "partition":
             self._partition(conn)
             return "drop"
+        if rule.action == "corrupt":
+            self._corrupt(header, blobs)
+            return None
         await self._apply(conn, rule, header)
         return None
 
@@ -199,6 +221,47 @@ class FaultPlan:
             )
             await self._kill(conn, abort=rule.action == "reset")
             raise InjectedFault(f"injected connection {rule.action}")
+
+    def _corrupt(self, header: dict, blobs: list | None) -> None:
+        """Byzantine payload corruption: decode the first float tensor in
+        the frame, perturb it with a seeded pick of NaN-poison / ×64 scale
+        / exponent bit-flip, and re-serialize. The frame stays well-formed
+        (valid header, codec, sizes) — only the numbers lie. Non-float or
+        tensor-less frames are left untouched (corrupting int token ids
+        would be undetectable by activation checks and is a different
+        failure class)."""
+        tms = header.get("tm") or []
+        if not tms or not blobs:
+            return
+        from bloombee_tpu.wire import tensor_codec
+
+        try:
+            meta = tensor_codec.TensorMeta.from_wire(tms[0])
+            arr = tensor_codec.deserialize_tensor(meta, blobs[0]).copy()
+        except Exception:  # pragma: no cover - malformed frames ship as-is
+            return
+        is_float = np.issubdtype(np.dtype(arr.dtype), np.floating) or (
+            np.dtype(arr.dtype) == np.dtype(ml_dtypes.bfloat16)
+        )
+        if arr.size == 0 or not is_float:
+            return
+        mode = ("nan", "scale", "bitflip")[self.rng.randrange(3)]
+        flat = arr.reshape(-1)
+        idx = self.rng.randrange(flat.size)
+        if mode == "nan":
+            flat[idx] = float("nan")
+        elif mode == "scale":
+            np.multiply(arr, arr.dtype.type(64), out=arr)
+        else:
+            # flip the top exponent bit of one element via its raw bytes —
+            # the classic single-bit memory fault
+            view = flat.view(np.uint8)
+            byte = idx * arr.dtype.itemsize + (arr.dtype.itemsize - 1)
+            view[byte] ^= 0x40
+        m, b = tensor_codec.serialize_tensor(arr, compression=True)
+        tms[0] = m.to_wire()
+        blobs[0] = b
+        header["tm"] = tms
 
     @staticmethod
     def _partition(conn) -> None:
@@ -246,7 +309,26 @@ class FaultPlan:
             plan.add(FaultRule(
                 site="send", action="partition", prob=partition_p,
             ))
+        corrupt_p = env.get("BBTPU_CHAOS_CORRUPT_P")
+        if corrupt_p > 0:
+            # only span-output step replies ("sitem" frames whose meta
+            # carries compute timing) are corrupted: a process-wide plan is
+            # shared by in-proc client AND servers, and corrupting a
+            # client->server prefill frame would poison server KV in a way
+            # no client-side check can see (the lie becomes the ground
+            # truth both replicas agree on)
+            plan.add(FaultRule(
+                site="send", action="corrupt", method="sitem",
+                prob=corrupt_p, predicate=_is_span_output_reply,
+            ))
         return plan
+
+
+def _is_span_output_reply(header: dict) -> bool:
+    """True for stream items that carry a span-output tensor (step replies
+    stamp t_compute_ms into their meta; acks and client-side frames don't)."""
+    meta = header.get("meta") or {}
+    return bool(header.get("tm")) and "t_compute_ms" in meta
 
 
 _active_plan: FaultPlan | None = None

@@ -317,9 +317,11 @@ class Connection:
     async def _send(self, header: dict, blobs: list[bytes]) -> None:
         if self.fault_plan is not None:
             # may sleep (delayed frame), raise after killing the transport
-            # (injected reset / mid-stream close / stalled write), or ask
-            # for a silent discard (injected partition blackhole)
-            if await self.fault_plan.on_send(self, header) == "drop":
+            # (injected reset / mid-stream close / stalled write), mutate
+            # header+blobs in place (injected payload corruption — the
+            # frame below is encoded from the mutated pair), or ask for a
+            # silent discard (injected partition blackhole)
+            if await self.fault_plan.on_send(self, header, blobs) == "drop":
                 return
         frame = _encode_frame(header, blobs)
         async with self._send_lock:

@@ -1,26 +1,29 @@
 #!/usr/bin/env bash
 # Chaos gate: replay the chaos-marked suite under a fixed seed matrix of
-# ambient wire faults (the BBTPU_CHAOS_* env plan). Each entry is
-# "SEED:DELAY_P:ADMIT:PARTITION_P:MIXED:SPEC:REBALANCE" — mild delay-only ambient
-# chaos, so
-# the per-test seeded FaultPlans stay the dominant fault source while
-# connections opened before a test installs its plan still see injected
-# jitter; the ADMIT flag additionally turns on server admission control
-# (BBTPU_ADMIT, low high-watermark) so the overload scenario exercises
-# shed-and-reroute recovery paths under the same ambient jitter; a
-# nonzero PARTITION_P silently blackholes connections mid-flight (no
-# FIN/RST), so keepalive half-open detection plus lease park/resume are
-# what keep the suite green (keepalive is forced small for that entry);
-# MIXED=1 turns on mixed-batch dispatch (BBTPU_MIXED_BATCH) so the fused
-# decode+prefill path and its solo-replay failure recovery run under the
-# same ambient jitter; SPEC=1 turns on batched tree-speculative
-# verification (BBTPU_SPEC_BATCH) so grouped tree-verify dispatches and
-# their rollback-then-solo-replay recovery run under ambient jitter too;
-# REBALANCE=1 turns on the elastic self-healing control loop — measured-
-# load rebalancing (BBTPU_MEASURED_REBALANCE) plus fast standby-promotion
-# watermarks (BBTPU_PROMOTE_*) — so promotion/demotion decisions and the
-# rebalance supervisor run against the same flaky-registry + wire jitter
-# the chaos plans inject.
+# ambient wire faults (the BBTPU_CHAOS_* env plan). Each matrix entry is a
+# space-separated list of KEY=VAL tokens; anything unset takes the default
+# below, so entries name ONLY what they vary (the old positional
+# "SEED:DELAY_P:ADMIT:..." strings needed every column on every entry and
+# silently misassigned values when a column was added).
+#
+# Keys:
+#   SEED         chaos RNG seed (replays are bit-for-bit per seed)
+#   DELAY_P      per-frame send-delay probability (mild ambient jitter, so
+#                the per-test seeded FaultPlans stay the dominant source)
+#   ADMIT        1 = server admission control (BBTPU_ADMIT, low watermark)
+#                so overload shed-and-reroute runs under the same jitter
+#   PARTITION_P  silent both-way blackhole probability (no FIN/RST);
+#                keepalive is forced small so half-open detection + lease
+#                park/resume are the recovery under test
+#   MIXED        1 = mixed-batch dispatch (BBTPU_MIXED_BATCH)
+#   SPEC         1 = batched tree-speculative verification (BBTPU_SPEC_BATCH)
+#   REBALANCE    1 = elastic control loop (measured-load rebalance + fast
+#                promotion watermarks)
+#   CORRUPT      per-frame probability of corrupting a span-output reply
+#                tensor in-flight (well-formed frame, wrong numbers).
+#                Forces BBTPU_INTEGRITY=1: only the client integrity layer
+#                (out_digest + sanity gate) can see this fault class, and
+#                the suite must stay green + token-identical through it
 # Fixed seeds keep every run replayable bit-for-bit (wire/faults.py
 # contract).
 # Exits 0 when pytest is unavailable (mirrors scripts/lint.sh).
@@ -32,45 +35,66 @@ if ! python -c "import pytest" >/dev/null 2>&1; then
     exit 0
 fi
 
-MATRIX=("11:0.05:0:0:0:0:0" "23:0.1:0:0:0:0:0" "31:0.05:1:0:0:0:0"
-        "43:0.02:0:0.02:0:0:0" "57:0.05:0:0:1:0:0" "71:0.05:0:0:0:1:0"
-        "83:0.05:0:0:0:0:1")
+# Each entry replays the whole chaos-marked suite (~50s), so the matrix
+# is budgeted: independent feature flags share an entry instead of each
+# getting their own, keeping the tier-1 gate inside its wall-clock cap
+# while every flag still runs under ambient chaos.
+MATRIX=(
+    "SEED=23 DELAY_P=0.1"
+    "SEED=43 DELAY_P=0.02 PARTITION_P=0.02"
+    "SEED=57 DELAY_P=0.05 MIXED=1 SPEC=1"
+    "SEED=83 DELAY_P=0.05 ADMIT=1 REBALANCE=1"
+    "SEED=97 DELAY_P=0.02 CORRUPT=0.05"
+)
 for entry in "${MATRIX[@]}"; do
-    IFS=: read -r seed delay_p admit partition_p mixed spec rebalance <<<"${entry}"
-    partition_p="${partition_p:-0}"
-    mixed="${mixed:-0}"
-    spec="${spec:-0}"
-    rebalance="${rebalance:-0}"
+    # per-entry defaults; each entry overrides only what it varies
+    SEED=0 DELAY_P=0 ADMIT=0 PARTITION_P=0 MIXED=0 SPEC=0 REBALANCE=0
+    CORRUPT=0
+    for tok in ${entry}; do
+        case "${tok%%=*}" in
+            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT)
+                declare "${tok}" ;;
+            *)
+                echo "chaos: unknown matrix token '${tok}'" >&2
+                exit 1 ;;
+        esac
+    done
     # partitioned conns go silent instead of erroring: a small keepalive
     # turns the blackhole into a prompt local abort so lease park/resume
     # (not a step_timeout expiry) is the recovery path under test
     keepalive_s=0
-    if [ "${partition_p}" != "0" ]; then
+    if [ "${PARTITION_P}" != "0" ]; then
         keepalive_s=0.5
     fi
     # the rebalance entry runs with hair-trigger promotion watermarks so
     # the standby control loop actually fires inside short chaos tests
     promote_high_ms=1500
     promote_sustain_s=10
-    if [ "${rebalance}" != "0" ]; then
+    if [ "${REBALANCE}" != "0" ]; then
         promote_high_ms=500
         promote_sustain_s=0.3
     fi
-    echo "chaos: seed=${seed} delay_p=${delay_p} admit=${admit}" \
-         "partition_p=${partition_p} mixed=${mixed} spec=${spec}" \
-         "rebalance=${rebalance}" >&2
+    # in-flight corruption is invisible to the transport; the integrity
+    # layer (server digest stamps + client gate) must be on to catch it
+    integrity=0
+    if [ "${CORRUPT}" != "0" ]; then
+        integrity=1
+    fi
+    echo "chaos: ${entry}" >&2
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     BBTPU_CHAOS=1 \
-    BBTPU_CHAOS_SEED="${seed}" \
-    BBTPU_CHAOS_DELAY_P="${delay_p}" \
+    BBTPU_CHAOS_SEED="${SEED}" \
+    BBTPU_CHAOS_DELAY_P="${DELAY_P}" \
     BBTPU_CHAOS_DELAY_S=0.02 \
-    BBTPU_CHAOS_PARTITION_P="${partition_p}" \
+    BBTPU_CHAOS_PARTITION_P="${PARTITION_P}" \
+    BBTPU_CHAOS_CORRUPT_P="${CORRUPT}" \
+    BBTPU_INTEGRITY="${integrity}" \
     BBTPU_KEEPALIVE_S="${keepalive_s}" \
-    BBTPU_ADMIT="${admit}" \
+    BBTPU_ADMIT="${ADMIT}" \
     BBTPU_ADMIT_HIGH_MS=400 \
-    BBTPU_MIXED_BATCH="${mixed}" \
-    BBTPU_SPEC_BATCH="${spec}" \
-    BBTPU_MEASURED_REBALANCE="${rebalance}" \
+    BBTPU_MIXED_BATCH="${MIXED}" \
+    BBTPU_SPEC_BATCH="${SPEC}" \
+    BBTPU_MEASURED_REBALANCE="${REBALANCE}" \
     BBTPU_PROMOTE_HIGH_MS="${promote_high_ms}" \
     BBTPU_PROMOTE_SUSTAIN_S="${promote_sustain_s}" \
     python -m pytest tests/ -q -m chaos \

@@ -271,6 +271,46 @@ def test_bb006_ignores_non_server_code():
     assert codes(BB006_TP, path=CLIENT) == []
 
 
+# ------------------------------------------------------------------ BB007
+BB007_TP = """
+    import numpy as np
+
+    def audit(primary_out, audited_hidden):
+        if np.array_equal(primary_out, audited_hidden):
+            return True
+        return audited_hidden == primary_out
+"""
+
+BB007_TN = """
+    import numpy as np
+
+    def audit(primary_out, audited_hidden, expected_digest, tokens):
+        ok = tensors_close(primary_out, audited_hidden)
+        same_geom = primary_out.shape == audited_hidden.shape
+        byte_check = out_digest(primary_out) == expected_digest
+        toks = tokens == [1, 2, 3]
+        return ok and same_geom and byte_check and toks
+"""
+
+
+def test_bb007_true_positive():
+    # both the helper-call form and the bare `==` on two hidden-state
+    # expressions are exact compares that convict honest ulp drift
+    assert codes(BB007_TP, path=CLIENT) == ["BB007", "BB007"]
+
+
+def test_bb007_true_negative():
+    # tolerance compare, shape compare, byte-digest compare over the SAME
+    # serialized array, and token-id compare are all legitimate
+    assert codes(BB007_TN, path=CLIENT) == []
+
+
+def test_bb007_scoped_to_client_server_paths():
+    # test helpers asserting exactness on purpose live outside the
+    # verification paths and stay quiet
+    assert codes(BB007_TP, path="bloombee_tpu/kv/mod.py") == []
+
+
 # ------------------------------------------------- suppressions & baseline
 def test_noqa_suppresses_named_code():
     src = 'import os\nX = os.getenv("BBTPU_X")  # bbtpu: noqa[BB005]\n'

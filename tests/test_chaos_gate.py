@@ -8,9 +8,37 @@ when pytest is unavailable, mirroring the scripts/lint.sh contract.
 """
 
 import pathlib
+import re
 import subprocess
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_matrix_entries_are_keyval_tokens():
+    """The matrix format is KEY=VAL tokens with per-entry defaults — not
+    the old positional colon strings, which silently misassigned every
+    column to the right of an insertion. Also pins that the Byzantine
+    corruption entry exists and forces the integrity layer on (corruption
+    is invisible to the transport; without BBTPU_INTEGRITY=1 the entry
+    would test nothing)."""
+    src = (REPO / "scripts" / "chaos.sh").read_text()
+    entries = re.findall(r'^\s+"([^"]+)"$', src, flags=re.M)
+    assert len(entries) >= 5, f"matrix lost entries: {entries}"
+    known = {
+        "SEED", "DELAY_P", "ADMIT", "PARTITION_P", "MIXED", "SPEC",
+        "REBALANCE", "CORRUPT",
+    }
+    for entry in entries:
+        for tok in entry.split():
+            key, sep, val = tok.partition("=")
+            assert sep == "=" and key in known and val, (
+                f"matrix entry {entry!r} has non-KEY=VAL token {tok!r}"
+            )
+    assert any("CORRUPT=" in e for e in entries), (
+        "no Byzantine corruption entry in the chaos matrix"
+    )
+    assert 'BBTPU_INTEGRITY="${integrity}"' in src
+    assert 'BBTPU_CHAOS_CORRUPT_P="${CORRUPT}"' in src
 
 
 def test_chaos_suite_under_seed_matrix():

@@ -1,0 +1,536 @@
+"""Byzantine-robust serving (ISSUE 12): corruption fault injection,
+inline sanity gate, cross-replica activation audits, peer quarantine.
+
+Petals names the threat this layer closes: in a public swarm a peer may
+return INCORRECT outputs — maliciously or via broken hardware — and the
+client would feed them straight into the next span. The correctness bar
+here: a seeded liar server is detected and quarantined mid-decode while
+the final generation stays token-identical to HF greedy (every lie is
+caught BEFORE its token commits), and an honest swarm with every check
+forced on produces ZERO rejects/mismatches (no false positives — exact
+compares would convict honest ulp drift, hence bbtpu-lint BB007).
+"""
+
+import asyncio
+import random
+import time
+import types
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bloombee_tpu.client.config import ClientConfig
+from bloombee_tpu.client.integrity import SanityGate, tensors_close
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+from bloombee_tpu.kv.prefix import out_digest
+from bloombee_tpu.server.block_server import BlockServer
+from bloombee_tpu.swarm.data import RemoteSpanInfo, ServerInfo
+from bloombee_tpu.wire import faults, tensor_codec
+from bloombee_tpu.wire.faults import (
+    FaultPlan,
+    FaultRule,
+    _is_span_output_reply,
+)
+from bloombee_tpu.wire.rpc import connect
+from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=3,
+        vocab_size=128,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = tmp_path_factory.mktemp("tiny_llama_integ")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model, config
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_plan():
+    yield
+    faults.set_plan(None)
+
+
+def _server(model_dir, registry, start, end, **kw):
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prefix_cache", True)
+    return BlockServer(
+        model_uid="tiny", start=start, end=end, model_dir=model_dir,
+        registry=registry, **kw,
+    )
+
+
+def _hf_greedy(model, input_ids, max_new_tokens):
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor(input_ids), max_new_tokens=max_new_tokens,
+            do_sample=False, use_cache=True,
+        )
+    return out.numpy()
+
+
+# ------------------------------------------------- corrupt wire action
+def _span_output_frame(arr):
+    """A frame shaped like a server step reply: "sitem" with tensor metas
+    and compute timing in the meta (the corrupt rule's predicate)."""
+    m, b = tensor_codec.serialize_tensor(arr, compression=True)
+    header = {
+        "t": "sitem", "id": 7,
+        "meta": {"t_compute_ms": 1.0},
+        "tm": [m.to_wire()],
+    }
+    return header, [b]
+
+
+def _decode_frame(header, blobs):
+    meta = tensor_codec.TensorMeta.from_wire(header["tm"][0])
+    return tensor_codec.deserialize_tensor(meta, blobs[0])
+
+
+def _conn():
+    return types.SimpleNamespace(peer=("127.0.0.1", 7000))
+
+
+def _corrupt_plan(seed, prob=None):
+    return FaultPlan(
+        [FaultRule(site="send", action="corrupt", method="sitem",
+                   prob=prob, count=0,
+                   predicate=_is_span_output_reply)],
+        seed=seed,
+    )
+
+
+def test_corrupt_keeps_frame_well_formed_and_is_seeded():
+    rng = np.random.default_rng(0)
+    arr = (rng.standard_normal((1, 4, 16)) * 0.02).astype(np.float32)
+
+    def corrupted(seed):
+        header, blobs = _span_output_frame(arr)
+        plan = _corrupt_plan(seed)
+        asyncio.run(plan.on_send(_conn(), header, blobs))
+        assert plan.log and plan.log[0][1] == "corrupt"
+        return header, blobs
+
+    h1, b1 = corrupted(5)
+    # the frame is still WELL-FORMED: valid meta, decodable payload, same
+    # geometry — only the numbers changed (detectable solely by the
+    # integrity layer, never by the transport)
+    out = _decode_frame(h1, b1)
+    assert out.shape == arr.shape and out.dtype == arr.dtype
+    assert not np.array_equal(
+        np.nan_to_num(np.asarray(out)), arr
+    ) or not np.isfinite(np.asarray(out)).all()
+    # and the digest of the corrupted bytes no longer matches the
+    # original's — exactly what the client-side out_digest check sees
+    assert out_digest(np.asarray(out)) != out_digest(arr)
+
+    # seeded replay: same seed -> bit-identical corruption; different
+    # seed -> a different lie
+    h2, b2 = corrupted(5)
+    assert b2 == b1 and h2["tm"] == h1["tm"]
+    h3, b3 = corrupted(6)
+    assert b3 != b1
+
+
+def test_corrupt_leaves_nonfloat_and_foreign_frames_alone():
+    ids = np.arange(12, dtype=np.int32).reshape(1, 12)
+    header, blobs = _span_output_frame(ids)
+    before = (dict(header), list(header["tm"]), list(blobs))
+    plan = _corrupt_plan(1)
+    asyncio.run(plan.on_send(_conn(), header, blobs))
+    # int tensors ship untouched (corrupting token ids is a different,
+    # activation-invisible failure class)
+    assert blobs == before[2] and header["tm"] == before[1]
+
+    # frames that are NOT span-output replies (no compute timing in the
+    # meta: acks, client->server sends) never match the predicate — a
+    # process-wide chaos plan must not poison server-side KV via prefill
+    m, b = tensor_codec.serialize_tensor(
+        np.ones((1, 2, 4), np.float32), compression=True
+    )
+    client_send = {"t": "sitem", "id": 1, "meta": {}, "tm": [m.to_wire()]}
+    plan2 = _corrupt_plan(1)
+    asyncio.run(plan2.on_send(_conn(), client_send, [b]))
+    assert not plan2.log
+
+
+def test_chaos_env_builds_corrupt_rule(monkeypatch):
+    monkeypatch.setenv("BBTPU_CHAOS", "1")
+    monkeypatch.setenv("BBTPU_CHAOS_CORRUPT_P", "0.25")
+    plan = FaultPlan.from_env()
+    assert plan is not None
+    (rule,) = [r for r in plan.rules if r.action == "corrupt"]
+    assert rule.site == "send" and rule.method == "sitem"
+    assert rule.prob == 0.25
+    assert rule.predicate is _is_span_output_reply
+
+
+# ------------------------------------------------------------ sanity gate
+def test_sanity_gate_envelope_and_nonfinite():
+    rng = np.random.default_rng(3)
+    g = SanityGate(margin=4.0, warmup=3)
+    key = (0, 3)
+    base = (rng.standard_normal((1, 1, 64)) * 0.02).astype(np.float32)
+    for _ in range(4):
+        assert g.check(key, base) is None
+    # honest drift well inside the margin is accepted...
+    assert g.check(key, base * 1.5) is None
+    # ...and updates the envelope; the x64 lie does not
+    reason = g.check(key, base * 64)
+    assert reason is not None and "rms-envelope" in reason
+    # a rejected output must NOT stretch the envelope for the next lie
+    assert g.check(key, base * 16) is not None
+    # NaN poison is caught regardless of magnitude or warmup
+    poisoned = base.copy()
+    poisoned[0, 0, 5] = np.nan
+    assert g.check(key, poisoned) == "nonfinite"
+    assert g.check((1, 2), poisoned) == "nonfinite"  # fresh key too
+
+
+def test_sanity_gate_warmup_accepts_unconditionally():
+    g = SanityGate(margin=4.0, warmup=3)
+    # first `warmup` observations establish the envelope, whatever their
+    # scale — prefill activations legitimately dwarf decode ones
+    big = np.full((1, 1, 8), 100.0, np.float32)
+    small = np.full((1, 1, 8), 0.01, np.float32)
+    assert g.check((0, 1), big) is None
+    assert g.check((0, 1), small) is None
+    assert g.check((0, 1), big) is None
+    # post-warmup, the envelope (max accepted RMS = 100) holds: 3.9x is
+    # inside the 4x margin and, once ACCEPTED, stretches the envelope —
+    # so the next lie must clear 4 x 390, not 4 x 100
+    assert g.check((0, 1), big * 3.9) is None
+    assert g.check((0, 1), big * 20) is not None
+
+
+# ------------------------------------------------------------ tolerance
+def test_tensors_close_is_dtype_aware_never_exact():
+    rng = np.random.default_rng(4)
+    a = (rng.standard_normal((1, 2, 32))).astype(np.float32)
+    # ulp-scale drift (what honest replicas produce: float reductions are
+    # batch-width dependent) passes at every wire dtype
+    drift = a * (1 + 1e-3)
+    assert tensors_close(a, drift, dtype="f32")
+    assert tensors_close(a, a + 0.05 * np.abs(a), dtype="bf16")
+    # lies don't
+    assert not tensors_close(a, a * 64, dtype="bf16")
+    nanned = a.copy()
+    nanned[0, 0, 0] = np.nan
+    assert not tensors_close(a, nanned, dtype="f32")
+    # geometry mismatch is an automatic fail, never a crash
+    assert not tensors_close(a, a[:, :1], dtype="f32")
+    # f32 is tighter than bf16: 5% drift passes bf16, fails f32
+    noisy = a * 1.05
+    assert tensors_close(a, noisy, dtype="bf16")
+    assert not tensors_close(a, noisy, dtype="f32")
+
+
+def test_out_digest_binds_dtype_shape_and_bytes():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    assert out_digest(a) == out_digest(a.copy())
+    assert out_digest(a) != out_digest(a.reshape(4, 3))
+    assert out_digest(a) != out_digest(a.astype(np.float64))
+    b = a.copy()
+    b[0, 0] += 1e-7
+    assert out_digest(a) != out_digest(b)
+
+
+# --------------------------------------------------- quarantine machinery
+def _span(peer_id, start, end, **info_kw):
+    info_kw.setdefault("host", "127.0.0.1")
+    info_kw.setdefault("port", 7000 + hash(peer_id) % 100)
+    info_kw.setdefault("throughput", 10.0)
+    return RemoteSpanInfo(
+        peer_id, start, end,
+        ServerInfo(start_block=start, end_block=end, **info_kw),
+    )
+
+
+def _manager(num_blocks=2, **kw):
+    kw.setdefault("quarantine_timeout", 0.2)
+    kw.setdefault("quarantine_max", 1.0)
+    kw.setdefault("rng", random.Random(0))
+    return RemoteSequenceManager(None, "uid", num_blocks, **kw)
+
+
+def test_strikes_accumulate_to_quarantine_and_never_decay():
+    m = _manager()
+    m.spans = {"a": _span("a", 0, 2), "b": _span("b", 0, 2)}
+    assert not m.note_integrity_strike("a")
+    assert "a" not in m._quarantine
+    # ordinary successes do NOT clear integrity strikes (a lie is
+    # evidence of Byzantine behavior, not a transient fault)...
+    m.note_peer_ok("a")
+    assert m._integrity_strikes["a"] == 1
+    # ...so the second strike convicts, however many successes separated
+    # the two lies
+    assert m.note_integrity_strike("a")
+    assert "a" in m._quarantine
+    assert m.peers_quarantined == 1
+    for _ in range(5):
+        assert [s.peer_id for s in m.make_sequence()] == ["b"]
+
+
+def test_quarantined_peer_excluded_from_standby_pool():
+    m = _manager()
+    primary = _span("primary", 0, 2, kv_repl=True, page_size=4)
+    fast = _span("fast", 0, 2, kv_repl=True, page_size=4,
+                 inference_rps=100.0, throughput=100.0)
+    slow = _span("slow", 0, 2, kv_repl=True, page_size=4,
+                 inference_rps=1.0, throughput=1.0)
+    m.spans = {s.peer_id: s for s in (primary, fast, slow)}
+    assert m.pick_standby(primary).peer_id == "fast"
+    m.quarantine_peer("fast")
+    # a lying peer must never receive replicated KV, however attractive
+    # its throughput advert
+    assert m.pick_standby(primary).peer_id == "slow"
+    m.quarantine_peer("slow")
+    assert m.pick_standby(primary) is None
+
+
+def test_quarantine_readmission_keeps_escalation_history():
+    m = _manager(quarantine_timeout=0.05, quarantine_max=10.0)
+    m.quarantine_peer("a")
+    first = m._quarantine["a"].banned_until - time.monotonic()
+    assert 0.05 * 0.75 <= first <= 0.05 * 1.25 + 0.01
+    assert m._integrity_excludes("a", time.monotonic())
+    time.sleep(0.08)
+    # expiry admits exactly one half-open probe; other routes still avoid
+    now = time.monotonic()
+    assert not m._integrity_excludes("a", now)
+    assert m._integrity_excludes("a", now)
+    # the probe succeeds -> readmitted, but the conviction count survives
+    m.note_peer_ok("a")
+    assert "a" not in m._quarantine
+    assert m._quarantine_history["a"] == 1
+    # conviction had reset the sanity strikes: fresh evidence re-convicts
+    assert "a" not in m._integrity_strikes
+    m.quarantine_peer("a")
+    st = m._quarantine["a"]
+    assert st.strikes == 2  # restored from history, then escalated
+    backoff = st.banned_until - time.monotonic()
+    assert backoff >= 0.05 * 2 * 0.74  # doubled base, not from scratch
+
+
+def test_quarantine_outlives_fault_ban_class():
+    """Quarantine is the LONGEST penalty class: with identical strike
+    counts a quarantined peer stays excluded long after a fault-banned
+    peer has been re-admitted."""
+    m = _manager(ban_timeout=0.05, ban_max=0.05,
+                 quarantine_timeout=5.0, quarantine_max=10.0)
+    m.ban_peer("crashed")
+    m.quarantine_peer("liar")
+    time.sleep(0.08)
+    now = time.monotonic()
+    assert not m._ban_excludes("crashed", now)
+    assert m._integrity_excludes("liar", now)
+
+
+# ------------------------------------------------------------------- e2e
+async def _greedy_decode(model, session, out, n, dtype=np.int64):
+    new = np.zeros((out.shape[0], 0), dtype=dtype)
+    for _ in range(n):
+        logits = model.logits(out[:, -1:])[:, 0]
+        nxt = np.argmax(logits, axis=-1).astype(dtype)[:, None]
+        new = np.concatenate([new, nxt], axis=1)
+        out = await session.step(model.embed(nxt), ids=nxt)
+    return new, out
+
+
+def test_liar_server_is_quarantined_and_decode_stays_token_identical(
+    tiny_model_dir,
+):
+    """Three whole-model replicas, one a seeded liar advertising the best
+    throughput (so routing picks it first — the worst case). With the
+    integrity layer + audit_p=1.0 on, the liar must land in quarantine
+    and the full generation must match HF greedy token-for-token: every
+    lie is caught BEFORE its token commits, so recovery replays from
+    clean history."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        liar = _server(
+            model_dir, rc(), 0, 3, throughput=100.0, integrity=True,
+            liar_p=1.0, liar_seed=7,
+        )
+        honest = [
+            _server(model_dir, rc(), 0, 3, throughput=1.0, integrity=True)
+            for _ in range(2)
+        ]
+        for s in (liar, *honest):
+            await s.start()
+
+        input_ids = (np.arange(8)[None, :] * 5 + 3) % config.vocab_size
+        ref = _hf_greedy(hf_model, input_ids, 6)
+
+        cfg = ClientConfig(
+            use_push=False, integrity=True, audit_p=1.0,
+            quarantine_timeout=600.0,
+        )
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", config=cfg
+        )
+        session = model.inference_session(24, 1)
+        async with session:
+            out = await session.step(model.embed(input_ids), ids=input_ids)
+            new, _ = await _greedy_decode(
+                model, session, out, 6, dtype=input_ids.dtype
+            )
+            manager = model.manager
+            assert liar.server_id in manager._quarantine, (
+                f"liar not quarantined (lied {liar.liar_steps}x, "
+                f"{session.sanity_rejects} gate rejects, "
+                f"{session.audit_mismatches} audit mismatches)"
+            )
+            assert manager.peers_quarantined >= 1
+            # detection fired through at least one of the two mechanisms
+            assert session.sanity_rejects + session.audit_mismatches >= 1
+            assert session.integrity_reroutes >= 1
+            # the current chain no longer contains the liar
+            assert all(
+                sp.span.peer_id != liar.server_id
+                for sp in session._spans
+            )
+        got = np.concatenate([input_ids, new], axis=1)
+        np.testing.assert_array_equal(got, ref)
+
+        # observability: the liar's own counters ride rpc_info
+        conn = await connect("127.0.0.1", liar.port)
+        info, _ = await conn.call("rpc_info", {})
+        assert info["integrity"] is True
+        assert info["liar_steps"] == liar.liar_steps >= 1
+        assert info["out_digests_sent"] >= 1
+        assert "audit_forwards" in info
+        assert "seq_hash_extend_failures" in info
+        await conn.close()
+
+        for s in (liar, *honest):
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_clean_swarm_zero_false_positives_with_everything_on(
+    tiny_model_dir,
+):
+    """False-positive gate: an HONEST 3-replica swarm with the sanity
+    gate + digests + audit_p=1.0 forced on must decode with ZERO rejects
+    and ZERO audit mismatches (honest replicas differ in ulps; exact
+    compares would convict them — bbtpu-lint BB007), and the integrity
+    layer must not change the tokens."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        servers = [
+            _server(model_dir, rc(), 0, 3, integrity=True)
+            for _ in range(3)
+        ]
+        for s in servers:
+            await s.start()
+
+        input_ids = (np.arange(10)[None, :] * 7 + 1) % config.vocab_size
+        ref = _hf_greedy(hf_model, input_ids, 5)
+
+        cfg = ClientConfig(use_push=False, integrity=True, audit_p=1.0)
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", config=cfg
+        )
+        session = model.inference_session(24, 1)
+        async with session:
+            out = await session.step(model.embed(input_ids), ids=input_ids)
+            new, _ = await _greedy_decode(
+                model, session, out, 5, dtype=input_ids.dtype
+            )
+            assert session.audits_run >= 1  # the audits actually ran
+            assert session.sanity_rejects == 0
+            assert session.audit_mismatches == 0
+            assert model.manager.peers_quarantined == 0
+            assert not model.manager._quarantine
+        got = np.concatenate([input_ids, new], axis=1)
+        np.testing.assert_array_equal(got, ref)
+
+        for s in servers:
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+
+def test_clean_spec_decode_zero_false_positives(tiny_model_dir):
+    """Speculative decoding under the inline gate (tree steps pass the
+    same sanity checks; audits sit out non-committing tree steps): the
+    greedy-equals-speculative invariant must hold with integrity forced
+    on, with zero rejects."""
+    model_dir, _, config = tiny_model_dir
+
+    from bloombee_tpu.client.speculative import generate_speculative
+    from bloombee_tpu.spec.drafter import GreedyTreeDrafter, LocalJaxDraftModel
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        servers = [
+            _server(model_dir, rc(), 0, 2, integrity=True),
+            _server(model_dir, rc(), 2, 3, integrity=True),
+        ]
+        for s in servers:
+            await s.start()
+
+        cfg = ClientConfig(use_push=False, integrity=True)
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", config=cfg
+        )
+        drafter = GreedyTreeDrafter(
+            LocalJaxDraftModel.from_dir(model_dir), branching=(2, 1)
+        )
+        input_ids = np.arange(5)[None, :]
+        spec_ids = await generate_speculative(
+            model, drafter, input_ids, max_new_tokens=8
+        )
+        plain_ids = await model.generate(
+            input_ids,
+            max_new_tokens=spec_ids.shape[1] - input_ids.shape[1],
+        )
+        np.testing.assert_array_equal(spec_ids, plain_ids)
+        assert model.manager.peers_quarantined == 0
+
+        for s in servers:
+            await s.stop()
+        await reg.stop()
+
+    asyncio.run(run())
