@@ -1,4 +1,4 @@
-"""bbtpu-lint rules BB001–BB007.
+"""bbtpu-lint rules BB001–BB008.
 
 Each rule encodes one invariant this codebase has already been burned by
 (see ARCHITECTURE.md "Invariants"). Rules are plugin classes over the
@@ -622,6 +622,91 @@ class ExactTensorCompareRule(Rule):
         return out
 
 
+class RawClockRule(Rule):
+    """BB008: package code must tell time through utils/clock.py, never
+    the stdlib directly.
+
+    The deterministic chaos substrate works by swapping the process
+    clock (scaled for soak runs, steppable for timing tests): every
+    lease expiry, ban probe, quarantine window, keepalive and announce
+    period advances on `clock.*`. One raw `time.monotonic()` in a
+    timing decision silently splits the codebase into two clock domains
+    and the steppable tests hang (virtual time advances, the raw site
+    doesn't). Flags calls to ``time()``/``monotonic()``/``sleep()`` on
+    any imported alias of the ``time`` module, and ``from time import``
+    of those names (they escape as callbacks). ``time.perf_counter()``
+    stays legal: duration *measurement* (throughput, codec timing) must
+    read real hardware time even under a virtual clock — but it must
+    never feed a deadline. Out-of-package harnesses (bench.py, scripts)
+    keep real time and are out of scope.
+    """
+
+    code = "BB008"
+    name = "raw-clock"
+    summary = "raw time.time/monotonic/sleep bypasses the virtual clock"
+
+    BANNED = {"time", "monotonic", "sleep"}
+
+    def _in_scope(self, path: str) -> bool:
+        p = path.replace("\\", "/")
+        if "bloombee_tpu/" not in p and not p.startswith(
+            ("client/", "server/", "kv/", "swarm/", "wire/", "utils/",
+             "models/", "runtime/", "cli/", "analysis/")
+        ):
+            return False
+        return not p.endswith("utils/clock.py")
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        if not self._in_scope(sf.path):
+            return []
+        out: list[Finding] = []
+        aliases: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        aliases.add(a.asname or "time")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "time" and node.level == 0:
+                    for a in node.names:
+                        if a.name in self.BANNED:
+                            f = sf.finding(
+                                self.code, node,
+                                f"`from time import {a.name}` escapes the "
+                                "virtual clock as a bare callable; import "
+                                "bloombee_tpu.utils.clock and call "
+                                f"clock.{'now' if a.name == 'time' else a.name}"
+                                "() instead",
+                            )
+                            if f:
+                                out.append(f)
+        if not aliases:
+            return out
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in aliases
+                and fn.attr in self.BANNED
+            ):
+                repl = "now" if fn.attr == "time" else fn.attr
+                f = sf.finding(
+                    self.code, node,
+                    f"raw `{fn.value.id}.{fn.attr}()` bypasses the virtual "
+                    "clock (utils/clock.py): steppable/scaled test clocks "
+                    "cannot reach it, so chaos timing tests hang or race; "
+                    f"use clock.{repl}() (clock.async_sleep() in "
+                    "coroutines; clock.perf_counter() is allowed for pure "
+                    "duration measurement)",
+                )
+                if f:
+                    out.append(f)
+        return out
+
+
 def make_rules() -> list[Rule]:
     """Fresh rule instances (BB006 keeps cross-file state)."""
     return [
@@ -632,6 +717,7 @@ def make_rules() -> list[Rule]:
         EnvRegistryRule(),
         CounterSurfacingRule(),
         ExactTensorCompareRule(),
+        RawClockRule(),
     ]
 
 

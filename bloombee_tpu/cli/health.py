@@ -42,6 +42,31 @@ def main(argv=None):
         from bloombee_tpu.swarm.data import ServerState
 
         reg = make_registry(args.registry)
+        if args.probe:
+            # the discovery plane is a server too: surface its audited
+            # error swallows (registry_swallowed_errors) the same way
+            for part in args.registry.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                rhost, rport = part.rsplit(":", 1)
+                rline = f"  registry {part}"
+                conn = None
+                try:
+                    conn = await connect(rhost, int(rport))
+                    probe, _ = await asyncio.wait_for(
+                        conn.call("rpc_info", {}), 5
+                    )
+                    rline += "  [reachable]"
+                    for k in ("keys", "registry_swallowed_errors"):
+                        if probe.get(k):
+                            rline += f"  {k}={probe[k]}"
+                except Exception as e:
+                    rline += f"  [UNREACHABLE: {type(e).__name__}]"
+                finally:
+                    if conn is not None:
+                        await conn.close()
+                print(rline)
         infos = await reg.get_module_infos(
             args.model_uid, range(args.num_blocks)
         )

@@ -14,7 +14,6 @@ import dataclasses
 import heapq
 import logging
 import random
-import time
 
 from bloombee_tpu.swarm.data import RemoteSpanInfo, ServerState
 
@@ -31,6 +30,7 @@ from bloombee_tpu.swarm.load import (  # noqa: F401  (re-exports)
     predicted_queue_delay_s,
 )
 from bloombee_tpu.swarm.ping import DEFAULT_RTT_S, PingAggregator
+from bloombee_tpu.utils import clock, ledger
 from bloombee_tpu.swarm.spans import compute_spans
 
 logger = logging.getLogger(__name__)
@@ -134,7 +134,7 @@ class RemoteSequenceManager:
 
     # ---------------------------------------------------------------- updates
     async def update(self, force: bool = False) -> None:
-        now = time.monotonic()
+        now = clock.monotonic()
         if not force and now - self._last_update < self.update_period:
             return
         infos = await self.registry.get_module_infos(
@@ -156,7 +156,7 @@ class RemoteSequenceManager:
         self._prune_bans()
         banned_now = {
             p for p, st in self._bans.items()
-            if st.banned_until > time.monotonic()
+            if st.banned_until > clock.monotonic()
         }
         to_ping = [
             (s.peer_id, s.server_info.host, s.server_info.port)
@@ -184,8 +184,9 @@ class RemoteSequenceManager:
             self.ban_timeout * (2.0 ** (state.strikes - 1)), self.ban_max
         )
         backoff *= 0.75 + 0.5 * self._rng.random()
-        state.banned_until = time.monotonic() + backoff
+        state.banned_until = clock.monotonic() + backoff
         self.pinger.forget(peer_id)
+        ledger.recovery("client.ban")
         logger.info(
             "banned peer %s for %.1fs (strike %d)", peer_id, backoff,
             state.strikes,
@@ -210,7 +211,8 @@ class RemoteSequenceManager:
         if retry_after_s is not None and retry_after_s > 0:
             backoff = max(backoff, min(retry_after_s, self.overload_max))
         backoff *= 0.75 + 0.5 * self._rng.random()
-        state.banned_until = time.monotonic() + backoff
+        state.banned_until = clock.monotonic() + backoff
+        ledger.recovery("client.overload_backoff")
         logger.info(
             "avoiding overloaded peer %s for %.1fs (strike %d)", peer_id,
             backoff, state.strikes,
@@ -252,9 +254,10 @@ class RemoteSequenceManager:
             self.quarantine_max,
         )
         backoff *= 0.75 + 0.5 * self._rng.random()
-        state.banned_until = time.monotonic() + backoff
+        state.banned_until = clock.monotonic() + backoff
         self._integrity_strikes.pop(peer_id, None)
         self.peers_quarantined += 1
+        ledger.recovery("client.quarantine")
         self.pinger.forget(peer_id)
         logger.warning(
             "QUARANTINED peer %s for %.0fs (conviction %d): excluded from "
@@ -324,7 +327,7 @@ class RemoteSequenceManager:
         if state is None:
             return False
         if now is None:
-            now = time.monotonic()
+            now = clock.monotonic()
         return now < state.banned_until or (
             state.probing and now < state.probe_until
         )
@@ -333,7 +336,7 @@ class RemoteSequenceManager:
         """Drop entries that can no longer matter: peers that left the
         swarm view, and long-expired bans whose peer was never re-routed
         (without this the maps grow monotonically with churn)."""
-        now = time.monotonic()
+        now = clock.monotonic()
         if self.spans:
             for d in (self._quarantine_history, self._integrity_strikes):
                 for pid in list(d):
@@ -358,7 +361,7 @@ class RemoteSequenceManager:
         # overload_excludes=False keeps hot (but not fault-banned) peers in
         # the pool: pick_standby prefers cool standbys itself but must be
         # able to degrade to a hot one when nothing else qualifies.
-        now = time.monotonic()
+        now = clock.monotonic()
         return [
             s
             for s in self.spans.values()
@@ -415,7 +418,7 @@ class RemoteSequenceManager:
         None when the swarm has no eligible alternative (the caller
         degrades to plain full-replay recovery)."""
         info = span.server_info
-        now = time.monotonic()
+        now = clock.monotonic()
         pool = list(self._active_spans(overload_excludes=False))
         # dedicated warm standbys (JOINING adverts) qualify too — they are
         # what the elastic control loop promotes on failover, so they are

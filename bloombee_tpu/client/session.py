@@ -29,7 +29,7 @@ from bloombee_tpu.client.sequence_manager import (
     RemoteSequenceManager,
 )
 from bloombee_tpu.swarm.data import RemoteSpanInfo
-from bloombee_tpu.utils import env
+from bloombee_tpu.utils import env, ledger
 from bloombee_tpu.wire.rpc import (
     Connection,
     OverloadedError,
@@ -1633,6 +1633,7 @@ class InferenceSession:
                 await asyncio.sleep(min(0.2 * attempt, 1.0))
             try:
                 await self._recover_once()
+                ledger.recovery("client.reroute_replay")
                 return
             except (
                 RpcError, OSError, asyncio.TimeoutError, MissingBlocksError,

@@ -25,7 +25,6 @@ import dataclasses
 import functools
 import itertools
 import threading
-import time
 from typing import Iterator
 
 import jax
@@ -33,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bloombee_tpu.kv import arena as arena_ops
-from bloombee_tpu.utils import env
+from bloombee_tpu.utils import clock, env
 
 env.declare(
     "BBTPU_PARK_QUANT", bool, False,
@@ -315,18 +314,18 @@ class CacheManager:
                 f"{admit_limit}"
             )
         cond = self._condition()
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = clock.deadline(timeout)
         async with cond:
             while self._reserved_tokens + need > admit_limit:
                 remaining = None
                 if deadline is not None:
-                    remaining = deadline - time.monotonic()
+                    remaining = deadline - clock.monotonic()
                     if remaining <= 0:
                         raise AllocationTimeout(
                             f"timed out waiting for {need} cache tokens"
                         )
                 try:
-                    await asyncio.wait_for(cond.wait(), remaining)
+                    await clock.cond_wait(cond, remaining)
                 except asyncio.TimeoutError:
                     raise AllocationTimeout(
                         f"timed out waiting for {need} cache tokens"
@@ -928,18 +927,31 @@ class CacheManager:
         os.unlink(path)  # POSIX: mapping keeps the data until released
         return mm
 
-    @_locked
     def unpark_sequence(self, seq_id: int) -> None:
-        entry = self._parked[seq_id]
-        # blocks until the background d2h copy has landed (usually long
-        # done — the sequence sat parked precisely because it was idle)
+        with self._lock:
+            entry = self._parked[seq_id]
+        # resolve OUTSIDE the manager lock: the d2h copy is usually long
+        # done (the sequence sat parked precisely because it was idle),
+        # but when it isn't, blocking here must not stall every other
+        # cache operation behind this one future
         try:
             k_host, v_host = entry.resolve()
         except ParkedKVLost:
             # the copy is gone for good: drop the entry so the client's
             # replay lands on a clean zero-length sequence, not a wedge
-            del self._parked[seq_id]
+            with self._lock:
+                if self._parked.get(seq_id) is entry:
+                    del self._parked[seq_id]
             raise
+        self._unpark_restore(seq_id, entry, k_host, v_host)
+
+    @_locked
+    def _unpark_restore(self, seq_id, entry, k_host, v_host) -> None:
+        """Second half of unpark: re-check ownership under the lock (a
+        concurrent lease teardown may have purged the entry while the
+        future resolved), then scatter the host copy back into the arena."""
+        if self._parked.get(seq_id) is not entry:
+            raise KeyError(seq_id)
         l_acc, l_seq = entry.l_acc, entry.l_seq
         state = self.table.seq(seq_id)
         assert state.l_seq == 0, "unpark target must be empty"

@@ -18,9 +18,8 @@ import fcntl
 import os
 import pathlib
 import shutil
-import time
 
-from bloombee_tpu.utils import env
+from bloombee_tpu.utils import clock, env
 
 env.declare(
     "BBTPU_CACHE_DIR", str, os.path.expanduser("~/.cache/bloombee_tpu"),
@@ -39,7 +38,7 @@ def _dir_size(path: pathlib.Path) -> int:
 
 
 def _touch_access(path: pathlib.Path) -> None:
-    (path / ".last_access").write_text(str(time.time()))
+    (path / ".last_access").write_text(str(clock.now()))
 
 
 def _last_access(path: pathlib.Path) -> float:

@@ -24,9 +24,8 @@ from __future__ import annotations
 
 import collections
 import math
-import time
 
-from bloombee_tpu.utils import env
+from bloombee_tpu.utils import clock, env
 
 env.declare(
     "BBTPU_ADMIT", bool, False,
@@ -98,7 +97,7 @@ class AdmissionController:
     # ------------------------------------------------------------ accounting
     def note_tokens(self, client: str, tokens: int, now: float | None = None):
         """Charge `tokens` processed tokens (batch x seq) to `client`."""
-        now = time.monotonic() if now is None else now
+        now = clock.monotonic() if now is None else now
         dq = self._tokens.setdefault(client, collections.deque())
         dq.append((now, max(0, int(tokens))))
         self._prune(dq, now)
@@ -109,7 +108,7 @@ class AdmissionController:
 
     def token_rate(self, client: str, now: float | None = None) -> float:
         """Tokens/s charged to `client` over the sliding window."""
-        now = time.monotonic() if now is None else now
+        now = clock.monotonic() if now is None else now
         dq = self._tokens.get(client)
         if not dq:
             return 0.0
@@ -122,7 +121,7 @@ class AdmissionController:
         > 0 means over-share (shed first), <= 0 at-or-under share. A client
         alone in the window is by construction at 0 debt — uncontended
         traffic can never look greedy."""
-        now = time.monotonic() if now is None else now
+        now = clock.monotonic() if now is None else now
         rates = {}
         for c in list(self._tokens):
             r = self.token_rate(c, now)
@@ -137,7 +136,7 @@ class AdmissionController:
         return rates.get(client, 0.0) / total - 1.0 / n
 
     def debts(self, now: float | None = None) -> dict[str, float]:
-        now = time.monotonic() if now is None else now
+        now = clock.monotonic() if now is None else now
         return {
             c: round(self.fair_share_debt(c, now), 3)
             for c in list(self._tokens)
@@ -150,7 +149,7 @@ class AdmissionController:
         """Admission decision for NEW work from `client` given the current
         queue delay. Returns None to admit, or a retry_after_ms hint when
         the work is shed."""
-        now = time.monotonic() if now is None else now
+        now = clock.monotonic() if now is None else now
         delay = float(queue_delay_ms)
         if not math.isfinite(delay):
             delay = 0.0

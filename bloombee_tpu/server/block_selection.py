@@ -10,14 +10,13 @@ servers don't thrash).
 
 from __future__ import annotations
 
-import time
 from collections import deque
 
 import numpy as np
 
 from bloombee_tpu.swarm.data import ModuleInfo, RemoteSpanInfo
 from bloombee_tpu.swarm.load import predicted_queue_delay_s
-from bloombee_tpu.utils import env
+from bloombee_tpu.utils import clock, env
 
 BALANCE_QUALITY = 0.75
 
@@ -55,7 +54,7 @@ def block_throughputs(
     _effective_throughput); with no adverts in the swarm the result is
     identical to the static aggregate."""
     if measured and now is None:
-        now = time.time()
+        now = clock.now()
     out = np.zeros(len(module_infos))
     for i, info in enumerate(module_infos):
         for server in info.servers.values():
@@ -139,7 +138,7 @@ def _rebalance_decision(
     if measured is None:
         measured = bool(env.get("BBTPU_MEASURED_REBALANCE"))
     if now is None:
-        now = time.time()
+        now = clock.now()
     tput = block_throughputs(module_infos, measured=measured, now=now)
     current_min = float(tput.min())
 

@@ -46,7 +46,7 @@ from bloombee_tpu.server.compute_queue import (
     aged_chunk_priority,
 )
 from bloombee_tpu.swarm.data import ServerInfo, ServerState
-from bloombee_tpu.utils import env
+from bloombee_tpu.utils import clock, env, ledger
 from bloombee_tpu.wire.flow import FlowLimiter
 from bloombee_tpu.wire.rpc import (
     Connection,
@@ -673,10 +673,13 @@ class BlockServer:
             # one extra group slot for the prefill chunk, so fusing never
             # costs the decode batcher any of its max_batch decode seats
             self.compute = ComputeQueue(
-                max_group=self.max_batch + 1, compat=self._mixed_compat
+                max_group=self.max_batch + 1, compat=self._mixed_compat,
+                group_hint=self._batch_group_hint,
             )
         else:
-            self.compute = ComputeQueue(max_group=self.max_batch)
+            self.compute = ComputeQueue(
+                max_group=self.max_batch, group_hint=self._batch_group_hint
+            )
         self.peers = _PeerPool()
         # server-side multi-step decode (decode_n): needs the checkpoint's
         # embed/norm/lm_head trio; lazy-loaded from model_dir on first use
@@ -707,6 +710,9 @@ class BlockServer:
         # graceful shutdown: announces DRAINING (routing stops sending NEW
         # sessions), keeps serving in-flight sessions up to drain_timeout
         self._draining = False
+        # chaos harness: crash() flips this; post-crash nothing may take a
+        # graceful path (no park, no announce, no revoke)
+        self._crashed = False
         # elastic self-healing: standby/promotion control-loop state. A
         # standby announces JOINING (invisible to routing, visible to
         # kv_put replication) and refuses session opens; _promotion_loop
@@ -911,18 +917,17 @@ class BlockServer:
         close (bounded by `timeout`, default drain_timeout), then stop.
         Sessions that outlive the drain replay elsewhere via the client's
         ordinary dead-server recovery path."""
-        import time as _time
 
         if self._draining:
             return
         self._draining = True
-        deadline = _time.monotonic() + (
+        deadline = clock.monotonic() + (
             self.drain_timeout if timeout is None else float(timeout)
         )
         logger.info(
             "draining %s: %d in-flight session(s), up to %.0fs",
             self.server_id, len(self._sessions),
-            deadline - _time.monotonic(),
+            deadline - clock.monotonic(),
         )
         if self.registry is not None:
             try:
@@ -945,7 +950,7 @@ class BlockServer:
             try:
                 await asyncio.wait_for(
                     asyncio.gather(*flush, return_exceptions=True),
-                    timeout=max(1.0, deadline - _time.monotonic()),
+                    timeout=max(1.0, deadline - clock.monotonic()),
                 )
             except asyncio.TimeoutError:
                 logger.warning(
@@ -966,10 +971,10 @@ class BlockServer:
             logger.info(
                 "drain force-expired %d parked session lease(s)", reaped
             )
-        while self._sessions and _time.monotonic() < deadline:
+        while self._sessions and clock.monotonic() < deadline:
             # sessions parking DURING the drain are refused (the park path
             # checks _draining), so only live streams remain to wait on
-            await asyncio.sleep(0.1)
+            await clock.async_sleep(0.1)
         if self._sessions:
             logger.warning(
                 "%d session(s) outlived the drain; they will replay "
@@ -1001,6 +1006,38 @@ class BlockServer:
         await self.compute.stop()
         await self.peers.close()
         await self.rpc.stop()
+
+    def crash(self) -> None:
+        """Process-crash emulation for the chaos harness: the server dies
+        NOW, mid-whatever-it-was-doing. Unlike every graceful path above
+        there is no DRAINING announce, no replication flush, no session
+        park, no registry revoke (the announce record must expire on its
+        own — that silence is what standby promotion watches for), and no
+        orderly stream close: every connection's transport is aborted so
+        peers see exactly what a kill -9 produces. Sessions and their KV
+        are simply lost; recovery happens entirely elsewhere (standby
+        promotion, client reroute-replay)."""
+        if self._crashed:
+            return
+        self._crashed = True
+        self._draining = True  # refuse any racing open/park/announce
+        ledger.fault("server.crash")
+        logger.warning("CRASH injected: server %s dying hard", self.server_id)
+        for task in (self._supervisor_task, self._warmup_task,
+                     self._throughput_task, self._reaper_task,
+                     self._promotion_task, self._announce_task):
+            if task is not None:
+                task.cancel()
+        # sessions die unresolved: wake parked resume-waiters so their
+        # handler tasks unwind (they observe _crashed and abort), then
+        # forget everything — no parking, no lease bookkeeping
+        for s in list(self._sessions.values()):
+            s.reaped = True
+            if s.resume_waiter is not None:
+                s.resume_waiter.set()
+        self._sessions.clear()
+        self.compute.kill()
+        self.rpc.abort()
 
     async def warmup(
         self, batch_sizes=(1,), prefill_tokens: int = 128
@@ -1091,12 +1128,11 @@ class BlockServer:
         - every rebalance_period seconds, checks whether moving the span
           to the least-served window beats the hysteresis and moves
           (reference server.py:479-542)."""
-        import time as _time
 
-        last_rebalance = _time.monotonic()
+        last_rebalance = clock.monotonic()
         tick = max(1.0, min(self.announce_period, 15.0))
         while True:
-            await asyncio.sleep(tick)
+            await clock.async_sleep(tick)
             try:
                 self._supervisor_tick()
                 if (
@@ -1104,10 +1140,10 @@ class BlockServer:
                     and not self._rebalancing
                     and not self._standby
                     and self.rebalance_unsupported() is None
-                    and _time.monotonic() - last_rebalance
+                    and clock.monotonic() - last_rebalance
                     >= self.rebalance_period
                 ):
-                    last_rebalance = _time.monotonic()
+                    last_rebalance = clock.monotonic()
                     from bloombee_tpu.server.block_selection import (
                         rebalance_if_needed,
                     )
@@ -1179,7 +1215,6 @@ class BlockServer:
         back to standby once the span's OTHER servers stay cool below
         promote_low_ms for the sustain window — the high/low gap plus the
         dwell time is the hysteresis that stops replica flapping."""
-        import time as _time
 
         tick = max(
             0.1,
@@ -1188,7 +1223,7 @@ class BlockServer:
         hot_since: float | None = None
         cool_since: float | None = None
         while True:
-            await asyncio.sleep(tick)
+            await clock.async_sleep(tick)
             if self._draining:
                 return
             try:
@@ -1198,7 +1233,7 @@ class BlockServer:
                     if reason is None:
                         hot_since = None
                         continue
-                    now = _time.monotonic()
+                    now = clock.monotonic()
                     if reason == "hot":
                         # sustained-overload dwell; a dead span promotes
                         # without one (there is nobody left to flap with)
@@ -1209,7 +1244,7 @@ class BlockServer:
                     # storm guard: jittered delay, then RE-CHECK — a peer
                     # standby that promoted during our sleep clears the
                     # trigger (span covered again / best server cool)
-                    await asyncio.sleep(
+                    await clock.async_sleep(
                         self._promote_rng.uniform(0, self.promote_jitter_s)
                     )
                     if await self._span_needs_me() is None:
@@ -1225,7 +1260,7 @@ class BlockServer:
                         cool_since = None
                         continue
                     if await self._span_cooled():
-                        now = _time.monotonic()
+                        now = clock.monotonic()
                         if cool_since is None:
                             cool_since = now
                         if now - cool_since >= self.promote_sustain_s:
@@ -1299,6 +1334,7 @@ class BlockServer:
         self._standby = False
         self._promoted = True
         self.promotions += 1
+        ledger.recovery("server.promotion")
         logger.warning(
             "standby %s PROMOTING to serve %s[%d:%d) (%s; %d replicated "
             "pages warm)", self.server_id, self.model_uid,
@@ -1350,16 +1386,15 @@ class BlockServer:
         window the demotion ABORTS (re-announce ONLINE, retry later) —
         drain-back must never strand live streams on an unroutable
         server."""
-        import time as _time
 
         self._standby = True  # session opens now refuse; open streams live
         try:
             await self._announce(ServerState.DRAINING)
         except Exception as e:
             logger.warning("demotion announce failed: %s", e)
-        deadline = _time.monotonic() + self.drain_timeout
-        while self._sessions and _time.monotonic() < deadline:
-            await asyncio.sleep(0.1)
+        deadline = clock.monotonic() + self.drain_timeout
+        while self._sessions and clock.monotonic() < deadline:
+            await clock.async_sleep(0.1)
         if self._sessions and not yielded:
             # a yielded storm-duplicate demotes regardless: its sibling
             # serves the span, and any session that raced onto us replays
@@ -1435,11 +1470,10 @@ class BlockServer:
                     )
                 except Exception as e:
                     logger.warning("revoke of old span failed: %s", e)
-            import time as _time
 
-            deadline = _time.monotonic() + self.drain_timeout
-            while self._sessions and _time.monotonic() < deadline:
-                await asyncio.sleep(0.25)
+            deadline = clock.monotonic() + self.drain_timeout
+            while self._sessions and clock.monotonic() < deadline:
+                await clock.async_sleep(0.25)
             if self._sessions:
                 logger.warning(
                     "%d session(s) outlived the %.0fs drain; they will "
@@ -1491,6 +1525,7 @@ class BlockServer:
             self.spec = spec
             if self.registry is not None:
                 await self._announce(ServerState.ONLINE)
+                ledger.recovery("server.rebalance_reannounce")
         except Exception:
             # mid-move crash: whatever span is actually loaded right now
             # (the OLD one unless the swap already landed — the swap is
@@ -1514,7 +1549,6 @@ class BlockServer:
         """Live load gauges republished in every advert (ServerInfo.load)
         and consumed by the client router's predicted-queue-delay term.
         Wall-clock `ts` lets readers staleness-discount the whole dict."""
-        import time as _time
 
         waits = self.compute.wait_stats_ms()
         window_s = (
@@ -1524,7 +1558,7 @@ class BlockServer:
         table = getattr(self.manager, "table", None)
         pages_free = getattr(table, "free_pages", None)
         return {
-            "ts": _time.time(),
+            "ts": clock.now(),
             "delay_ms": round(delay_ms, 3),
             "queue_depth": self.compute.depth(),
             "wait_ms": {"p50": waits["p50"], "p95": waits["p95"]},
@@ -1612,7 +1646,7 @@ class BlockServer:
                 # registry expiration stays announce_period * 2.5, so extra
                 # announces only ever REFRESH liveness, never shorten it
                 period = min(period, self.load_advert_s)
-            await asyncio.sleep(period)
+            await clock.async_sleep(period)
             if self._rebalancing:
                 # mid-move: announcing the OLD span would overwrite the
                 # tombstone (registry merge is latest-write-wins) and keep
@@ -1668,7 +1702,6 @@ class BlockServer:
 
     # ------------------------------------------------------------------- RPCs
     async def _rpc_info(self, meta: dict, tensors):
-        import time as _time
 
         from bloombee_tpu.wire.tensor_codec import transport_stats
 
@@ -1682,7 +1715,7 @@ class BlockServer:
         )
         info = {
             "server_id": self.server_id,
-            "server_time": _time.time(),  # NTP-style clock sync anchor
+            "server_time": clock.now(),  # NTP-style clock sync anchor
             "transport": transport_stats(),
             # chaos/ops observability: expired-deadline work drops and the
             # drain flag (also visible as state=DRAINING in server_info)
@@ -2061,11 +2094,10 @@ class BlockServer:
         async with self.manager.allocate(
             batch, max_length, timeout=self.alloc_timeout
         ) as handle:
-            import time as _time
 
             session = _Session(session_id, handle, batch, layers, adapter,
                                client_id=client_id)
-            session.opened_at = _time.monotonic()
+            session.opened_at = clock.monotonic()
             session.last_step_at = session.opened_at
             self._sessions[session_id] = session
             self._drain_pending_pushes(session)
@@ -2120,7 +2152,7 @@ class BlockServer:
                     except Exception:
                         pass
                 if session.n_steps:
-                    wall = _time.monotonic() - session.opened_at
+                    wall = clock.monotonic() - session.opened_at
                     logger.info(
                         "[TIMING_TABLE] session=%s steps=%d tokens=%d "
                         "mean_dispatch_ms=%.2f mean_fetch_ms=%.2f "
@@ -2156,7 +2188,6 @@ class BlockServer:
         full replay), then sleep until a resume handler delivers a fresh
         stream or the reaper expires the lease. Returns the new stream, or
         None once the session is reclaimed."""
-        import time as _time
 
         # fence the dead stream: nothing may still be writing KV when the
         # pages change owner (same ordering as _session_loop teardown)
@@ -2170,9 +2201,10 @@ class BlockServer:
         session.cur_stream = None
         session.resume_stream = None
         session.resume_waiter = asyncio.Event()
-        session.lease_deadline = _time.monotonic() + self.session_lease_s
+        session.lease_deadline = clock.monotonic() + self.session_lease_s
         session.parked = True
         await self.manager.lease_park(session.handle)
+        ledger.recovery("server.lease_park")
         logger.info(
             "session %s parked after stream death (%s: %s); resumable for "
             "%.1fs", session.id, type(cause).__name__, cause,
@@ -2183,6 +2215,7 @@ class BlockServer:
         if session.reaped or session.resume_stream is None:
             self.manager.lease_reclaim(session.handle)
             self.sessions_reaped += 1
+            ledger.recovery("server.lease_reap")
             logger.info(
                 "session %s lease expired while parked; KV reclaimed",
                 session.id,
@@ -2200,7 +2233,6 @@ class BlockServer:
         frame open until the session lets go of it. Declines (resumed:
         False) instead of erroring so the client cleanly falls back to the
         standby/full-replay path."""
-        import time as _time
 
         session = self._sessions.get(session_id)
         reason = None
@@ -2218,11 +2250,11 @@ class BlockServer:
             for _ in range(100):
                 if session.parked or session_id not in self._sessions:
                     break
-                await asyncio.sleep(0.05)
+                await clock.async_sleep(0.05)
             if not session.parked:
                 reason = "session is still attached to a live stream"
         if reason is None and (
-            session.reaped or _time.monotonic() >= session.lease_deadline
+            session.reaped or clock.monotonic() >= session.lease_deadline
         ):
             reason = "session lease expired"
         if reason is None and not await self.manager.lease_resume(
@@ -2270,12 +2302,11 @@ class BlockServer:
         keepalives are off). A fenced stream fails into the ordinary park
         path, so even this late detection hands the pages to the pool
         rather than freeing them under a client that might still return."""
-        import time as _time
 
         interval = max(0.05, self.session_lease_s / 4)
         while True:
-            await asyncio.sleep(interval)
-            now = _time.monotonic()
+            await clock.async_sleep(interval)
+            now = clock.monotonic()
             for session in list(self._sessions.values()):
                 if session.parked:
                     if now >= session.lease_deadline and not session.reaped:
@@ -2303,9 +2334,8 @@ class BlockServer:
     def _session_ages(self) -> dict:
         """Operator gauges for rpc_info: how old and how idle the live
         sessions are, and how many sit parked awaiting a resume."""
-        import time as _time
 
-        now = _time.monotonic()
+        now = clock.monotonic()
         ages = [now - s.opened_at for s in self._sessions.values()]
         idles = [now - s.last_step_at for s in self._sessions.values()]
         return {
@@ -2461,18 +2491,16 @@ class BlockServer:
         """meta['deadline_s'] (relative remaining seconds stamped by the
         client or shrunk by the previous hop) -> local monotonic cutoff,
         or None when the item carries no budget."""
-        import time as _time
 
         budget = meta.get("deadline_s")
         if budget is None:
             return None
-        return _time.monotonic() + float(budget)
+        return clock.monotonic() + float(budget)
 
     @staticmethod
     def _deadline_passed(deadline: float | None) -> bool:
-        import time as _time
 
-        return deadline is not None and _time.monotonic() > deadline
+        return deadline is not None and clock.monotonic() > deadline
 
     def _liar_perturb(self, out: np.ndarray) -> np.ndarray:
         """TEST HOOK (liar_p): return a perturbed copy of a span output —
@@ -2528,6 +2556,7 @@ class BlockServer:
             # recorded before the stream died — resend the identical reply
             # instead of mutating KV a second time
             self.steps_deduped += 1
+            ledger.recovery("server.resume_dedup")
             resp, out_t = cached
             await stream.send({**resp, "deduped": True}, out_t)
             return
@@ -2752,11 +2781,10 @@ class BlockServer:
             ):
                 return
             raise
-        import time as _time
 
-        t0 = _time.perf_counter()
+        t0 = clock.perf_counter()
         out = await asyncio.to_thread(self.executor.fetch, out_dev)
-        t_fetch_ms = (_time.perf_counter() - t0) * 1000.0
+        t_fetch_ms = (clock.perf_counter() - t0) * 1000.0
         if self.liar_p > 0 and self._liar_rng.random() < self.liar_p:
             # TEST HOOK: lie BEFORE the digest/serialization below, so the
             # reply is a well-formed frame whose digest matches the lie —
@@ -2834,7 +2862,7 @@ class BlockServer:
                 # each hop spends part of the budget; forward the REMAINDER
                 # so a downstream span never computes for a client whose
                 # overall step timeout already fired
-                remaining = deadline - _time.monotonic()
+                remaining = deadline - clock.monotonic()
                 if remaining <= 0:
                     self._note_deadline_expired(meta, "before forwarding")
                     return
@@ -2975,7 +3003,6 @@ class BlockServer:
             np.asarray(meta["finished"], dtype=bool)
             if meta.get("finished") is not None else None
         )
-        import time as _time
 
         def _dispatch():
             if not self.manager.epoch_valid(session.handle):
@@ -2983,14 +3010,14 @@ class BlockServer:
                     "server KV arena was rebuilt; session cache lost — "
                     "replay"
                 )
-            session.last_step_at = _time.monotonic()
-            t0 = _time.perf_counter()
+            session.last_step_at = clock.monotonic()
+            t0 = clock.perf_counter()
             out = self.executor.decode_n(
                 session.handle, ids, n, self._client_params,
                 eos_token_id=eos, finished=finished,
                 adapter=session.adapter,
             )
-            return out, (_time.perf_counter() - t0) * 1000.0
+            return out, (clock.perf_counter() - t0) * 1000.0
 
         try:
             out_dev, t_dispatch_ms = await self.compute.submit(
@@ -3006,11 +3033,11 @@ class BlockServer:
             ):
                 return
             raise
-        t0 = _time.perf_counter()
+        t0 = clock.perf_counter()
         toks = await asyncio.to_thread(
             lambda: np.asarray(out_dev, dtype=np.int32)
         )
-        t_fetch_ms = (_time.perf_counter() - t0) * 1000.0
+        t_fetch_ms = (clock.perf_counter() - t0) * 1000.0
         session.n_steps += n
         session.sum_tokens += int(ids.shape[0]) * n
         session.sum_dispatch_ms += t_dispatch_ms
@@ -3046,7 +3073,6 @@ class BlockServer:
         Failure contract: once any KV was committed this RPC, spans hold
         ragged extra tokens — the decline carries dirty=True so the client
         rebuilds-and-replays before falling back (clean by construction)."""
-        import time as _time
 
         n = int(meta["decode_n"])
         ids = np.asarray(tensors[0]).reshape(-1).astype(np.int64)
@@ -3068,7 +3094,7 @@ class BlockServer:
             session.chain_inbox.get_nowait()
         toks = np.zeros((b, n), dtype=np.int32)
         committed = 0
-        t_start = _time.perf_counter()
+        t_start = clock.perf_counter()
         t_dispatch_sum = 0.0
         # total budget for the WHOLE chain RPC: one cold-compile allowance
         # plus 1s/token. Deliberately under the client's recv budget
@@ -3076,15 +3102,15 @@ class BlockServer:
         # transient decline beats the client timing out and BANNING a
         # coordinator that was making slow-but-legal progress. A retry
         # after replay hits warm compile caches and converges.
-        t_deadline = _time.monotonic() + self.chain_step_timeout + float(n)
+        t_deadline = clock.monotonic() + self.chain_step_timeout + float(n)
         budget = meta.get("deadline_s")
         if budget is not None:
             # never outlive the CLIENT's budget either: past it the reply
             # lands on a closed ear and every further token is waste
-            t_deadline = min(t_deadline, _time.monotonic() + float(budget))
+            t_deadline = min(t_deadline, clock.monotonic() + float(budget))
         try:
             for i in range(n):
-                if _time.monotonic() > t_deadline:
+                if clock.monotonic() > t_deadline:
                     raise _ChainError(
                         f"chain exceeded its {self.chain_step_timeout:.0f}s"
                         f"+{n}s budget after {i}/{n} tokens"
@@ -3095,8 +3121,8 @@ class BlockServer:
                             "server KV arena was rebuilt; session cache "
                             "lost — replay"
                         )
-                    session.last_step_at = _time.monotonic()
-                    t0 = _time.perf_counter()
+                    session.last_step_at = clock.monotonic()
+                    t0 = clock.perf_counter()
                     h = self._embed_ids(ids_now)
                     out = self.executor.decode(
                         session.handle,
@@ -3104,7 +3130,7 @@ class BlockServer:
                         commit=True, layers=session.layers, fetch=False,
                         adapter=session.adapter,
                     )
-                    return out, (_time.perf_counter() - t0) * 1000.0
+                    return out, (clock.perf_counter() - t0) * 1000.0
                 out_dev, dt_ms = await self.compute.submit(
                     PRIORITY_INFERENCE, _dispatch
                 )
@@ -3124,7 +3150,7 @@ class BlockServer:
                     await self._push_hop(
                         route, chain, meta.get("step"),
                         meta.get("head_dtype"), out,
-                        deadline_s=t_deadline - _time.monotonic(),
+                        deadline_s=t_deadline - clock.monotonic(),
                     )
                     nxt = await self._await_chain_ids(
                         session, cid, i, t_deadline
@@ -3174,7 +3200,7 @@ class BlockServer:
             # the ragged KV no longer blocks a later park
             session.kv_dirty = False
             return
-        total_ms = (_time.perf_counter() - t_start) * 1000.0
+        total_ms = (clock.perf_counter() - t_start) * 1000.0
         session.n_steps += n
         session.sum_tokens += b * n
         session.sum_dispatch_ms += t_dispatch_sum
@@ -3222,10 +3248,9 @@ class BlockServer:
         stale messages from earlier chains are dropped, errors raise.
         Bounded by the chain's overall deadline so the RPC always answers
         inside the client's recv budget."""
-        import time as _time
 
         while True:
-            remaining = t_deadline - _time.monotonic()
+            remaining = t_deadline - clock.monotonic()
             if remaining <= 0:
                 raise asyncio.TimeoutError("chain deadline exhausted")
             msg_meta, msg_tensors = await asyncio.wait_for(
@@ -3254,7 +3279,6 @@ class BlockServer:
         failures travel to the coordinator as chain_error pushes — never
         onto this span's own client stream (the client is not reading it
         mid-decode_n)."""
-        import time as _time
 
         chain = meta["chain"]
         origin = chain["origin"]
@@ -3268,7 +3292,7 @@ class BlockServer:
                         "server KV arena was rebuilt; session cache lost "
                         "— replay"
                     )
-                session.last_step_at = _time.monotonic()
+                session.last_step_at = clock.monotonic()
                 return self.executor.decode(
                     session.handle, hidden, commit=True,
                     layers=session.layers, fetch=False,
@@ -3301,7 +3325,7 @@ class BlockServer:
                 out = await asyncio.to_thread(self.executor.fetch, out_dev)
                 remaining = None
                 if deadline is not None:
-                    remaining = deadline - _time.monotonic()
+                    remaining = deadline - clock.monotonic()
                     if remaining <= 0:
                         self._note_deadline_expired(
                             meta, "before chain forward"
@@ -3535,9 +3559,8 @@ class BlockServer:
         lost arena — rolls back and frees every partial page. Returns
         (per-chunk lazy outputs, total dispatch ms); `executor.fetch`
         concatenates the chunk list off-queue."""
-        import time as _time
 
-        stream_t0 = _time.monotonic()
+        stream_t0 = clock.monotonic()
         outs: list = []
         total_ms = 0.0
         last = len(spans) - 1
@@ -3621,14 +3644,13 @@ class BlockServer:
         off-queue) with the chunk-stream twists: the FIRST chunk settles
         a pending prefix-cache adoption, every chunk writes speculatively,
         and the LAST chunk commits the whole prompt."""
-        import time
 
         if not self.manager.epoch_valid(handle):
             raise SessionKVLost(
                 "server KV arena was rebuilt; session cache lost — replay"
             )
-        session.last_step_at = time.monotonic()
-        t0 = time.perf_counter()
+        session.last_step_at = clock.monotonic()
+        t0 = clock.perf_counter()
         if first and self.manager.has_adopted(handle):
             # settle the probe adoption before the suffix's first chunk
             # (same semantics as _compute_step's settle)
@@ -3646,7 +3668,7 @@ class BlockServer:
             self.manager.commit(handle)
         self.step_dispatches += 1
         self.step_tokens += int(hidden.shape[0]) * int(hidden.shape[1])
-        dt_ms = (time.perf_counter() - t0) * 1000.0
+        dt_ms = (clock.perf_counter() - t0) * 1000.0
         if env.log_channel_enabled("timing"):
             logger.info(
                 "[timing] session=%s prefill chunk tokens=%d%s "
@@ -3665,7 +3687,6 @@ class BlockServer:
         time is the serialized cost per step — the unit that bounds server
         throughput (reference [TIMING_TABLE] decomposition,
         handler.py:1276-1605)."""
-        import time
 
         if not self.manager.epoch_valid(handle):
             # the arena was rebuilt after a kernel failure and this
@@ -3676,8 +3697,8 @@ class BlockServer:
             raise SessionKVLost(
                 "server KV arena was rebuilt; session cache lost — replay"
             )
-        session.last_step_at = time.monotonic()
-        t0 = time.perf_counter()
+        session.last_step_at = clock.monotonic()
+        t0 = clock.perf_counter()
         if self.manager.has_adopted(handle):
             # settle an outstanding probe adoption: unpark first so the
             # trim acts on live lengths, then shrink each row's adopted
@@ -3715,7 +3736,7 @@ class BlockServer:
             )
         self.step_dispatches += 1
         self.step_tokens += int(hidden.shape[0]) * int(hidden.shape[1])
-        dt_ms = (time.perf_counter() - t0) * 1000.0
+        dt_ms = (clock.perf_counter() - t0) * 1000.0
         if env.log_channel_enabled("timing"):
             logger.info(
                 "[timing] session=%s tokens=%d dispatch_ms=%.2f",
@@ -3797,6 +3818,7 @@ class BlockServer:
 
     def _solo_member_step(self, m: _BatchMember):
         self.batch_solo_steps += 1
+        ledger.recovery("server.rollback_solo_replay")
         try:
             return self._compute_step(
                 m.session, m.handle, m.hidden, True, None
@@ -3809,10 +3831,9 @@ class BlockServer:
         KV writes go in speculatively and commit only after the dispatch
         succeeds, so a failure rolls the whole group's tables back to the
         pre-step state and the row-by-row replay appends no ghost tokens."""
-        import time
 
-        t0 = time.perf_counter()
-        now = time.monotonic()
+        t0 = clock.perf_counter()
+        now = clock.monotonic()
         for m in group:
             m.session.last_step_at = now
         handles = [m.handle for m in group]
@@ -3827,7 +3848,7 @@ class BlockServer:
             self.manager.rollback(self.manager.combine_handles(handles))
             raise
         self.manager.commit(combined)
-        dt_ms = (time.perf_counter() - t0) * 1000.0
+        dt_ms = (clock.perf_counter() - t0) * 1000.0
         self.batch_dispatches += 1
         self.batched_steps += len(group)
         self.step_dispatches += 1
@@ -3931,10 +3952,9 @@ class BlockServer:
         solo replay re-verifies from a clean table. On success nothing
         commits here: the surviving slots settle when each session's next
         accept rides in (accept_speculative, unchanged)."""
-        import time
 
-        t0 = time.perf_counter()
-        now = time.monotonic()
+        t0 = clock.perf_counter()
+        now = clock.monotonic()
         for m in group:
             m.session.last_step_at = now
         handles = [m.handle for m in group]
@@ -3956,7 +3976,7 @@ class BlockServer:
                 if self.manager.epoch_valid(m.handle):
                     self.manager.truncate_speculative(m.handle, snap)
             raise
-        dt_ms = (time.perf_counter() - t0) * 1000.0
+        dt_ms = (clock.perf_counter() - t0) * 1000.0
         self.tree_group_dispatches += 1
         self.tree_group_members += len(group)
         self.step_dispatches += 1
@@ -3981,6 +4001,14 @@ class BlockServer:
         return outs
 
     # --------------------------------------------------- mixed-batch dispatch
+    def _batch_group_hint(self) -> int:
+        """Upper bound on how many members a ComputeQueue gather window
+        could still collect: a session submits at most one step (or
+        prefill chunk) at a time, so once every open session is in the
+        group the window is pure dead time — a solo session never waits
+        it out at all."""
+        return len(self._sessions)
+
     def _mixed_compat(self, members: list, cand) -> bool:
         """ComputeQueue group-membership predicate with --mixed-batch on:
         decode steps ("decode1") and prefill chunks ("chunkm") may share
@@ -4089,10 +4117,9 @@ class BlockServer:
         handle is TRUNCATED to its pre-dispatch length — a plain rollback
         would also discard the stream's earlier (still wanted) speculative
         chunks — so the solo replays append no ghost tokens."""
-        import time
 
-        t0 = time.perf_counter()
-        now = time.monotonic()
+        t0 = clock.perf_counter()
+        now = clock.monotonic()
         for m in group:
             m.session.last_step_at = now
         chunk = group[-1]
@@ -4117,7 +4144,7 @@ class BlockServer:
             self.manager.commit(m.handle)
         if chunk.last:
             self.manager.commit(chunk.handle)
-        dt_ms = (time.perf_counter() - t0) * 1000.0
+        dt_ms = (clock.perf_counter() - t0) * 1000.0
         ntok = sum(
             m.handle.batch_size * int(m.hidden.shape[1]) for m in group
         )
@@ -4151,9 +4178,8 @@ class BlockServer:
         """Park idle sessions' KV (LRU by last step) until `need_pages` are
         freed. Runs on the compute thread — the only thread that mutates
         the paged table — so no step can race the eviction."""
-        import time as _time
 
-        now = _time.monotonic()
+        now = clock.monotonic()
         victims = sorted(
             (
                 s for s in list(self._sessions.values())
@@ -4457,9 +4483,8 @@ class BlockServer:
         session.push_inbox.put_nowait((meta, tensors))
 
     def _buffer_pending_push(self, meta: dict, tensors) -> None:
-        import time
 
-        now = time.monotonic()
+        now = clock.monotonic()
         sid = meta["session_id"]
         self._pending_pushes.setdefault(sid, []).append((now, meta, tensors))
         # drop stale buffers

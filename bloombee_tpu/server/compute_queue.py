@@ -23,11 +23,10 @@ import collections
 import dataclasses
 import functools
 import itertools
-import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Hashable
 
-from bloombee_tpu.utils import env
+from bloombee_tpu.utils import clock, env
 
 PRIORITY_INFERENCE = 0.0  # reference DummyTaskPrioritizer: inference=1.0
 # resumable prefill chunks re-enter the queue BETWEEN decode steps and
@@ -56,13 +55,13 @@ def aged_chunk_priority(
     stream_started_at: float, now: float | None = None
 ) -> float:
     """Priority for the next chunk of a prefill stream that began at
-    `stream_started_at` (time.monotonic()). Fresh streams yield to queued
+    `stream_started_at` (clock.monotonic()). Fresh streams yield to queued
     decode steps; once the stream has aged past BBTPU_CHUNK_AGE_S its
     chunks compete at decode priority (FIFO by submission order), bounding
     worst-case prefill delay under sustained decode pressure."""
     horizon = max(1e-9, float(env.get("BBTPU_CHUNK_AGE_S")))
     if now is None:
-        now = time.monotonic()
+        now = clock.monotonic()
     frac = min(1.0, max(0.0, (now - stream_started_at) / horizon))
     return PRIORITY_PREFILL_CHUNK * (1.0 - frac)
 
@@ -83,7 +82,7 @@ class _Task:
 
     fn: Callable[[], Any]
     fut: asyncio.Future
-    deadline: float | None  # time.monotonic() cutoff, checked at pop time
+    deadline: float | None  # clock.monotonic() cutoff, checked at pop time
     enqueued_at: float
     task_class: str | None = None  # "prefill"/"decode" wait-stat bucket
 
@@ -109,6 +108,7 @@ class ComputeQueue:
         self,
         max_group: int = 8,
         compat: Callable[[list, "_GroupTask"], bool] | None = None,
+        group_hint: Callable[[], int] | None = None,
     ) -> None:
         self._queue: asyncio.PriorityQueue = asyncio.PriorityQueue()
         self._seq = itertools.count()
@@ -123,6 +123,12 @@ class ComputeQueue:
         # heterogeneous members into one dispatch (mixed decode+prefill
         # batching) while still refusing cross-adapter/dtype mixes.
         self.compat = compat
+        # upper bound on how many members a gather could EVER collect
+        # (the server passes its open-session count: a session has at
+        # most one step in flight). When the group reaches it, the gather
+        # window is pure dead time and the dispatch goes out immediately.
+        # None = no bound known; the window always runs to its deadline.
+        self.group_hint = group_hint
         # samples are (picked_up_at_monotonic, wait_s) so windowed readers
         # (admission control, load adverts) can discard old load regimes
         # instead of averaging over the whole 512-sample tail
@@ -137,12 +143,17 @@ class ComputeQueue:
         # and nothing pops, (now - _last_pop_at) lower-bounds the wait the
         # NEXT pop will report — the only live signal during a jam, when the
         # sample deques go quiet precisely because nothing completes
-        self._last_pop_at: float = time.monotonic()
+        self._last_pop_at: float = clock.monotonic()
 
     def start(self) -> None:
         self._worker_task = asyncio.create_task(self._worker())
 
     async def stop(self) -> None:
+        self.kill()
+
+    def kill(self) -> None:
+        """Synchronous stop — also the crash-fault path, which cannot
+        await anything graceful."""
         if self._worker_task is not None:
             self._worker_task.cancel()
         # fail everything still queued: a future that never resolves leaves
@@ -191,7 +202,7 @@ class ComputeQueue:
         queued). The second term is what makes this usable for admission
         control — during a stall no samples arrive, so a percentile alone
         reads zero exactly when the queue is at its worst."""
-        now = time.monotonic()
+        now = clock.monotonic()
         src = self._class_waits.get(cls) if cls is not None else self._waits
         recent = [e for e in (src or ()) if now - e[0] <= window_s]
         p95 = self._percentiles(recent)["p95"]
@@ -205,7 +216,7 @@ class ComputeQueue:
         priority: float,
         fn: Callable[..., Any],
         *args,
-        deadline: float | None = None,  # time.monotonic() cutoff: the task
+        deadline: float | None = None,  # clock.monotonic() cutoff: the task
         # is abandoned (DeadlineExpired) if the worker reaches it later
         task_class: str | None = None,  # wait-stat bucket, not passed to fn
         **kwargs,
@@ -217,7 +228,7 @@ class ComputeQueue:
             fn=functools.partial(fn, *args, **kwargs),
             fut=fut,
             deadline=deadline,
-            enqueued_at=time.monotonic(),
+            enqueued_at=clock.monotonic(),
             task_class=task_class,
         )
         self._queue.put_nowait((priority, next(self._seq), task))
@@ -246,7 +257,7 @@ class ComputeQueue:
             run_group=run_group,
             fut=fut,
             deadline=deadline,
-            enqueued_at=time.monotonic(),
+            enqueued_at=clock.monotonic(),
             task_class=task_class,
         )
         self._queue.put_nowait((priority, next(self._seq), task))
@@ -256,7 +267,7 @@ class ComputeQueue:
         loop = asyncio.get_running_loop()
         while True:
             _, _, task = await self._queue.get()
-            self._last_pop_at = time.monotonic()
+            self._last_pop_at = clock.monotonic()
             try:
                 if isinstance(task, _GroupTask):
                     await self._run_group(loop, task)
@@ -292,9 +303,24 @@ class ComputeQueue:
         window_s = float(env.get("BBTPU_BATCH_WINDOW_MS")) / 1000.0
         if window_s > 0 and len(members) < self.max_group:
             # hold the device for one short window: steps of other sessions
-            # in the same decode round are typically in flight right now
-            await asyncio.sleep(window_s)
-            self._gather(members, self.max_group - len(members))
+            # in the same decode round are typically in flight right now.
+            # Sliced, so a member landing mid-window joins at the next
+            # slice and the hold ends the moment the group provably cannot
+            # grow — group_hint() bounds the possible member count, so a
+            # full house dispatches at once instead of sleeping out the
+            # window (a solo session skips the hold entirely).
+            deadline = clock.monotonic() + window_s
+            while len(members) < self.max_group:
+                if (
+                    self.group_hint is not None
+                    and len(members) >= self.group_hint()
+                ):
+                    break
+                remaining = deadline - clock.monotonic()
+                if remaining <= 0:
+                    break
+                await clock.async_sleep(min(0.05, remaining))
+                self._gather(members, self.max_group - len(members))
         try:
             live = []
             for m in members:
@@ -374,7 +400,7 @@ class ComputeQueue:
             self._queue.put_nowait(entry)
 
     def _note_wait(self, task) -> None:
-        now = time.monotonic()
+        now = clock.monotonic()
         wait = now - task.enqueued_at
         self._waits.append((now, wait))
         if task.task_class is not None:
@@ -388,7 +414,7 @@ class ComputeQueue:
     def _expired(self, task) -> bool:
         # checked at execution time, not submit time: a deep queue behind
         # a slow step is exactly when expiry happens
-        if task.deadline is not None and time.monotonic() > task.deadline:
+        if task.deadline is not None and clock.monotonic() > task.deadline:
             if not task.fut.done():
                 task.fut.set_exception(
                     DeadlineExpired(

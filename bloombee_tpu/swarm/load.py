@@ -12,7 +12,8 @@ staleness-discounted reading of them, not three.
 from __future__ import annotations
 
 import math
-import time
+
+from bloombee_tpu.utils import clock
 
 LOAD_STALE_S = 30.0  # advert age at which the load term decays to zero
 LOAD_DELAY_CAP_S = 10.0  # hard cap on the load term: a garbage/hostile
@@ -55,7 +56,7 @@ def predicted_queue_delay_s(server_info, now: float | None = None) -> float:
     if not isinstance(load, dict):
         return 0.0
     if now is None:
-        now = time.time()
+        now = clock.now()
     ts = load.get("ts")
     if not isinstance(ts, (int, float)) or not math.isfinite(float(ts)):
         ts = getattr(server_info, "advert_stored_at", None)

@@ -10,7 +10,8 @@ with real measurements instead of a constant).
 from __future__ import annotations
 
 import asyncio
-import time
+
+from bloombee_tpu.utils import clock
 
 DEFAULT_RTT_S = 0.01  # used until a peer has been measured
 FAILED_RTT_S = 5.0  # unreachable peers look very expensive, not infinite
@@ -36,7 +37,7 @@ class PingAggregator:
         self._rtt[peer_id] = (
             rtt if old is None else old * (1 - self.alpha) + rtt * self.alpha
         )
-        self._measured_at[peer_id] = time.monotonic()
+        self._measured_at[peer_id] = clock.monotonic()
 
     def get(self, peer_id: str, default: float = DEFAULT_RTT_S) -> float:
         return self._rtt.get(peer_id, default)
@@ -53,12 +54,12 @@ class PingAggregator:
 
     def needs_measure(self, peer_id: str) -> bool:
         at = self._measured_at.get(peer_id)
-        return at is None or time.monotonic() - at > self.stale_after
+        return at is None or clock.monotonic() - at > self.stale_after
 
     def to_wire(self) -> dict[str, float]:
         """Fresh entries only; departed peers (never re-measured) are evicted
         so long-lived servers' announce payloads don't grow with churn."""
-        cutoff = time.monotonic() - 4 * self.stale_after
+        cutoff = clock.monotonic() - 4 * self.stale_after
         for pid in [
             p for p, at in self._measured_at.items() if at < cutoff
         ]:
@@ -74,21 +75,21 @@ class PingAggregator:
         Unreachable peers record FAILED_RTT_S (routing avoids, bans expire)."""
         from bloombee_tpu.wire.rpc import connect
 
-        t0 = time.perf_counter()
+        t0 = clock.perf_counter()
         try:
             conn = await asyncio.wait_for(connect(host, port), timeout)
             try:
                 # stamp AFTER connect: the NTP midpoint must halve only the
                 # rpc round trip, not the TCP handshake
-                t_call = time.perf_counter()
-                t_call_wall = time.time()
+                t_call = clock.perf_counter()
+                t_call_wall = clock.now()
                 meta, _ = await asyncio.wait_for(
                     conn.call("rpc_info", {}, []), timeout
                 )
-                call_rtt = time.perf_counter() - t_call
+                call_rtt = clock.perf_counter() - t_call
             finally:
                 await conn.close()
-            rtt = time.perf_counter() - t0
+            rtt = clock.perf_counter() - t0
             server_time = meta.get("server_time")
             if server_time is not None:
                 self._clock_offset[peer_id] = float(server_time) - (

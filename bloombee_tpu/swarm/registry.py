@@ -16,11 +16,14 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
-import time
 
 from bloombee_tpu.swarm.data import ModuleInfo, ServerInfo
+from bloombee_tpu.utils import clock
 from bloombee_tpu.wire.rpc import Connection, RpcServer, connect
+
+logger = logging.getLogger(__name__)
 
 
 class _Store:
@@ -45,13 +48,13 @@ class _Store:
         # announce/revoke sequence identically on every replica, so the
         # replicated merge is immune to cross-replica clock skew
         self._data.setdefault(key, {})[subkey] = (
-            value, expiration, time.time() if stored_at is None else stored_at,
+            value, expiration, clock.now() if stored_at is None else stored_at,
         )
 
     # --------------------------------------------------------- persistence
     def snapshot(self) -> list:
         """Live records (and tombstones) as a JSON-serializable list."""
-        now = time.time()
+        now = clock.now()
         return [
             {"key": k, "subkey": sk, "value": v, "expiration": exp,
              "stored_at": t}
@@ -61,7 +64,7 @@ class _Store:
         ]
 
     def load_snapshot(self, records: list) -> None:
-        now = time.time()
+        now = clock.now()
         for r in records:
             if r["expiration"] > now:
                 self._data.setdefault(r["key"], {})[r["subkey"]] = (
@@ -71,7 +74,7 @@ class _Store:
 
     def get(self, key: str) -> dict[str, tuple[dict | None, float]]:
         """subkey -> (value | None-for-tombstone, stored_at), expired pruned."""
-        now = time.time()
+        now = clock.now()
         out = {}
         sub = self._data.get(key)
         if not sub:
@@ -90,7 +93,7 @@ class _Store:
         self, key: str, subkey: str, ttl: float | None = None,
         stored_at: float | None = None,
     ):
-        now = time.time()
+        now = clock.now()
         self._data.setdefault(key, {})[subkey] = (
             None,
             now + (self.TOMBSTONE_TTL if ttl is None else ttl),
@@ -120,11 +123,17 @@ class RegistryServer:
         self.persist_path = persist_path
         self.persist_period = persist_period
         self._persist_task: asyncio.Task | None = None
+        # audited error swallows: persistence failures must not take down
+        # the discovery plane, but they must not be silent either —
+        # surfaced via rpc_info so `cli/health --probe` sees them
+        self.swallowed_errors = 0
+        self._swallow_logged: set[tuple[str, str]] = set()
         self.rpc = RpcServer(
             unary_handlers={
                 "registry_store": self._rpc_store,
                 "registry_get": self._rpc_get,
                 "registry_delete": self._rpc_delete,
+                "rpc_info": self._rpc_info,
             },
             host=host,
             port=port,
@@ -134,13 +143,27 @@ class RegistryServer:
     def port(self) -> int:
         return self.rpc.port
 
+    def _note_swallow(self, site: str, exc: Exception) -> None:
+        """Count a deliberately-survived error, warning once per
+        (site, exception type) so a persistent cause logs exactly one
+        line instead of one per period — or zero."""
+        self.swallowed_errors += 1
+        cause = (site, type(exc).__name__)
+        if cause not in self._swallow_logged:
+            self._swallow_logged.add(cause)
+            logger.warning(
+                "registry: %s failed (%s: %s) — continuing; counted in "
+                "registry_swallowed_errors", site, type(exc).__name__, exc,
+            )
+
     async def start(self):
         if self.persist_path and os.path.exists(self.persist_path):
             try:
                 with open(self.persist_path) as f:
                     self._store.load_snapshot(json.load(f))
-            except Exception:
-                pass  # a corrupt snapshot must not block bootstrap
+            except Exception as e:
+                # a corrupt snapshot must not block bootstrap
+                self._note_swallow("snapshot load", e)
         await self.rpc.start()
         if self.persist_path:
             self._persist_task = asyncio.create_task(self._persist_loop())
@@ -166,14 +189,18 @@ class RegistryServer:
 
     async def _persist_loop(self) -> None:
         while True:
-            await asyncio.sleep(self.persist_period)
+            await clock.async_sleep(self.persist_period)
             try:
                 await asyncio.to_thread(self._write_snapshot)
-            except Exception:
-                pass
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # a failed periodic write must not kill the loop (the
+                # next period retries), but it must be counted
+                self._note_swallow("snapshot write", e)
 
     async def _rpc_store(self, meta: dict, tensors):
-        now = time.time()
+        now = clock.now()
         for rec in meta["records"]:
             self._store.store(
                 rec["key"], rec["subkey"], rec["value"],
@@ -193,6 +220,16 @@ class RegistryServer:
                 }
                 for k in meta["keys"]
             }
+        }, []
+
+    async def _rpc_info(self, meta: dict, tensors):
+        """Probe endpoint (cli/health --probe reaches every advertised rpc
+        server with this): registry identity + the swallowed-error audit."""
+        return {
+            "kind": "registry",
+            "registry_swallowed_errors": self.swallowed_errors,
+            "keys": len(self._store._data),
+            "server_time": clock.now(),
         }, []
 
     async def _rpc_delete(self, meta: dict, tensors):
@@ -234,7 +271,7 @@ class RegistryClient:
     ) -> None:
         """reference: declare_active_modules (utils/dht.py:28-73)."""
         conn = await self._connection()
-        now = time.time()
+        now = clock.now()
         records = [
             {
                 "key": f"{model_uid}.{i}",
@@ -255,7 +292,7 @@ class RegistryClient:
         outlives any stale live record on a replica that missed the
         delete."""
         conn = await self._connection()
-        now = time.time()
+        now = clock.now()
         records = [
             {
                 "key": f"{model_uid}.{i}",
@@ -460,7 +497,7 @@ class InProcessRegistry:
 
     async def declare_blocks(self, model_uid, server_id, blocks, info,
                              expiration: float = 30.0):
-        now = time.time()
+        now = clock.now()
         for i in blocks:
             self._store.store(
                 f"{model_uid}.{i}", server_id, info.to_wire(), now + expiration

@@ -1,0 +1,165 @@
+"""Recovery-coverage ledger: proof that a chaos run tested something.
+
+A green chaos entry is only meaningful if faults actually fired AND
+recovery machinery actually ran — a run whose probabilistic plan happened
+to inject nothing (or whose injections never reached a recovery path)
+passes vacuously. Every injection site calls ``fault(name)`` and every
+recovery path calls ``recovery(name)``; the counters are process-wide,
+thread-safe, and dumped as one JSON line to ``BBTPU_CHAOS_LEDGER`` at
+interpreter exit (append mode — one line per process, merged by the
+reader). ``scripts/chaos.sh`` fails any matrix entry whose merged ledger
+shows zero faults or zero recoveries.
+
+Registered point names (the coverage vocabulary — grep for callers):
+
+faults
+  ``wire.delay|reset|close|stall|drop|corrupt|partition`` — FaultPlan
+  injections per action; ``wire.scheduled.<action>`` — FaultSchedule
+  firings; ``server.crash`` — hard process-crash via BlockServer.crash().
+
+recoveries
+  ``client.reroute_replay`` — session failover onto a new chain with
+  history replay; ``client.ban`` / ``client.overload_backoff`` /
+  ``client.quarantine`` — peer penalty classes; ``server.resume_dedup``
+  — duplicate step suppressed on session resume; ``server.rollback_solo_replay``
+  — batched dispatch failure isolated by solo replay;
+  ``server.lease_park`` / ``server.lease_reap`` — disconnected session
+  parked / force-expired; ``server.promotion`` — standby promoted to
+  serving; ``server.rebalance_reannounce`` — measured-load rebalance
+  re-announced a new span.
+
+With no ledger path configured the counters still accumulate in memory
+(tests read ``snapshot()`` directly) and nothing is written.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import threading
+
+from bloombee_tpu.utils import env
+
+env.declare(
+    "BBTPU_CHAOS_LEDGER", str, "",
+    "path to append this process's fault/recovery coverage ledger to at "
+    "exit (one JSON line per process); empty = in-memory only. Set by "
+    "scripts/chaos.sh so the gate can fail entries that tested nothing",
+)
+
+_lock = threading.Lock()
+_faults: collections.Counter = collections.Counter()
+_recoveries: collections.Counter = collections.Counter()
+_atexit_registered = False
+
+
+def _ensure_atexit() -> None:
+    global _atexit_registered
+    if not _atexit_registered:
+        _atexit_registered = True
+        if env.get("BBTPU_CHAOS_LEDGER"):
+            atexit.register(flush)
+
+
+def fault(name: str, n: int = 1) -> None:
+    """Record an injected fault at a named point."""
+    with _lock:
+        _faults[name] += n
+    _ensure_atexit()
+
+
+def recovery(name: str, n: int = 1) -> None:
+    """Record an exercised recovery path at a named point."""
+    with _lock:
+        _recoveries[name] += n
+    _ensure_atexit()
+
+
+def snapshot() -> dict:
+    with _lock:
+        return {
+            "faults": dict(_faults),
+            "recoveries": dict(_recoveries),
+        }
+
+
+def reset() -> None:
+    with _lock:
+        _faults.clear()
+        _recoveries.clear()
+
+
+def flush(path: str | None = None) -> None:
+    """Append this process's ledger as one JSON line (atexit hook; also
+    callable directly by harnesses that outlive their chaos phase)."""
+    path = path or env.get("BBTPU_CHAOS_LEDGER")
+    if not path:
+        return
+    snap = snapshot()
+    if not snap["faults"] and not snap["recoveries"]:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(snap, sort_keys=True) + "\n")
+    except OSError:  # ledger must never take down the process it audits
+        pass
+
+
+def merge_lines(text: str) -> dict:
+    """Merge a multi-process ledger file (one JSON line each) into one
+    {"faults": {...}, "recoveries": {...}} dict — the reader half of the
+    chaos.sh gate."""
+    faults: collections.Counter = collections.Counter()
+    recoveries: collections.Counter = collections.Counter()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            snap = json.loads(line)
+        except ValueError:
+            continue
+        faults.update(snap.get("faults") or {})
+        recoveries.update(snap.get("recoveries") or {})
+    return {"faults": dict(faults), "recoveries": dict(recoveries)}
+
+
+def _main(argv=None) -> int:
+    """``python -m bloombee_tpu.utils.ledger PATH [--require]``: merge and
+    print a ledger file; with --require, exit 1 unless it shows at least
+    one fault AND one recovery (the chaos.sh vacuous-green gate)."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(description=_main.__doc__)
+    ap.add_argument("path")
+    ap.add_argument("--require", action="store_true",
+                    help="fail (exit 1) on an empty half of the ledger")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            text = f.read()
+    except OSError:
+        text = ""
+    merged = merge_lines(text)
+    n_f = sum(merged["faults"].values())
+    n_r = sum(merged["recoveries"].values())
+    print(f"ledger: {n_f} fault(s), {n_r} recovery(ies)")
+    for kind in ("faults", "recoveries"):
+        for name, n in sorted(merged[kind].items()):
+            print(f"  {kind[:-1] if kind == 'faults' else 'recovery'} "
+                  f"{name}={n}")
+    if args.require and (n_f == 0 or n_r == 0):
+        print(
+            "ledger: EMPTY — a chaos entry must observe >=1 injected fault "
+            "and >=1 exercised recovery path; a run that injected nothing "
+            "(or whose injections never reached recovery machinery) is a "
+            "vacuous green", file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

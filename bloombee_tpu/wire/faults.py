@@ -46,7 +46,7 @@ from typing import Callable, Optional
 import ml_dtypes
 import numpy as np
 
-from bloombee_tpu.utils import env
+from bloombee_tpu.utils import env, ledger
 
 logger = logging.getLogger(__name__)
 
@@ -86,6 +86,15 @@ env.declare(
     "per-frame probability of corrupting a span-output reply tensor "
     "in-flight (well-formed frame, wrong numbers); only the integrity "
     "layer can detect it, so pair with BBTPU_INTEGRITY=1",
+)
+env.declare(
+    "BBTPU_CHAOS_SCHEDULE", str, "",
+    "scripted deterministic faults: ';'-separated STEP:ACTION[:PORT] "
+    "entries, e.g. '3:reset;7:partition:7711' — at the Nth span-output "
+    "decode-step reply (per entry, counted over frames matching the "
+    "entry's PORT filter), fire the wire ACTION exactly once. Works with "
+    "BBTPU_CHAOS=0 (a schedule alone arms the plan). The 'crash' action "
+    "is in-process only (needs a bound callback) and is rejected here",
 )
 
 
@@ -137,13 +146,116 @@ class FaultRule:
         return True
 
 
+@dataclasses.dataclass
+class ScheduledFault:
+    """One scripted fault: "at decode step N, do X". Unlike a FaultRule
+    (which matches frame shapes, possibly probabilistically), a scheduled
+    fault counts *span-output decode-step replies* — the swarm's logical
+    clock — so a test can script "crash server B at step 3" and assert the
+    exact recovery sequence that follows, bit-for-bit, run after run.
+
+    ``action`` is any wire action ("delay"/"reset"/"close"/"stall"/"drop"/
+    "partition"/"corrupt") or ``"crash"`` — a hard process-death of the
+    server named by ``target``, delivered via a callback the test harness
+    binds with FaultSchedule.bind_crash (env schedules cannot express it).
+    ``port`` filters which peer's replies advance this entry's counter."""
+
+    at_step: int  # 1-based index among this entry's matching replies
+    action: str
+    port: int | None = None  # count only replies to/from this peer port
+    target: str | None = None  # crash only: bind_crash() callback name
+    delay_s: float = 0.05  # delay action only
+    fired: bool = dataclasses.field(default=False, repr=False)
+    _seen: int = dataclasses.field(default=0, repr=False)
+
+
+class FaultSchedule:
+    """Ordered scripted faults, consulted by the plan on every span-output
+    reply frame BEFORE the probabilistic rules. Each entry keeps its own
+    step counter, so two entries with different port filters tick
+    independently. Fired entries never re-fire."""
+
+    def __init__(self, faults: list[ScheduledFault] | None = None,
+                 site: str = "send"):
+        # steps are counted at ONE site only: in-process swarms share a
+        # single plan between client and server connections, and counting
+        # a reply at both its send AND its read would tick every entry
+        # twice per step. "send" (the server emitting the reply) is the
+        # default; a client-process-only deployment can count at "read".
+        self.site = site
+        self.faults = list(faults or [])
+        self._crash_cbs: dict[str, Callable[[], None]] = {}
+        # observability: tests assert exactly which steps faulted
+        self.log: list[tuple[int, str, str | int | None]] = []
+
+    def add(self, fault: ScheduledFault) -> "FaultSchedule":
+        self.faults.append(fault)
+        return self
+
+    def bind_crash(self, name: str, cb: Callable[[], None]) -> "FaultSchedule":
+        """Bind a crash target: ``cb`` (typically BlockServer.crash) runs
+        when an entry with action='crash', target=name comes due."""
+        self._crash_cbs[name] = cb
+        return self
+
+    def pending(self) -> list[ScheduledFault]:
+        return [f for f in self.faults if not f.fired]
+
+    def due(self, peer: tuple | None) -> list[ScheduledFault]:
+        """Advance every live entry's counter by this one matching reply
+        frame; return the entries that just came due (usually 0 or 1)."""
+        out = []
+        for f in self.faults:
+            if f.fired:
+                continue
+            if f.port is not None and (peer is None or peer[1] != f.port):
+                continue
+            f._seen += 1
+            if f._seen >= f.at_step:
+                f.fired = True
+                out.append(f)
+        return out
+
+    @classmethod
+    def from_env(cls) -> "FaultSchedule | None":
+        """Parse BBTPU_CHAOS_SCHEDULE ('STEP:ACTION[:PORT];...'); None when
+        unset. Rejects 'crash' loudly — a process death needs an in-process
+        bound callback, which no env string can carry."""
+        spec = str(env.get("BBTPU_CHAOS_SCHEDULE")).strip()
+        if not spec:
+            return None
+        faults = []
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            parts = [p.strip() for p in entry.split(":")]
+            if len(parts) < 2:
+                raise ValueError(
+                    f"BBTPU_CHAOS_SCHEDULE entry {entry!r}: want "
+                    "STEP:ACTION[:PORT]"
+                )
+            action = parts[1]
+            if action == "crash":
+                raise ValueError(
+                    "BBTPU_CHAOS_SCHEDULE cannot script 'crash': it needs "
+                    "an in-process FaultSchedule.bind_crash() callback"
+                )
+            faults.append(ScheduledFault(
+                at_step=int(parts[0]), action=action,
+                port=int(parts[2]) if len(parts) > 2 else None,
+            ))
+        return cls(faults)
+
+
 class FaultPlan:
     """Seeded, ordered rule set consulted by every Connection."""
 
     def __init__(self, rules: list[FaultRule] | None = None,
-                 seed: int = 0):
+                 seed: int = 0, schedule: FaultSchedule | None = None):
         self.rules = list(rules or [])
         self.rng = random.Random(seed)
+        self.schedule = schedule
         # observability: tests assert exactly which faults landed
         self.log: list[tuple[str, str, dict]] = []
 
@@ -166,10 +278,19 @@ class FaultPlan:
         to silently discard the frame (partition)."""
         if getattr(conn, "_bbtpu_partitioned", False):
             return "drop"
+        if (
+            self.schedule is not None
+            and self.schedule.site == "send"
+            and _is_span_output_reply(header)
+        ):
+            verdict = await self._fire_scheduled("send", conn, header, blobs)
+            if verdict is not None:
+                return verdict
         rule = self._pick("send", conn.peer, header)
         if rule is None:
             return None
         self.log.append(("send", rule.action, dict(header)))
+        ledger.fault(f"wire.{rule.action}")
         if rule.action == "partition":
             self._partition(conn)
             return "drop"
@@ -184,10 +305,19 @@ class FaultPlan:
         before dispatch. Returns "drop" to swallow the frame."""
         if getattr(conn, "_bbtpu_partitioned", False):
             return "drop"
+        if (
+            self.schedule is not None
+            and self.schedule.site == "read"
+            and _is_span_output_reply(header)
+        ):
+            verdict = await self._fire_scheduled("read", conn, header, None)
+            if verdict is not None:
+                return verdict
         rule = self._pick("read", conn.peer, header)
         if rule is None:
             return None
         self.log.append(("read", rule.action, dict(header)))
+        ledger.fault(f"wire.{rule.action}")
         if rule.action == "partition":
             self._partition(conn)
             return "drop"
@@ -204,6 +334,50 @@ class FaultPlan:
             await self._kill(conn, abort=rule.action == "reset")
             return "drop"
         return None
+
+    async def _fire_scheduled(self, site: str, conn, header: dict,
+                              blobs: list | None) -> str | None:
+        """Apply every scheduled fault due at this span-output reply.
+        Returns "drop" to discard the frame, None to let it proceed.
+        Scheduled "stall"/"drop" both swallow the reply (the deterministic
+        harness must never hang a writer on a wall-clock wait); "crash"
+        runs the bound callback and drops the in-flight reply — it dies
+        with the server, exactly like a mid-step kill -9."""
+        verdict = None
+        for f in self.schedule.due(conn.peer):
+            self.schedule.log.append((f._seen, f.action, f.target or f.port))
+            self.log.append((site, f"scheduled.{f.action}", dict(header)))
+            logger.info(
+                "chaos: scheduled %s at decode step %d (peer %s)",
+                f.action, f._seen, conn.peer,
+            )
+            if f.action == "crash":
+                cb = self.schedule._crash_cbs.get(f.target or "")
+                if cb is None:
+                    raise RuntimeError(
+                        f"scheduled crash target {f.target!r} has no "
+                        "bound callback (FaultSchedule.bind_crash)"
+                    )
+                # crash() itself ledgers the server.crash fault
+                cb()
+                verdict = "drop"
+                continue
+            ledger.fault(f"wire.scheduled.{f.action}")
+            if f.action == "partition":
+                self._partition(conn)
+                verdict = "drop"
+            elif f.action == "corrupt":
+                self._corrupt(header, blobs)
+            elif f.action == "delay":
+                await asyncio.sleep(f.delay_s)
+            elif f.action in ("stall", "drop"):
+                verdict = "drop"
+            elif f.action in ("reset", "close"):
+                await self._kill(conn, abort=f.action == "reset")
+                raise InjectedFault(f"injected scheduled {f.action}")
+            else:
+                raise ValueError(f"unknown scheduled action {f.action!r}")
+        return verdict
 
     async def _apply(self, conn, rule: FaultRule, header: dict) -> None:
         if rule.action == "delay":
@@ -287,11 +461,15 @@ class FaultPlan:
 
     @classmethod
     def from_env(cls) -> "FaultPlan | None":
-        """Build a probabilistic plan from the BBTPU_CHAOS_* knobs; None
-        when chaos is off."""
-        if not env.get("BBTPU_CHAOS"):
+        """Build a plan from the BBTPU_CHAOS_* knobs; None when chaos is
+        off. A BBTPU_CHAOS_SCHEDULE alone arms the plan (deterministic
+        scripts should not require enabling the probabilistic machinery)."""
+        schedule = FaultSchedule.from_env()
+        if not env.get("BBTPU_CHAOS") and schedule is None:
             return None
-        plan = cls(seed=env.get("BBTPU_CHAOS_SEED"))
+        plan = cls(seed=env.get("BBTPU_CHAOS_SEED"), schedule=schedule)
+        if not env.get("BBTPU_CHAOS"):
+            return plan
         delay_p = env.get("BBTPU_CHAOS_DELAY_P")
         if delay_p > 0:
             plan.add(FaultRule(

@@ -17,13 +17,12 @@ import asyncio
 import itertools
 import logging
 import struct
-import time
 from typing import Awaitable, Callable
 
 import msgpack
 import numpy as np
 
-from bloombee_tpu.utils import env
+from bloombee_tpu.utils import clock, env
 from bloombee_tpu.wire import faults
 from bloombee_tpu.wire.tensor_codec import (
     deserialize_tensors,
@@ -191,7 +190,7 @@ class Connection:
             env.get("BBTPU_KEEPALIVE_S") if keepalive_s is None
             else keepalive_s
         )
-        self.last_recv = time.monotonic()
+        self.last_recv = clock.monotonic()
         self.keepalives_sent = 0
         self._keepalive_task: asyncio.Task | None = None
 
@@ -339,8 +338,8 @@ class Connection:
         interval = self.keepalive_s
         try:
             while not self._closed.is_set():
-                await asyncio.sleep(interval / 2)
-                idle = time.monotonic() - self.last_recv
+                await clock.async_sleep(interval / 2)
+                idle = clock.monotonic() - self.last_recv
                 if idle >= 2.5 * interval:
                     logger.warning(
                         "keepalive timeout after %.2fs silence from %s",
@@ -375,7 +374,7 @@ class Connection:
                     act = await self.fault_plan.on_read(self, header)
                     if act == "drop":
                         continue  # injected stall/loss: frame never arrives
-                self.last_recv = time.monotonic()
+                self.last_recv = clock.monotonic()
                 self._dispatch(header, blobs)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
@@ -588,6 +587,17 @@ class RpcServer:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+
+    def abort(self) -> None:
+        """Hard-kill (crash fault injection): abort every live
+        connection's transport — no close frame, no FIN handshake, every
+        pending call on the peer side fails exactly like a process death
+        — and close the listener without waiting for it."""
+        for c in list(self._conns):
+            c.abort("server crashed")
+        if self._server is not None:
+            self._server.close()
+            self._server = None
 
 
 async def connect(

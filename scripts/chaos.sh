@@ -24,6 +24,14 @@
 #                Forces BBTPU_INTEGRITY=1: only the client integrity layer
 #                (out_digest + sanity gate) can see this fault class, and
 #                the suite must stay green + token-identical through it
+#   TESTS        comma-separated test-file list for this entry (default:
+#                the whole chaos-marked suite). Feature entries target the
+#                files that actually exercise their flags — the per-entry
+#                recovery-coverage ledger proves each one still injected
+#                faults AND ran recovery machinery (no vacuous greens),
+#                while the broad first entry keeps whole-suite ambient
+#                coverage. Replaying all ~22 chaos tests five times bought
+#                nothing the ledger can't prove more cheaply
 # Fixed seeds keep every run replayable bit-for-bit (wire/faults.py
 # contract).
 # Exits 0 when pytest is unavailable (mirrors scripts/lint.sh).
@@ -39,20 +47,29 @@ fi
 # is budgeted: independent feature flags share an entry instead of each
 # getting their own, keeping the tier-1 gate inside its wall-clock cap
 # while every flag still runs under ambient chaos.
+# Persistent XLA compilation cache shared by the matrix entries: every
+# entry replays the same tiny-model shapes in a fresh python process, and
+# recompiling them once per entry dominated the gate's wall clock.
+# Entries 2..N hit entry 1's cache instead. Correctness-neutral (XLA keys
+# on HLO + compile options) and deliberately NOT part of the printed
+# reproduction line — it is a perf knob, not part of the failure recipe.
+compile_cache="$(mktemp -d "${TMPDIR:-/tmp}/bbtpu-chaos-xla.XXXXXX")"
+trap 'rm -rf "${compile_cache}"' EXIT
+
 MATRIX=(
     "SEED=23 DELAY_P=0.1"
-    "SEED=43 DELAY_P=0.02 PARTITION_P=0.02"
-    "SEED=57 DELAY_P=0.05 MIXED=1 SPEC=1"
-    "SEED=83 DELAY_P=0.05 ADMIT=1 REBALANCE=1"
-    "SEED=97 DELAY_P=0.02 CORRUPT=0.05"
+    "SEED=43 DELAY_P=0.02 PARTITION_P=0.02 TESTS=tests/test_session_lease.py,tests/test_chaos.py,tests/test_kv_replication.py"
+    "SEED=57 DELAY_P=0.05 MIXED=1 SPEC=1 TESTS=tests/test_mixed_batch.py,tests/test_spec_decode.py,tests/test_batched_decode.py,tests/test_chunked_prefill.py"
+    "SEED=83 DELAY_P=0.05 ADMIT=1 REBALANCE=1 TESTS=tests/test_chaos.py,tests/test_promotion.py,tests/test_kv_replication.py,tests/test_prefix_cache.py"
+    "SEED=97 DELAY_P=0.02 CORRUPT=0.05 TESTS=tests/test_chaos.py,tests/test_session_lease.py,tests/test_kv_replication.py"
 )
 for entry in "${MATRIX[@]}"; do
     # per-entry defaults; each entry overrides only what it varies
     SEED=0 DELAY_P=0 ADMIT=0 PARTITION_P=0 MIXED=0 SPEC=0 REBALANCE=0
-    CORRUPT=0
+    CORRUPT=0 TESTS=tests/
     for tok in ${entry}; do
         case "${tok%%=*}" in
-            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT)
+            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|TESTS)
                 declare "${tok}" ;;
             *)
                 echo "chaos: unknown matrix token '${tok}'" >&2
@@ -80,23 +97,52 @@ for entry in "${MATRIX[@]}"; do
     if [ "${CORRUPT}" != "0" ]; then
         integrity=1
     fi
+    # the full derived environment in one line: the run below uses it, and
+    # a red entry reprints it verbatim so "reproduce this failure" is a
+    # single copy-paste (matrix tokens alone hide the derived knobs)
+    env_line="JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+BBTPU_CHAOS=1 \
+BBTPU_CHAOS_SEED=${SEED} \
+BBTPU_CHAOS_DELAY_P=${DELAY_P} \
+BBTPU_CHAOS_DELAY_S=0.02 \
+BBTPU_CHAOS_PARTITION_P=${PARTITION_P} \
+BBTPU_CHAOS_CORRUPT_P=${CORRUPT} \
+BBTPU_INTEGRITY=${integrity} \
+BBTPU_KEEPALIVE_S=${keepalive_s} \
+BBTPU_ADMIT=${ADMIT} \
+BBTPU_ADMIT_HIGH_MS=400 \
+BBTPU_MIXED_BATCH=${MIXED} \
+BBTPU_SPEC_BATCH=${SPEC} \
+BBTPU_MEASURED_REBALANCE=${REBALANCE} \
+BBTPU_PROMOTE_HIGH_MS=${promote_high_ms} \
+BBTPU_PROMOTE_SUSTAIN_S=${promote_sustain_s}"
+    # recovery-coverage ledger: every in-process fault/recovery point
+    # appends here at interpreter exit; an entry that tested nothing
+    # (zero faults or zero recoveries) fails the gate even if pytest
+    # went green — a vacuous pass is a gate bug, not a pass
+    ledger_file="$(mktemp "${TMPDIR:-/tmp}/bbtpu-chaos-ledger.XXXXXX")"
     echo "chaos: ${entry}" >&2
-    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    BBTPU_CHAOS=1 \
-    BBTPU_CHAOS_SEED="${SEED}" \
-    BBTPU_CHAOS_DELAY_P="${DELAY_P}" \
-    BBTPU_CHAOS_DELAY_S=0.02 \
-    BBTPU_CHAOS_PARTITION_P="${PARTITION_P}" \
-    BBTPU_CHAOS_CORRUPT_P="${CORRUPT}" \
-    BBTPU_INTEGRITY="${integrity}" \
-    BBTPU_KEEPALIVE_S="${keepalive_s}" \
-    BBTPU_ADMIT="${ADMIT}" \
-    BBTPU_ADMIT_HIGH_MS=400 \
-    BBTPU_MIXED_BATCH="${MIXED}" \
-    BBTPU_SPEC_BATCH="${SPEC}" \
-    BBTPU_MEASURED_REBALANCE="${REBALANCE}" \
-    BBTPU_PROMOTE_HIGH_MS="${promote_high_ms}" \
-    BBTPU_PROMOTE_SUSTAIN_S="${promote_sustain_s}" \
-    python -m pytest tests/ -q -m chaos \
-        -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+    entry_start=${SECONDS}
+    rc=0
+    test_targets="${TESTS//,/ }"
+    env ${env_line} BBTPU_CHAOS_LEDGER="${ledger_file}" \
+        JAX_COMPILATION_CACHE_DIR="${compile_cache}" \
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0.5 \
+        python -m pytest ${test_targets} -q -m chaos \
+        -p no:cacheprovider -p no:xdist -p no:randomly "$@" || rc=$?
+    if [ "${rc}" -eq 0 ]; then
+        python -m bloombee_tpu.utils.ledger "${ledger_file}" --require \
+            >&2 || rc=$?
+    fi
+    elapsed=$(( SECONDS - entry_start ))
+    if [ "${rc}" -ne 0 ]; then
+        echo "chaos: RED entry '${entry}' after ${elapsed}s" >&2
+        echo "chaos: reproduce with:" >&2
+        echo "  ${env_line} python -m pytest ${test_targets} -q -m chaos" \
+             "-p no:cacheprovider -p no:xdist -p no:randomly" >&2
+        rm -f "${ledger_file}"
+        exit "${rc}"
+    fi
+    echo "chaos: entry '${entry}' green in ${elapsed}s" >&2
+    rm -f "${ledger_file}"
 done

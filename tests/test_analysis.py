@@ -1,5 +1,5 @@
 """bbtpu-lint (bloombee_tpu/analysis): one true-positive and one
-true-negative fixture per rule BB001-BB006, plus suppression and
+true-negative fixture per rule BB001-BB008, plus suppression and
 baseline mechanics. Fixtures run through `analyze_source` on in-memory
 sources, so these tests never depend on the live tree's findings."""
 
@@ -309,6 +309,63 @@ def test_bb007_scoped_to_client_server_paths():
     # test helpers asserting exactness on purpose live outside the
     # verification paths and stay quiet
     assert codes(BB007_TP, path="bloombee_tpu/kv/mod.py") == []
+
+
+# ------------------------------------------------------------------ BB008
+BB008_TP = """
+    import time
+    import time as _time
+
+    def reap(sessions, lease_s):
+        cutoff = time.monotonic() - lease_s
+        time.sleep(0.1)
+        return [s for s in sessions if s.t < cutoff], _time.time()
+"""
+
+BB008_TN = """
+    import time
+    from bloombee_tpu.utils import clock
+
+    def measure(sessions, lease_s):
+        t0 = time.perf_counter()
+        cutoff = clock.monotonic() - lease_s
+        clock.sleep(0.1)
+        live = [s for s in sessions if s.t >= cutoff]
+        return live, time.perf_counter() - t0
+"""
+
+BB008_FROM_IMPORT = """
+    from time import monotonic
+
+    def stamp():
+        return monotonic()
+"""
+
+
+def test_bb008_true_positive():
+    # every banned call fires, through the bare alias AND the `as _time`
+    # alias — the rename idiom must not dodge the rule
+    assert codes(BB008_TP, path=SERVER) == ["BB008", "BB008", "BB008"]
+
+
+def test_bb008_true_negative():
+    # clock.* calls and perf_counter duration measurement are the
+    # sanctioned idioms; neither fires
+    assert codes(BB008_TN, path=SERVER) == []
+
+
+def test_bb008_flags_from_import():
+    # `from time import monotonic` escapes the virtual clock as a bare
+    # callable; the import itself is the finding (the call site no longer
+    # mentions `time` at all)
+    assert codes(BB008_FROM_IMPORT, path=SERVER) == ["BB008"]
+
+
+def test_bb008_exempts_clock_module_and_harness_code():
+    # utils/clock.py IS the real-time boundary; bench.py is an
+    # out-of-package harness that reports wall time on purpose
+    assert codes(BB008_TP, path="bloombee_tpu/utils/clock.py") == []
+    assert codes(BB008_TP, path="bench.py") == []
 
 
 # ------------------------------------------------- suppressions & baseline

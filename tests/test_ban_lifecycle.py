@@ -16,6 +16,19 @@ from bloombee_tpu.client.sequence_manager import (
     RemoteSequenceManager,
 )
 from bloombee_tpu.swarm.data import RemoteSpanInfo, ServerInfo, ServerState
+from bloombee_tpu.utils import clock
+from bloombee_tpu.utils.clock import SteppableClock
+
+
+@pytest.fixture
+def stepper():
+    """Hand-stepped process clock: the manager's ban/probe state machine
+    reads clock.monotonic(), so tests advance virtual time instead of
+    sleeping — identical transitions, zero wall-clock waits."""
+    c = SteppableClock()
+    prev = clock.install(c)
+    yield c
+    clock.install(prev)
 
 
 def _span(peer_id, start, end, **info_kw):
@@ -61,14 +74,14 @@ def test_ban_backoff_doubles_with_jitter_and_caps():
     assert m._bans["a"].strikes == 1
 
 
-def test_half_open_probe_admits_one_route():
+def test_half_open_probe_admits_one_route(stepper):
     m = _manager(ban_timeout=0.05, ban_max=0.05)
     m.spans = {"a": _span("a", 0, 2), "b": _span("b", 0, 2)}
     m.ban_peer("a")
-    now = time.monotonic()
+    now = clock.monotonic()
     assert m._ban_excludes("a", now)  # still banned
-    time.sleep(0.08)
-    now = time.monotonic()
+    stepper.advance(0.08)
+    now = clock.monotonic()
     # ban expired: the FIRST caller becomes the half-open trial...
     assert not m._ban_excludes("a", now)
     assert m._bans["a"].probing
@@ -77,33 +90,33 @@ def test_half_open_probe_admits_one_route():
     # trial succeeds -> fully re-admitted everywhere
     m.note_peer_ok("a")
     assert "a" not in m._bans
-    assert not m._ban_excludes("a", time.monotonic())
+    assert not m._ban_excludes("a", clock.monotonic())
 
 
-def test_probe_lease_expires_so_peer_is_not_stuck():
+def test_probe_lease_expires_so_peer_is_not_stuck(stepper):
     """If the trial route never resolves (client went away mid-probe), the
     probe lease expires and the next route re-probes instead of the peer
     being excluded forever."""
     m = _manager(ban_timeout=0.01, ban_max=0.01)
     m.ban_peer("a")
-    time.sleep(0.02)
-    assert not m._ban_excludes("a", time.monotonic())  # trial 1
+    stepper.advance(0.02)
+    assert not m._ban_excludes("a", clock.monotonic())  # trial 1
     st = m._bans["a"]
-    assert st.probing and st.probe_until > time.monotonic()
-    st.probe_until = time.monotonic() - 1.0  # the trial went silent
-    assert not m._ban_excludes("a", time.monotonic())  # trial renewed
-    assert st.probe_until > time.monotonic()
+    assert st.probing and st.probe_until > clock.monotonic()
+    st.probe_until = clock.monotonic() - 1.0  # the trial went silent
+    assert not m._ban_excludes("a", clock.monotonic())  # trial renewed
+    assert st.probe_until > clock.monotonic()
 
 
-def test_probe_failure_rebans_with_next_doubling():
+def test_probe_failure_rebans_with_next_doubling(stepper):
     m = _manager(ban_timeout=0.05, ban_max=10.0)
     m.ban_peer("a")
-    time.sleep(0.08)
-    assert not m._ban_excludes("a", time.monotonic())  # half-open trial
+    stepper.advance(0.08)
+    assert not m._ban_excludes("a", clock.monotonic())  # half-open trial
     m.ban_peer("a")  # the trial failed
     st = m._bans["a"]
     assert st.strikes == 2 and not st.probing
-    remaining = st.banned_until - time.monotonic()
+    remaining = st.banned_until - clock.monotonic()
     assert 0.05 * 2 * 0.74 <= remaining <= 0.05 * 2 * 1.25 + 0.01
 
 

@@ -26,7 +26,7 @@ def test_matrix_entries_are_keyval_tokens():
     assert len(entries) >= 5, f"matrix lost entries: {entries}"
     known = {
         "SEED", "DELAY_P", "ADMIT", "PARTITION_P", "MIXED", "SPEC",
-        "REBALANCE", "CORRUPT",
+        "REBALANCE", "CORRUPT", "TESTS",
     }
     for entry in entries:
         for tok in entry.split():
@@ -37,8 +37,56 @@ def test_matrix_entries_are_keyval_tokens():
     assert any("CORRUPT=" in e for e in entries), (
         "no Byzantine corruption entry in the chaos matrix"
     )
-    assert 'BBTPU_INTEGRITY="${integrity}"' in src
-    assert 'BBTPU_CHAOS_CORRUPT_P="${CORRUPT}"' in src
+    # at least one BROAD entry must replay the whole chaos-marked suite:
+    # targeted feature entries (TESTS=...) keep the gate inside its wall
+    # budget, but whole-suite ambient coverage must never disappear
+    assert any("TESTS=" not in e for e in entries), (
+        "every matrix entry is targeted; no broad whole-suite entry left"
+    )
+    # targeted entries must name real files (a typo would silently select
+    # nothing and the ledger gate would flag it only at run time)
+    for entry in entries:
+        for tok in entry.split():
+            if tok.startswith("TESTS="):
+                for f in tok[len("TESTS="):].split(","):
+                    assert (REPO / f).is_file(), (
+                        f"matrix entry {entry!r} targets missing file {f}"
+                    )
+    assert "BBTPU_INTEGRITY=${integrity}" in src
+    assert "BBTPU_CHAOS_CORRUPT_P=${CORRUPT}" in src
+
+
+def test_gate_requires_nonvacuous_ledger():
+    """Every matrix entry must run under a recovery-coverage ledger and
+    fail when the merged ledger shows zero faults or zero recoveries: a
+    probabilistic plan that happened to inject nothing (or whose
+    injections never reached recovery machinery) is a vacuous green, and
+    the gate's whole point is that green means 'recovery ran'."""
+    src = (REPO / "scripts" / "chaos.sh").read_text()
+    assert "BBTPU_CHAOS_LEDGER=" in src, "entries run without a ledger"
+    assert "bbtpu-chaos-ledger" in src and "mktemp" in src, (
+        "ledger file is not per-entry (entries would bleed coverage "
+        "into each other)"
+    )
+    assert re.search(
+        r"python -m bloombee_tpu\.utils\.ledger .*--require", src
+    ), "gate never checks the ledger with --require"
+
+
+def test_red_entry_prints_full_reproduction_line():
+    """A red entry must print a single copy-pasteable reproduction line:
+    the complete derived environment (not just the matrix tokens — those
+    hide keepalive/integrity/promotion knobs derived from them) plus the
+    exact pytest invocation, and the per-entry wall time."""
+    src = (REPO / "scripts" / "chaos.sh").read_text()
+    assert "reproduce with:" in src
+    # the repro line reuses the same env_line the run used — it cannot
+    # drift from reality
+    assert src.count("env_line=") == 1
+    assert re.search(r"echo\s+\"\s+\$\{env_line\} python -m pytest", src), (
+        "repro line does not print the derived environment"
+    )
+    assert "${elapsed}s" in src, "per-entry wall time missing from gate log"
 
 
 def test_chaos_suite_under_seed_matrix():

@@ -1,0 +1,332 @@
+"""Scripted fault schedules (PR 13): "at decode step N, do X".
+
+The probabilistic chaos plan answers "does the swarm survive random
+abuse?"; a FaultSchedule answers the sharper question "after THIS fault
+at THIS step, does EXACTLY this recovery sequence run?". Unit tests pin
+the step-counting contract (span-output replies only, per-entry counters,
+port filters, exactly-once firing, ledger records); the e2e scripts a
+hard server crash at decode step 4 and requires crash -> standby
+promotion -> client reroute+replay with the final generation
+token-identical to HF greedy — zero hard failures, run after run.
+"""
+
+import asyncio
+import types
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bloombee_tpu.client.config import ClientConfig
+from bloombee_tpu.client.model import DistributedModelForCausalLM
+from bloombee_tpu.server.block_server import BlockServer
+from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+from bloombee_tpu.utils import ledger
+from bloombee_tpu.wire import faults, tensor_codec
+from bloombee_tpu.wire.faults import (
+    FaultPlan,
+    FaultSchedule,
+    InjectedFault,
+    ScheduledFault,
+)
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_plan():
+    yield
+    faults.set_plan(None)
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=3,
+        vocab_size=128,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(7)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = tmp_path_factory.mktemp("tiny_llama_sched")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model, config
+
+
+def _span_output_frame(arr=None):
+    """A frame shaped like a server step reply: "sitem" with tensor metas
+    and compute timing in the meta — the swarm's logical clock tick."""
+    if arr is None:
+        arr = np.ones((1, 2, 4), np.float32)
+    m, b = tensor_codec.serialize_tensor(arr, compression=True)
+    header = {
+        "t": "sitem", "id": 7,
+        "meta": {"t_compute_ms": 1.0},
+        "tm": [m.to_wire()],
+    }
+    return header, [b]
+
+
+def _conn(port=7000):
+    return types.SimpleNamespace(peer=("127.0.0.1", port))
+
+
+# ------------------------------------------------------- step counting
+def test_schedule_counts_only_span_output_replies():
+    """Control frames (acks, opens, client requests) must not tick the
+    step counter — only span-output replies are decode steps."""
+    plan = FaultPlan(schedule=FaultSchedule(
+        [ScheduledFault(at_step=2, action="drop")]
+    ))
+
+    async def run():
+        # non-step frames: no tensor metas / no compute stamp
+        for header in (
+            {"t": "open", "m": "rpc_inference"},
+            {"t": "sitem", "id": 1, "meta": {}},  # ack: no tm
+            {"t": "sitem", "id": 2, "meta": {"t_compute_ms": 1.0}},  # no tm
+        ):
+            assert await plan.on_send(_conn(), header, None) is None
+        assert plan.schedule.pending()
+
+        h1, b1 = _span_output_frame()
+        assert await plan.on_send(_conn(), h1, b1) is None  # step 1
+        h2, b2 = _span_output_frame()
+        assert await plan.on_send(_conn(), h2, b2) == "drop"  # step 2: due
+
+    asyncio.run(run())
+    assert plan.schedule.log == [(2, "drop", None)]
+    assert [(s, a) for s, a, _ in plan.log] == [("send", "scheduled.drop")]
+
+
+def test_schedule_fires_exactly_once():
+    plan = FaultPlan(schedule=FaultSchedule(
+        [ScheduledFault(at_step=1, action="drop")]
+    ))
+
+    async def run():
+        h, b = _span_output_frame()
+        assert await plan.on_send(_conn(), h, b) == "drop"
+        for _ in range(5):  # fired entries never re-fire
+            h, b = _span_output_frame()
+            assert await plan.on_send(_conn(), h, b) is None
+
+    asyncio.run(run())
+    assert len(plan.schedule.log) == 1
+    assert not plan.schedule.pending()
+
+
+def test_schedule_port_filters_tick_independently():
+    """Two entries with different port filters each count only their own
+    peer's replies — step 2 on port A is independent of steps on port B."""
+    sched = FaultSchedule([
+        ScheduledFault(at_step=2, action="drop", port=7001),
+        ScheduledFault(at_step=1, action="drop", port=7002),
+    ])
+    plan = FaultPlan(schedule=sched)
+
+    async def run():
+        h, b = _span_output_frame()
+        assert await plan.on_send(_conn(7001), h, b) is None  # A step 1
+        h, b = _span_output_frame()
+        assert await plan.on_send(_conn(7002), h, b) == "drop"  # B step 1
+        h, b = _span_output_frame()
+        assert await plan.on_send(_conn(7001), h, b) == "drop"  # A step 2
+
+    asyncio.run(run())
+    assert sched.log == [(1, "drop", 7002), (2, "drop", 7001)]
+
+
+def test_schedule_counts_at_one_site_only():
+    """In-proc swarms share one plan between client and server conns; a
+    reply seen at send AND read must tick the counter once, not twice —
+    so a site="send" schedule ignores on_read entirely."""
+    plan = FaultPlan(schedule=FaultSchedule(
+        [ScheduledFault(at_step=1, action="drop")], site="send"
+    ))
+
+    async def run():
+        h, _ = _span_output_frame()
+        assert await plan.on_read(_conn(), h) is None  # read: not counted
+        assert plan.schedule.pending()
+        h, b = _span_output_frame()
+        assert await plan.on_send(_conn(), h, b) == "drop"
+
+    asyncio.run(run())
+
+
+def test_scheduled_corrupt_mutates_frame_and_ledgers():
+    arr = np.linspace(0.0, 1.0, 8, dtype=np.float32).reshape(1, 2, 4)
+    plan = FaultPlan(schedule=FaultSchedule(
+        [ScheduledFault(at_step=1, action="corrupt")]
+    ))
+    ledger.reset()
+
+    async def run():
+        header, blobs = _span_output_frame(arr)
+        assert await plan.on_send(_conn(), header, blobs) is None
+        meta = tensor_codec.TensorMeta.from_wire(header["tm"][0])
+        return tensor_codec.deserialize_tensor(meta, blobs[0])
+
+    out = asyncio.run(run())
+    assert not np.array_equal(out, arr)  # the numbers lie...
+    assert out.shape == arr.shape  # ...but the frame stays well-formed
+    assert ledger.snapshot()["faults"] == {"wire.scheduled.corrupt": 1}
+
+
+def test_scheduled_reset_kills_connection_loudly():
+    plan = FaultPlan(schedule=FaultSchedule(
+        [ScheduledFault(at_step=1, action="reset")]
+    ))
+    conn = _conn()
+    conn.writer = types.SimpleNamespace(
+        transport=None, close=lambda: None
+    )
+
+    async def run():
+        h, b = _span_output_frame()
+        with pytest.raises(InjectedFault):
+            await plan.on_send(conn, h, b)
+
+    asyncio.run(run())
+
+
+def test_scheduled_crash_requires_bound_callback():
+    plan = FaultPlan(schedule=FaultSchedule(
+        [ScheduledFault(at_step=1, action="crash", target="primary")]
+    ))
+
+    async def run():
+        h, b = _span_output_frame()
+        with pytest.raises(RuntimeError, match="bound callback"):
+            await plan.on_send(_conn(), h, b)
+
+    asyncio.run(run())
+
+
+def test_scheduled_crash_runs_callback_and_drops_reply():
+    crashed = []
+    sched = FaultSchedule(
+        [ScheduledFault(at_step=1, action="crash", target="primary")]
+    ).bind_crash("primary", lambda: crashed.append(True))
+    plan = FaultPlan(schedule=sched)
+
+    async def run():
+        h, b = _span_output_frame()
+        # the in-flight reply dies with the server, like a mid-step kill -9
+        assert await plan.on_send(_conn(), h, b) == "drop"
+
+    asyncio.run(run())
+    assert crashed == [True]
+
+
+# ------------------------------------------------------------ env knob
+def test_schedule_from_env_parses_and_arms_plan(monkeypatch):
+    monkeypatch.setenv("BBTPU_CHAOS_SCHEDULE", "3:reset; 7:partition:7711")
+    monkeypatch.delenv("BBTPU_CHAOS", raising=False)
+    plan = FaultPlan.from_env()  # schedule alone arms the plan
+    assert plan is not None and plan.rules == []
+    got = [(f.at_step, f.action, f.port) for f in plan.schedule.faults]
+    assert got == [(3, "reset", None), (7, "partition", 7711)]
+
+
+def test_schedule_from_env_rejects_crash(monkeypatch):
+    monkeypatch.setenv("BBTPU_CHAOS_SCHEDULE", "2:crash")
+    with pytest.raises(ValueError, match="crash"):
+        FaultSchedule.from_env()
+
+
+def test_schedule_from_env_rejects_malformed_entry(monkeypatch):
+    monkeypatch.setenv("BBTPU_CHAOS_SCHEDULE", "5")
+    with pytest.raises(ValueError, match="STEP:ACTION"):
+        FaultSchedule.from_env()
+
+
+# ------------------------------------------------------------------ e2e
+def test_scripted_crash_at_step_4_recovers_token_identical(tiny_model_dir):
+    """The acceptance scenario: script a hard primary crash at decode
+    step 4 (no drain, no park, KV and sessions lost, registry record left
+    to expire) and require the exact recovery sequence — standby
+    promotion on advert silence, client reroute + history replay — with
+    the final generation token-identical to HF greedy. No hard failures:
+    the client API never surfaces the crash."""
+    model_dir, hf_model, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        primary = BlockServer(
+            model_uid="tiny", start=0, end=3, model_dir=model_dir,
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, announce_period=0.3,
+        )
+        standby = BlockServer(
+            model_uid="tiny", start=0, end=3, model_dir=model_dir,
+            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+            page_size=4, announce_period=0.3, standby=True,
+            promote_high_ms=500.0, promote_low_ms=100.0,
+            promote_sustain_s=0.3, promote_jitter_s=0.4,
+            drain_timeout=2.0,
+        )
+        await primary.start()
+        await standby.start()
+
+        ledger.reset()
+        schedule = FaultSchedule([
+            ScheduledFault(at_step=4, action="crash", target="primary"),
+        ]).bind_crash("primary", primary.crash)
+        faults.set_plan(FaultPlan(schedule=schedule))
+
+        # the retry budget must outlast promotion latency (record expiry
+        # 0.75s + sustain 0.3s + jitter <=0.4s + announce ticks): each
+        # _recover attempt sleeps up to 1s, so 30 attempts is ~27s of
+        # self-heal window; short ban + fast view refresh keep the client
+        # probing instead of camping on the dead primary's stale record
+        cfg = ClientConfig(
+            max_retries=30, update_period=0.5,
+            ban_timeout=0.5, ban_max=2.0,
+        )
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", config=cfg,
+        )
+        rng = np.random.default_rng(5)
+        input_ids = rng.integers(0, config.vocab_size, size=(1, 4))
+        ids = await model.generate(
+            input_ids, max_new_tokens=8, server_decode=False
+        )
+        with torch.no_grad():
+            ref = hf_model.generate(
+                torch.tensor(input_ids), max_new_tokens=8,
+                do_sample=False, use_cache=True,
+            ).numpy()
+        np.testing.assert_array_equal(ids, ref)
+
+        # the crash really was a crash, and it fired exactly where scripted
+        assert primary._crashed
+        assert schedule.log == [(4, "crash", "primary")]
+        assert not schedule.pending()
+        assert standby.promotions >= 1 and standby._promoted
+
+        # ...and the ledger proves the full fault->recovery chain ran
+        snap = ledger.snapshot()
+        assert snap["faults"].get("server.crash") == 1
+        assert snap["recoveries"].get("server.promotion", 0) >= 1
+        assert snap["recoveries"].get("client.reroute_replay", 0) >= 1
+
+        faults.set_plan(None)
+        # primary died hard — only the survivors get a graceful stop
+        await standby.stop()
+        await reg.stop()
+
+    asyncio.run(run())

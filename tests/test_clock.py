@@ -1,0 +1,207 @@
+"""Virtual clock substrate (bloombee_tpu/utils/clock.py).
+
+The whole deterministic-chaos story rests on three promises: the default
+RealClock is byte-for-byte stdlib time (production never changes), a
+ScaledClock compresses every wait by a constant factor (soak tests), and
+a SteppableClock is frozen until advance() — virtual waits complete in
+zero wall time, in deadline order, on both the sync and asyncio sides.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from bloombee_tpu.utils import clock
+from bloombee_tpu.utils.clock import RealClock, ScaledClock, SteppableClock
+
+
+@pytest.fixture(autouse=True)
+def _restore_clock():
+    yield
+    clock.reset()
+
+
+# ---------------------------------------------------------------- RealClock
+def test_real_clock_is_stdlib_time():
+    c = RealClock()
+    assert abs(c.time() - time.time()) < 0.5
+    assert abs(c.monotonic() - time.monotonic()) < 0.5
+    t0 = time.perf_counter()
+    c.sleep(0.01)
+    assert time.perf_counter() - t0 >= 0.009
+
+
+def test_default_install_is_real():
+    clock.reset()
+    assert isinstance(clock.get(), RealClock)
+    assert abs(clock.now() - time.time()) < 0.5
+
+
+def test_deadline_none_passthrough():
+    assert clock.deadline(None) is None
+    dl = clock.deadline(5.0)
+    assert dl is not None and dl > clock.monotonic()
+
+
+# -------------------------------------------------------------- ScaledClock
+def test_scaled_clock_compresses_virtual_time():
+    c = ScaledClock(scale=100.0)
+    v0 = c.monotonic()
+    time.sleep(0.05)
+    advanced = c.monotonic() - v0
+    # 0.05 real seconds ≈ 5 virtual seconds at 100x
+    assert 2.0 < advanced < 60.0
+
+
+def test_scaled_clock_divides_sleeps():
+    c = ScaledClock(scale=50.0)
+    t0 = time.perf_counter()
+    c.sleep(1.0)  # 1 virtual second = 20ms real
+    real = time.perf_counter() - t0
+    assert real < 0.5
+
+
+def test_scaled_clock_rejects_nonpositive_scale():
+    with pytest.raises(ValueError):
+        ScaledClock(scale=0.0)
+
+
+def test_scaled_clock_async_sleep_compressed():
+    c = ScaledClock(scale=50.0)
+
+    async def run():
+        t0 = time.perf_counter()
+        await c.async_sleep(1.0)
+        return time.perf_counter() - t0
+
+    assert asyncio.run(run()) < 0.5
+
+
+# ----------------------------------------------------------- SteppableClock
+def test_steppable_clock_frozen_until_advanced():
+    c = SteppableClock(start=1000.0)
+    assert c.monotonic() == 1000.0
+    # wall time is anchored at construction and advances ONLY by advance()
+    w0 = c.time()
+    time.sleep(0.02)
+    assert c.time() == w0
+    c.advance(12.5)
+    assert c.monotonic() == 1012.5
+    assert c.time() == w0 + 12.5
+
+
+def test_steppable_sync_sleep_wakes_on_advance():
+    c = SteppableClock()
+    woke = threading.Event()
+
+    def sleeper():
+        c.sleep(10.0)
+        woke.set()
+
+    t = threading.Thread(target=sleeper, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not woke.is_set(), "sleep returned without the clock moving"
+    c.advance(9.0)
+    time.sleep(0.05)
+    assert not woke.is_set(), "woke before its deadline"
+    c.advance(1.0)
+    assert woke.wait(2.0), "advance past deadline did not wake the sleeper"
+    t.join(2.0)
+
+
+def test_steppable_async_sleep_wakes_in_deadline_order():
+    c = SteppableClock()
+    order = []
+
+    async def run():
+        async def napper(name, dt):
+            await c.async_sleep(dt)
+            order.append(name)
+
+        tasks = [
+            asyncio.ensure_future(napper("late", 5.0)),
+            asyncio.ensure_future(napper("early", 1.0)),
+        ]
+        await asyncio.sleep(0.05)  # real: let both park on the heap
+        assert order == []
+        c.advance(10.0)
+        await asyncio.wait_for(asyncio.gather(*tasks), 2.0)
+
+    asyncio.run(run())
+    assert order == ["early", "late"]
+
+
+def test_steppable_advance_from_foreign_thread_wakes_async_sleeper():
+    c = SteppableClock()
+
+    async def run():
+        task = asyncio.ensure_future(c.async_sleep(3.0))
+        await asyncio.sleep(0.05)
+        threading.Thread(target=lambda: c.advance(4.0), daemon=True).start()
+        await asyncio.wait_for(task, 2.0)
+
+    asyncio.run(run())
+
+
+def test_steppable_perf_counter_stays_real():
+    # measurement is NOT a timing decision: even a frozen clock reports
+    # real perf_counter durations (throughput numbers must stay honest)
+    c = SteppableClock()
+    prev = clock.install(c)
+    try:
+        t0 = clock.perf_counter()
+        time.sleep(0.01)
+        assert clock.perf_counter() - t0 >= 0.009
+        assert clock.monotonic() == c.monotonic()
+    finally:
+        clock.install(prev)
+
+
+def test_steppable_cond_wait_times_out_virtually():
+    c = SteppableClock()
+
+    async def run():
+        cond = asyncio.Condition()
+
+        async def waiter():
+            async with cond:
+                try:
+                    await c.cond_wait(cond, 5.0)
+                except asyncio.TimeoutError:
+                    return "timed_out"
+                return "notified"
+
+        task = asyncio.ensure_future(waiter())
+        await asyncio.sleep(0.05)
+        assert not task.done(), "cond_wait expired without virtual time"
+        c.advance(6.0)
+        assert await asyncio.wait_for(task, 2.0) == "timed_out"
+
+    asyncio.run(run())
+
+
+# -------------------------------------------------------- install machinery
+def test_install_returns_previous_and_reset_restores_default():
+    stepper = SteppableClock(start=7.0)
+    prev = clock.install(stepper)
+    try:
+        assert clock.monotonic() == 7.0
+    finally:
+        restored = clock.install(prev)
+        assert restored is stepper
+    clock.reset()
+    assert isinstance(clock.get(), RealClock)
+
+
+def test_env_scale_builds_scaled_clock(monkeypatch):
+    monkeypatch.setenv("BBTPU_CLOCK_SCALE", "25")
+    clock.reset()  # pristine: next get() re-reads the env knob
+    try:
+        assert isinstance(clock.get(), ScaledClock)
+        assert clock.get().scale == 25.0
+    finally:
+        monkeypatch.delenv("BBTPU_CLOCK_SCALE")
+        clock.reset()

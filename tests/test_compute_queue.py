@@ -393,6 +393,62 @@ def test_gather_window_catches_late_arrivals(monkeypatch):
     asyncio.run(run())
 
 
+def test_gather_window_dispatches_early_on_full_house(monkeypatch):
+    """With a group_hint (the server's open-session count), the gather
+    window ends the moment the group holds every possible member instead
+    of sleeping out the full window — here the window is far longer than
+    the test timeout, so only early dispatch lets this pass."""
+    monkeypatch.setenv("BBTPU_BATCH_WINDOW_MS", "30000")
+
+    async def run():
+        q = ComputeQueue(max_group=8, group_hint=lambda: 2)
+        q.start()
+        calls = []
+
+        def run_group(payloads):
+            calls.append(list(payloads))
+            return payloads
+
+        first = asyncio.create_task(
+            q.submit_group(PRIORITY_INFERENCE, "k", "a", run_group)
+        )
+        await asyncio.sleep(0.05)  # worker popped "a", window open
+        second = asyncio.create_task(
+            q.submit_group(PRIORITY_INFERENCE, "k", "b", run_group)
+        )
+        t0 = time.monotonic()
+        assert await asyncio.wait_for(first, timeout=5.0) == "a"
+        assert await asyncio.wait_for(second, timeout=5.0) == "b"
+        assert time.monotonic() - t0 < 5.0
+        assert calls == [["a", "b"]]
+        await q.stop()
+
+    asyncio.run(run())
+
+
+def test_solo_session_skips_gather_window(monkeypatch):
+    """group_hint == 1 (one open session): nobody else can ever join, so
+    the window must not be slept at all."""
+    monkeypatch.setenv("BBTPU_BATCH_WINDOW_MS", "30000")
+
+    async def run():
+        q = ComputeQueue(max_group=8, group_hint=lambda: 1)
+        q.start()
+
+        def run_group(payloads):
+            return payloads
+
+        t0 = time.monotonic()
+        out = await asyncio.wait_for(
+            q.submit_group(PRIORITY_INFERENCE, "k", "solo", run_group),
+            timeout=5.0,
+        )
+        assert out == "solo" and time.monotonic() - t0 < 5.0
+        await q.stop()
+
+    asyncio.run(run())
+
+
 def test_wait_stats_report_queue_time():
     async def run():
         q = ComputeQueue()

@@ -37,6 +37,19 @@ from bloombee_tpu.wire.faults import (
 )
 from bloombee_tpu.wire.rpc import connect
 from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+from bloombee_tpu.utils import clock
+from bloombee_tpu.utils.clock import SteppableClock
+
+
+@pytest.fixture
+def stepper():
+    """Hand-stepped process clock: the quarantine state machine reads
+    clock.monotonic(), so tests advance virtual time instead of sleeping
+    — identical transitions, zero wall-clock waits."""
+    c = SteppableClock()
+    prev = clock.install(c)
+    yield c
+    clock.install(prev)
 
 
 @pytest.fixture(scope="module")
@@ -306,15 +319,15 @@ def test_quarantined_peer_excluded_from_standby_pool():
     assert m.pick_standby(primary) is None
 
 
-def test_quarantine_readmission_keeps_escalation_history():
+def test_quarantine_readmission_keeps_escalation_history(stepper):
     m = _manager(quarantine_timeout=0.05, quarantine_max=10.0)
     m.quarantine_peer("a")
-    first = m._quarantine["a"].banned_until - time.monotonic()
+    first = m._quarantine["a"].banned_until - clock.monotonic()
     assert 0.05 * 0.75 <= first <= 0.05 * 1.25 + 0.01
-    assert m._integrity_excludes("a", time.monotonic())
-    time.sleep(0.08)
+    assert m._integrity_excludes("a", clock.monotonic())
+    stepper.advance(0.08)
     # expiry admits exactly one half-open probe; other routes still avoid
-    now = time.monotonic()
+    now = clock.monotonic()
     assert not m._integrity_excludes("a", now)
     assert m._integrity_excludes("a", now)
     # the probe succeeds -> readmitted, but the conviction count survives
@@ -326,11 +339,11 @@ def test_quarantine_readmission_keeps_escalation_history():
     m.quarantine_peer("a")
     st = m._quarantine["a"]
     assert st.strikes == 2  # restored from history, then escalated
-    backoff = st.banned_until - time.monotonic()
+    backoff = st.banned_until - clock.monotonic()
     assert backoff >= 0.05 * 2 * 0.74  # doubled base, not from scratch
 
 
-def test_quarantine_outlives_fault_ban_class():
+def test_quarantine_outlives_fault_ban_class(stepper):
     """Quarantine is the LONGEST penalty class: with identical strike
     counts a quarantined peer stays excluded long after a fault-banned
     peer has been re-admitted."""
@@ -338,8 +351,8 @@ def test_quarantine_outlives_fault_ban_class():
                  quarantine_timeout=5.0, quarantine_max=10.0)
     m.ban_peer("crashed")
     m.quarantine_peer("liar")
-    time.sleep(0.08)
-    now = time.monotonic()
+    stepper.advance(0.08)
+    now = clock.monotonic()
     assert not m._ban_excludes("crashed", now)
     assert m._integrity_excludes("liar", now)
 

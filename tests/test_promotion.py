@@ -23,6 +23,8 @@ from bloombee_tpu.client.model import DistributedModelForCausalLM
 from bloombee_tpu.server.block_server import BlockServer
 from bloombee_tpu.swarm.data import ServerInfo, ServerState
 from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+from bloombee_tpu.utils import clock
+from bloombee_tpu.utils.clock import ScaledClock
 
 
 @pytest.fixture(scope="module")
@@ -97,17 +99,35 @@ def test_three_standbys_exactly_one_promotes(tiny_model_dir):
         standbys = [_standby_server(model_dir, rc()) for _ in range(3)]
         for s in standbys:
             await s.start()
-        await _wait_for(
-            lambda: sum(s._promoted for s in standbys) >= 1, 25.0,
-            "any standby promotion",
-        )
-        # let the storm (if any) fully resolve, then require convergence
-        # to exactly one promoted replica, stable over several ticks
-        await asyncio.sleep(3.0)
-        for _ in range(5):
-            assert sum(s._promoted for s in standbys) == 1
-            assert sum(s._standby for s in standbys) == 2
-            await asyncio.sleep(0.3)
+        # every deadline in the promotion path (announce lease, sustain
+        # dwell, jitter, storm re-check) reads clock.*, and standbys never
+        # serve here, so no compute is in flight: the watch -> promote ->
+        # storm-resolve sequence runs 4x compressed with identical state
+        # transitions. 4x (not the 20x of the lease tests) keeps the
+        # 0.75s announce-lease margin ~10x above scheduler noise. The
+        # clock is installed AFTER the starts on purpose: the fake hot
+        # advert's staleness budget (LOAD_STALE_S) burns in virtual time,
+        # so the slow part (3x weight loading) must not run 4x; the
+        # install transition can at worst flap a standby lease for one
+        # real announce period, and a promotion storm triggered by that
+        # converges via the yield protocol — which is what this test
+        # asserts anyway.
+        prev = clock.install(ScaledClock(scale=4.0))
+        try:
+            await _wait_for(
+                lambda: sum(s._promoted for s in standbys) >= 1, 25.0,
+                "any standby promotion",
+            )
+            # let the storm (if any) fully resolve, then require
+            # convergence to exactly one promoted replica, stable over
+            # several ticks
+            await clock.async_sleep(3.0)
+            for _ in range(5):
+                assert sum(s._promoted for s in standbys) == 1
+                assert sum(s._standby for s in standbys) == 2
+                await clock.async_sleep(0.3)
+        finally:
+            clock.install(prev)
         # every decision is operator-visible: the winner counted its
         # promotion; any racer that also declared counted a yield
         winner = next(s for s in standbys if s._promoted)
@@ -138,37 +158,52 @@ def test_standby_promotes_on_dead_span_and_serves(tiny_model_dir):
         def rc():
             return RegistryClient("127.0.0.1", reg.port)
 
-        primary = BlockServer(
-            model_uid="tiny", start=0, end=3, model_dir=model_dir,
-            registry=rc(), compute_dtype=jnp.float32, num_pages=64,
-            page_size=4, announce_period=0.3,
-        )
-        standby = _standby_server(model_dir, rc())
-        await primary.start()
-        await standby.start()
-
-        # a standby is not a serving replica: a session opened directly
-        # against it must be refused before any KV is allocated
-        from bloombee_tpu.wire.rpc import RpcError, connect
-
-        conn = await connect("127.0.0.1", standby.port)
-        with pytest.raises(RpcError):
-            stream = await conn.open_stream(
-                "rpc_inference",
-                {"session_id": "s-refused", "batch_size": 1,
-                 "max_length": 8},
+        # everything up to the generate is control traffic on virtual
+        # deadlines (announce lease, watcher tick, sustain dwell), so the
+        # servers are BORN on a 4x compressed clock: installing before
+        # start() keeps every in-flight sleep and every lease on one
+        # timeline. Installing mid-run instead leaves pre-install sleeps
+        # holding real deadlines while virtual time jumps ahead — the
+        # primary's lease flaps expired for a beat and the standby
+        # promotes early. Restored to real before the generate; that
+        # backward jump only lengthens leases, never expires them.
+        prev = clock.install(ScaledClock(scale=4.0))
+        try:
+            primary = BlockServer(
+                model_uid="tiny", start=0, end=3, model_dir=model_dir,
+                registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+                page_size=4, announce_period=0.3,
             )
-            await stream.recv()
-        await conn.close()
+            standby = _standby_server(model_dir, rc())
+            await primary.start()
+            await standby.start()
 
-        # while the primary lives, the standby must not promote
-        await asyncio.sleep(2.0)
-        assert standby._standby and not standby._promoted
+            # a standby is not a serving replica: a session opened
+            # directly against it must be refused before any KV is
+            # allocated
+            from bloombee_tpu.wire.rpc import RpcError, connect
 
-        await primary.stop()  # tombstones the span: advert silence
-        await _wait_for(
-            lambda: standby._promoted, 20.0, "promotion after span loss"
-        )
+            conn = await connect("127.0.0.1", standby.port)
+            with pytest.raises(RpcError):
+                stream = await conn.open_stream(
+                    "rpc_inference",
+                    {"session_id": "s-refused", "batch_size": 1,
+                     "max_length": 8},
+                )
+                await stream.recv()
+            await conn.close()
+
+            # while the primary lives, the standby must not promote:
+            # observed over 2.0 virtual seconds (several watcher ticks)
+            await clock.async_sleep(2.0)
+            assert standby._standby and not standby._promoted
+
+            await primary.stop()  # tombstones the span: advert silence
+            await _wait_for(
+                lambda: standby._promoted, 20.0, "promotion after span loss"
+            )
+        finally:
+            clock.install(prev)
 
         model = DistributedModelForCausalLM.from_pretrained(
             model_dir, rc(), model_uid="tiny"
@@ -208,45 +243,56 @@ def test_promoted_replica_demotes_when_span_cools(tiny_model_dir):
 
         standby = _standby_server(model_dir, rc())
         await standby.start()
-        # no serving replica at all: the standby must promote...
-        await _wait_for(
-            lambda: standby._promoted, 20.0, "promotion of sole standby"
-        )
-        # ...and must NOT demote while it is the only coverage
-        await asyncio.sleep(1.5)
-        assert standby._promoted and standby.demotions == 0
+        # the whole promote -> drain-back -> re-promote cycle is control
+        # traffic only (this standby never serves a session), and every
+        # deadline in it (watcher tick, sustain dwell, lease expiry)
+        # reads clock.*, so it runs end to end on a 4x compressed clock;
+        # keep_cool_alive sleeps on the same clock, so its re-declare
+        # cadence keeps the same 4x margin over its 2.0s lease
+        prev = clock.install(ScaledClock(scale=4.0))
+        try:
+            # no serving replica at all: the standby must promote...
+            await _wait_for(
+                lambda: standby._promoted, 20.0, "promotion of sole standby"
+            )
+            # ...and must NOT demote while it is the only coverage
+            await clock.async_sleep(1.5)
+            assert standby._promoted and standby.demotions == 0
 
-        # a healthy primary (re)appears, cool (no load advert = delay 0)
-        cool = ServerInfo(
-            state=ServerState.ONLINE, host="127.0.0.1", port=1,
-            throughput=1.0, start_block=0, end_block=3,
-        )
+            # a healthy primary (re)appears, cool (no load advert =
+            # delay 0)
+            cool = ServerInfo(
+                state=ServerState.ONLINE, host="127.0.0.1", port=1,
+                throughput=1.0, start_block=0, end_block=3,
+            )
 
-        async def keep_cool_alive():
-            while True:
-                await rc().declare_blocks(
-                    "tiny", "srv-coolprimary", range(3), cool,
-                    expiration=2.0,
-                )
-                await asyncio.sleep(0.5)
+            async def keep_cool_alive():
+                while True:
+                    await rc().declare_blocks(
+                        "tiny", "srv-coolprimary", range(3), cool,
+                        expiration=2.0,
+                    )
+                    await clock.async_sleep(0.5)
 
-        alive = asyncio.create_task(keep_cool_alive())
-        await _wait_for(
-            lambda: not standby._promoted and standby._standby, 20.0,
-            "drain-back after the span cooled",
-        )
-        assert standby.demotions == 1
-        assert standby._advert_state() == ServerState.JOINING
+            alive = asyncio.create_task(keep_cool_alive())
+            await _wait_for(
+                lambda: not standby._promoted and standby._standby, 20.0,
+                "drain-back after the span cooled",
+            )
+            assert standby.demotions == 1
+            assert standby._advert_state() == ServerState.JOINING
 
-        # the primary dies again: the SAME standby must promote again
-        alive.cancel()
-        await rc().revoke_blocks(
-            "tiny", "srv-coolprimary", range(3), expiration=60.0
-        )
-        await _wait_for(
-            lambda: standby._promoted, 20.0, "re-promotion after re-loss"
-        )
-        assert standby.promotions == 2
+            # the primary dies again: the SAME standby must promote again
+            alive.cancel()
+            await rc().revoke_blocks(
+                "tiny", "srv-coolprimary", range(3), expiration=60.0
+            )
+            await _wait_for(
+                lambda: standby._promoted, 20.0, "re-promotion after re-loss"
+            )
+            assert standby.promotions == 2
+        finally:
+            clock.install(prev)
 
         await standby.stop()
         await reg.stop()
@@ -329,10 +375,17 @@ def test_promotion_survives_registry_chaos(tiny_model_dir):
         standby = _standby_server(model_dir, rc())
         standby.registry = FlakyRegistry(rc())
         await standby.start()
-        await _wait_for(
-            lambda: standby._promoted, 25.0,
-            "promotion through registry chaos",
-        )
+        # same 4x compressed clock as the other promotion tests: the
+        # watcher's log-and-retry cadence and every promotion deadline
+        # are clock-driven, and nothing computes while we wait
+        prev = clock.install(ScaledClock(scale=4.0))
+        try:
+            await _wait_for(
+                lambda: standby._promoted, 25.0,
+                "promotion through registry chaos",
+            )
+        finally:
+            clock.install(prev)
         assert not standby._promotion_task.done()
         await standby.stop()
         await reg.stop()

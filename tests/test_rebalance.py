@@ -25,6 +25,8 @@ from bloombee_tpu.server.block_server import BlockServer
 from bloombee_tpu.swarm.data import ModuleInfo, ServerInfo
 from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
 from bloombee_tpu.swarm.spans import compute_spans
+from bloombee_tpu.utils import clock
+from bloombee_tpu.utils.clock import ScaledClock
 
 
 def _infos(spans, n_blocks):  # spans: {sid: (start, end, throughput)}
@@ -187,17 +189,38 @@ def test_e2e_pathological_split_converges(tiny_model_dir):
                 page_size=4, announce_period=0.5, **kw,
             )
 
-        s_a = server(0, 2)  # static
-        s_b = server(0, 2, rebalance_period=1.0, drain_timeout=2.0)
-        await s_a.start()
-        await s_b.start()
-        # supervisor tick = announce_period (0.5s); rebalance after 1s
-        deadline = asyncio.get_event_loop().time() + 30.0
-        while (s_b.start_block, s_b.end_block) == (0, 2):
-            if asyncio.get_event_loop().time() > deadline:
-                raise AssertionError("rebalance never happened")
-            await asyncio.sleep(0.25)
-        assert (s_b.start_block, s_b.end_block) == (1, 3)
+        # both servers are BORN on a 4x compressed clock: every deadline
+        # in the move sequence (supervisor tick, rebalance period, drain
+        # budget, re-announce lease) reads clock.*, so convergence AND
+        # the hysteresis window run 4x compressed on one timeline.
+        # Installing mid-run instead leaves in-flight announce sleeps
+        # holding real deadlines while virtual time jumps ahead: the
+        # peer's lease flaps expired and the supervisor chases phantom
+        # uncovered blocks. The poll deadline stays real as a hard cap;
+        # weight loading is real compute, but nothing virtual-clocked
+        # fences it tighter than the 2.0s drain budget. Restored to real
+        # before the generate.
+        prev = clock.install(ScaledClock(scale=4.0))
+        try:
+            s_a = server(0, 2)  # static
+            s_b = server(0, 2, rebalance_period=1.0, drain_timeout=2.0)
+            await s_a.start()
+            await s_b.start()
+            # supervisor tick = announce_period (0.5s); rebalance after 1s
+            deadline = asyncio.get_event_loop().time() + 30.0
+            while (s_b.start_block, s_b.end_block) == (0, 2):
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError("rebalance never happened")
+                await asyncio.sleep(0.25)
+            assert (s_b.start_block, s_b.end_block) == (1, 3)
+
+            # stability: no further move (hysteresis), observed over 2.5
+            # virtual seconds (several supervisor ticks)
+            await clock.async_sleep(2.5)
+            assert (s_b.start_block, s_b.end_block) == (1, 3)
+            assert (s_a.start_block, s_a.end_block) == (0, 2)
+        finally:
+            clock.install(prev)
 
         # swarm must now serve the whole model, correct vs HF
         model = DistributedModelForCausalLM.from_pretrained(
@@ -214,11 +237,6 @@ def test_e2e_pathological_split_converges(tiny_model_dir):
                 use_cache=True,
             ).numpy()
         np.testing.assert_array_equal(ids, ref)
-
-        # stability: no further move (hysteresis)
-        await asyncio.sleep(2.5)
-        assert (s_b.start_block, s_b.end_block) == (1, 3)
-        assert (s_a.start_block, s_a.end_block) == (0, 2)
 
         await s_a.stop()
         await s_b.stop()
@@ -269,15 +287,22 @@ def test_supervisor_survives_registry_flaps(tiny_model_dir):
         )
         flaky = FlakyRegistry(rc())
         s_b.registry = flaky
-        await s_a.start()
-        await s_b.start()
-        deadline = asyncio.get_event_loop().time() + 30.0
-        while (s_b.start_block, s_b.end_block) == (0, 2):
-            if asyncio.get_event_loop().time() > deadline:
-                raise AssertionError(
-                    "rebalance never happened through registry flaps"
-                )
-            await asyncio.sleep(0.25)
+        # same born-on-a-4x-compressed-clock setup as the
+        # pathological-split test: the log-and-retry cadence and every
+        # move deadline are clock-driven
+        prev = clock.install(ScaledClock(scale=4.0))
+        try:
+            await s_a.start()
+            await s_b.start()
+            deadline = asyncio.get_event_loop().time() + 30.0
+            while (s_b.start_block, s_b.end_block) == (0, 2):
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError(
+                        "rebalance never happened through registry flaps"
+                    )
+                await asyncio.sleep(0.25)
+        finally:
+            clock.install(prev)
         assert (s_b.start_block, s_b.end_block) == (1, 3)
         # the supervisor saw real injected failures and is still alive
         assert flaky._calls >= flaky._fail_every
@@ -311,8 +336,14 @@ def test_supervisor_restarts_dead_announce_loop(tiny_model_dir):
         await s.start()
         s._announce_task.cancel()
         # expiry = announce_period * 2.5 = 1.25s; wait well past it and
-        # confirm the record is still alive (supervisor restarted the loop)
-        await asyncio.sleep(3.0)
+        # confirm the record is still alive (supervisor restarted the
+        # loop). Supervisor tick, announce lease, and registry expiry all
+        # read clock.*, so the wait runs 4x compressed.
+        prev = clock.install(ScaledClock(scale=4.0))
+        try:
+            await clock.async_sleep(3.0)
+        finally:
+            clock.install(prev)
         infos = await rc().get_module_infos("tiny", range(3))
         assert any(s.server_id in i.servers for i in infos), (
             "server expired from the registry after its announce loop died"

@@ -28,6 +28,8 @@ from bloombee_tpu.client.session import InferenceSession
 from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
 from bloombee_tpu.server.block_server import BlockServer
 from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+from bloombee_tpu.utils import clock
+from bloombee_tpu.utils.clock import ScaledClock
 from bloombee_tpu.wire import faults
 from bloombee_tpu.wire.faults import FaultPlan, FaultRule
 from bloombee_tpu.wire.rpc import RpcError, RpcServer, connect
@@ -200,16 +202,27 @@ def test_abandoned_session_reaped_within_lease(tiny_model_dir):
             assert _counts(server)["referenced"] > 0
 
             _partition_spans(s)
-            # park (keepalive fences the silent stream) makes every page
-            # refcount-0 — reclaimable under pressure from that instant
-            await _wait_for(
-                lambda: _counts(server)["referenced"] == 0,
-                5.0, "pages to become reclaimable at park",
-            )
-            # the reaper then frees them for good within the lease
-            await _wait_for(
-                lambda: server.sessions_reaped >= 1, 5.0, "lease reap"
-            )
+            # sit out the keepalive fence + lease on a compressed process
+            # clock: every timing loop involved (keepalive idle check,
+            # park deadline, reaper tick) reads clock.*, so the whole
+            # detection->park->reap sequence runs 20x faster with
+            # identical state transitions. No compute is in flight during
+            # the window, so nothing real-time can be mis-fenced.
+            prev = clock.install(ScaledClock(scale=20.0))
+            try:
+                # park (keepalive fences the silent stream) makes every
+                # page refcount-0 — reclaimable under pressure from that
+                # instant
+                await _wait_for(
+                    lambda: _counts(server)["referenced"] == 0,
+                    5.0, "pages to become reclaimable at park",
+                )
+                # the reaper then frees them for good within the lease
+                await _wait_for(
+                    lambda: server.sessions_reaped >= 1, 5.0, "lease reap"
+                )
+            finally:
+                clock.install(prev)
             assert not server._sessions
             c = _counts(server)
             # nothing pinned; synthetic park entries purged back to the
@@ -433,10 +446,17 @@ def test_resume_declined_after_lease_expiry_full_replay(tiny_model_dir):
         )
         for sp in session._spans:
             sp.conn.abort("test: injected failure")
-        # sit out the lease: the reaper reclaims the parked session
-        await _wait_for(
-            lambda: server.sessions_reaped >= 1, 5.0, "lease reap"
-        )
+        # sit out the lease on a 20x compressed process clock: the park
+        # deadline and reaper tick both read clock.*, so the 0.5s lease
+        # expires in ~30ms wall with identical transitions (no compute is
+        # in flight during the window)
+        prev = clock.install(ScaledClock(scale=20.0))
+        try:
+            await _wait_for(
+                lambda: server.sessions_reaped >= 1, 5.0, "lease reap"
+            )
+        finally:
+            clock.install(prev)
         rest, _ = await _greedy_decode(
             model, session, out, 4, dtype=input_ids.dtype
         )
