@@ -1,0 +1,284 @@
+"""Module-level call graph + reachability for bbtpu-lint v2.
+
+BB002/BB003 (PR 9) are intraprocedural: they see `with lock: time.sleep()`
+but not `with lock: flush()` where flush() sleeps three helpers down —
+and the bugs that actually ship are the second kind. This module builds
+a call graph over the analyzed files ONCE per run and gives the
+concurrency rules two primitives:
+
+- :meth:`CallGraph.resolve` — best-effort resolution of a call site to a
+  known function, using heuristics tuned for this codebase:
+  self-methods, same-file functions, from-imports/module aliases mapped
+  onto analyzed paths, a small known-singleton receiver map
+  (``manager``/``self.manager`` is always the CacheManager, ``conn`` a
+  wire Connection, ...), and a unique-global-name fallback.
+- :meth:`CallGraph.reach` — reverse-BFS shortest call chains from every
+  function to a target set, so a finding can print the full
+  ``caller -> helper -> blocking site`` trace.
+
+Deliberate under-approximations (missed edges beat false chains):
+
+- callables passed as ARGUMENTS (``compute.submit(fn)``,
+  ``asyncio.to_thread(fn)``) create no edge — which is exactly right for
+  the lock rules, since those run on another thread/later tick, outside
+  the caller's critical section;
+- nested ``def``/``lambda`` bodies are skipped (they run when called,
+  not where defined) and are not indexed;
+- unresolvable receivers resolve to nothing rather than to everything.
+
+Pure stdlib, like the rest of the lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+
+from bloombee_tpu.analysis.core import SourceFile
+
+# Known-singleton receivers: attribute/variable names that, by package
+# convention, always hold an instance of one specific class. Lets
+# `self.manager.reserve(...)` resolve without type inference. Keep this
+# list short and certain — a wrong entry fabricates call chains.
+RECEIVER_CLASSES: dict[str, str] = {
+    "manager": "CacheManager",
+    "cache_manager": "CacheManager",
+    "compute": "ComputeQueue",
+    "conn": "Connection",
+    "peers": "_PeerPool",
+    "registry": "RegistryClient",
+    "reg": "RegistryClient",
+    "table": "PagedKVTable",
+}
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qname: str  # "path::Class.method" or "path::func"
+    path: str
+    name: str
+    cls: str | None
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    sf: SourceFile
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+    @property
+    def display(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+def body_walk(node: ast.AST):
+    """Walk a function body WITHOUT descending into nested defs/lambdas
+    (their bodies run when called, not under the enclosing context).
+    Breadth-first in source order, so simple `alias = lock` assignments
+    are seen before the `with alias:` statements that use them."""
+    queue = deque(ast.iter_child_nodes(node))
+    while queue:
+        n = queue.popleft()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield n
+        queue.extend(ast.iter_child_nodes(n))
+
+
+class CallGraph:
+    def __init__(self, files: list[SourceFile]):
+        self._paths = {sf.path for sf in files}
+        self.functions: dict[str, FuncInfo] = {}
+        # (path, cls-or-None, name) -> qname
+        self._index: dict[tuple[str, str | None, str], str] = {}
+        # class name -> {method name -> qname}; first definition wins
+        self._class_methods: dict[str, dict[str, str]] = {}
+        # bare top-level function name -> [qname, ...] across all files
+        self._global_funcs: dict[str, list[str]] = {}
+        # per path: alias -> module path / name -> (module path, orig name)
+        self._module_alias: dict[str, dict[str, str]] = {}
+        self._symbol_import: dict[str, dict[str, tuple[str, str]]] = {}
+
+        for sf in files:
+            self._index_file(sf)
+        # edges resolved after the full index exists
+        self.edges: dict[str, list[tuple[str, ast.Call]]] = {}
+        self._reverse: dict[str, set[str]] = {}
+        for fi in self.functions.values():
+            self._collect_edges(fi)
+
+    # ------------------------------------------------------------ indexing
+    def _index_file(self, sf: SourceFile) -> None:
+        self._module_alias[sf.path] = {}
+        self._symbol_import[sf.path] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mp = self._module_to_path(a.name)
+                    if mp:
+                        alias = a.asname or a.name.split(".")[-1]
+                        self._module_alias[sf.path][alias] = mp
+            elif isinstance(node, ast.ImportFrom):
+                base = self._import_base(sf.path, node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    alias = a.asname or a.name
+                    sub = self._module_to_path(
+                        f"{base}.{a.name}" if base else a.name
+                    )
+                    if sub:
+                        # `from pkg import module` — alias is a module
+                        self._module_alias[sf.path][alias] = sub
+                        continue
+                    mp = self._module_to_path(base)
+                    if mp:
+                        self._symbol_import[sf.path][alias] = (mp, a.name)
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(sf, node, None)
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self._add_func(sf, item, node.name)
+
+    def _add_func(self, sf: SourceFile, node, cls: str | None) -> None:
+        disp = f"{cls}.{node.name}" if cls else node.name
+        qname = f"{sf.path}::{disp}"
+        if qname in self.functions:  # redefinition: last wins, like Python
+            pass
+        self.functions[qname] = FuncInfo(
+            qname=qname, path=sf.path, name=node.name, cls=cls,
+            node=node, sf=sf,
+        )
+        self._index[(sf.path, cls, node.name)] = qname
+        if cls is None:
+            self._global_funcs.setdefault(node.name, []).append(qname)
+        else:
+            self._class_methods.setdefault(cls, {}).setdefault(
+                node.name, qname
+            )
+
+    def _module_to_path(self, dotted: str) -> str | None:
+        if not dotted:
+            return None
+        base = dotted.replace(".", "/")
+        for cand in (f"{base}.py", f"{base}/__init__.py"):
+            if cand in self._paths:
+                return cand
+        return None
+
+    def _import_base(self, path: str, node: ast.ImportFrom) -> str | None:
+        """Dotted base module of an ImportFrom, resolving relative
+        imports against the importing file's package."""
+        if node.level == 0:
+            return node.module or None
+        parts = path.rsplit("/", 1)[0].split("/")
+        if node.level - 1 > len(parts):
+            return None
+        if node.level > 1:
+            parts = parts[: len(parts) - (node.level - 1)]
+        base = ".".join(parts)
+        if node.module:
+            base = f"{base}.{node.module}" if base else node.module
+        return base or None
+
+    # ----------------------------------------------------------- resolution
+    def resolve(
+        self, path: str, cls: str | None, call: ast.Call
+    ) -> str | None:
+        """Best-effort: qname of the called function, or None."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            q = self._index.get((path, None, f.id))
+            if q:
+                return q
+            sym = self._symbol_import.get(path, {}).get(f.id)
+            if sym:
+                return self._index.get((sym[0], None, sym[1]))
+            cands = self._global_funcs.get(f.id, ())
+            return cands[0] if len(cands) == 1 else None
+        if not isinstance(f, ast.Attribute):
+            return None
+        m, v = f.attr, f.value
+        if isinstance(v, ast.Name):
+            if v.id == "self" and cls is not None:
+                return self._index.get((path, cls, m))
+            mp = self._module_alias.get(path, {}).get(v.id)
+            if mp:
+                return self._index.get((mp, None, m))
+            cname = RECEIVER_CLASSES.get(v.id)
+            if cname:
+                return self._class_methods.get(cname, {}).get(m)
+            sym = self._symbol_import.get(path, {}).get(v.id)
+            if sym:  # `from pkg import Class` then Class.staticmethod()
+                return self._class_methods.get(sym[1], {}).get(m)
+            return None
+        if (
+            isinstance(v, ast.Attribute)
+            and isinstance(v.value, ast.Name)
+            and v.value.id == "self"
+        ):
+            cname = RECEIVER_CLASSES.get(v.attr)
+            if cname:
+                return self._class_methods.get(cname, {}).get(m)
+        return None
+
+    def _collect_edges(self, fi: FuncInfo) -> None:
+        out: list[tuple[str, ast.Call]] = []
+        nodes = list(body_walk(fi.node))
+        awaited = {
+            id(n.value)
+            for n in nodes
+            if isinstance(n, ast.Await) and isinstance(n.value, ast.Call)
+        }
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                q = self.resolve(fi.path, fi.cls, n)
+                if q is None:
+                    continue
+                # calling an async function without awaiting only CREATES
+                # the coroutine — `self._spawn(self._read_loop())` runs
+                # the body on a later tick, not here, so no edge
+                if self.functions[q].is_async and id(n) not in awaited:
+                    continue
+                out.append((q, n))
+                self._reverse.setdefault(q, set()).add(fi.qname)
+        self.edges[fi.qname] = out
+
+    # --------------------------------------------------------- reachability
+    def reach(self, targets: set[str]) -> dict[str, tuple[str, ...]]:
+        """For every function that can reach a target through call edges,
+        the SHORTEST chain of qnames from it to that target (a target's
+        own chain is just ``(target,)``). Reverse BFS, so recursion and
+        call-graph cycles terminate."""
+        nxt: dict[str, str] = {}
+        dist: dict[str, int] = {}
+        dq: deque[str] = deque()
+        for t in targets:
+            if t in self.functions:
+                dist[t] = 0
+                dq.append(t)
+        while dq:
+            q = dq.popleft()
+            for caller in self._reverse.get(q, ()):
+                if caller not in dist:
+                    dist[caller] = dist[q] + 1
+                    nxt[caller] = q
+                    dq.append(caller)
+        chains: dict[str, tuple[str, ...]] = {}
+        for q in dist:
+            chain = [q]
+            while chain[-1] in nxt:
+                chain.append(nxt[chain[-1]])
+            chains[q] = tuple(chain)
+        return chains
+
+    def display(self, qname: str) -> str:
+        fi = self.functions.get(qname)
+        return fi.display if fi else qname.rsplit("::", 1)[-1]
+
+    def format_chain(self, chain: tuple[str, ...]) -> str:
+        return " -> ".join(self.display(q) for q in chain)

@@ -25,6 +25,8 @@ from bloombee_tpu.analysis.rules import make_rules
 DEFAULT_PATHS = ["bloombee_tpu", "bench.py"]
 ENV_TABLE_BEGIN = "<!-- bbtpu-env-table:begin -->"
 ENV_TABLE_END = "<!-- bbtpu-env-table:end -->"
+LOCK_TABLE_BEGIN = "<!-- bbtpu-lock-table:begin -->"
+LOCK_TABLE_END = "<!-- bbtpu-lock-table:end -->"
 
 
 def find_root(start: Path | None = None) -> Path:
@@ -130,6 +132,83 @@ def fix_env_docs(root: Path, readme: str) -> int:
     return 0
 
 
+def _replace_marked(
+    root: Path, relpath: str, begin: str, end: str, body: str,
+    check_only: bool, what: str,
+) -> int:
+    """Shared engine for the generated README/ARCHITECTURE tables:
+    compare (check) or rewrite (fix) the marker-delimited region."""
+    path = root / relpath
+    if not path.exists():
+        print(f"{what}: {relpath} not found", file=sys.stderr)
+        return 1
+    text = path.read_text(encoding="utf-8")
+    try:
+        head, rest = text.split(begin, 1)
+        current, tail = rest.split(end, 1)
+    except ValueError:
+        print(
+            f"{what}: {relpath} lacks the generated table markers "
+            f"({begin} ... {end})", file=sys.stderr,
+        )
+        return 1
+    if check_only:
+        if current.strip() != body.strip():
+            print(
+                f"{what}: {relpath} drifted from "
+                "analysis/lock_hierarchy.py; regenerate with "
+                "scripts/analyze.sh --fix-lock-docs",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+    path.write_text(
+        head + begin + "\n" + body.strip() + "\n" + end + tail,
+        encoding="utf-8",
+    )
+    print(f"{what}: regenerated table in {relpath}")
+    return 0
+
+
+def check_lock_docs(root: Path, fix: bool = False) -> int:
+    """ARCHITECTURE.md's lock-hierarchy table is generated from the
+    declared registry, same contract as the README env table: drift
+    fails the gate, --fix-lock-docs rewrites it."""
+    from bloombee_tpu.analysis import lock_hierarchy
+
+    return _replace_marked(
+        root, "ARCHITECTURE.md", LOCK_TABLE_BEGIN, LOCK_TABLE_END,
+        lock_hierarchy.describe(), check_only=not fix, what="lock-docs",
+    )
+
+
+def render_json(findings, files, baselined: int) -> str:
+    """Machine-readable finding list for editor/CI integration. The
+    human text format stays byte-stable; tooling parses this instead."""
+    import json
+
+    return json.dumps(
+        {
+            "findings": [
+                {
+                    "rule": f.code,
+                    "fingerprint": f.fingerprint(),
+                    "path": f.path,
+                    "line": f.line,
+                    "location": f"{f.path}:{f.line}",
+                    "message": f.message,
+                    "chain": list(f.chain),
+                }
+                for f in findings
+            ],
+            "files": len(files),
+            "baselined": baselined,
+        },
+        indent=1,
+        sort_keys=True,
+    )
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m bloombee_tpu.analysis", description=__doc__
@@ -158,6 +237,17 @@ def main(argv=None) -> int:
                         "env table matches the registry")
     parser.add_argument("--fix-env-docs", action="store_true",
                         help="regenerate README's env table and exit")
+    parser.add_argument("--check-lock-docs", action="store_true",
+                        help="additionally verify ARCHITECTURE.md's "
+                        "generated lock-hierarchy table matches "
+                        "analysis/lock_hierarchy.py")
+    parser.add_argument("--fix-lock-docs", action="store_true",
+                        help="regenerate ARCHITECTURE.md's lock table "
+                        "and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="emit new findings as JSON on stdout "
+                        "(rule, fingerprint, path:line, call chain); "
+                        "summary stays on stderr")
     parser.add_argument("--readme", default="README.md")
     args = parser.parse_args(argv)
 
@@ -171,6 +261,8 @@ def main(argv=None) -> int:
         return 0
     if args.fix_env_docs:
         return fix_env_docs(root, args.readme)
+    if args.fix_lock_docs:
+        return check_lock_docs(root, fix=True)
 
     rules = make_rules()
     if args.select:
@@ -202,8 +294,11 @@ def main(argv=None) -> int:
     )
     new = [f for f in findings if f.fingerprint() not in baseline]
     old = len(findings) - len(new)
-    for f in new:
-        print(f.render())
+    if args.json:
+        print(render_json(new, files, old))
+    else:
+        for f in new:
+            print(f.render())
 
     rc = 0
     if new:
@@ -216,7 +311,8 @@ def main(argv=None) -> int:
     else:
         print(
             f"bbtpu-lint: clean — {len(files)} file(s), "
-            f"{old} baselined finding(s)"
+            f"{old} baselined finding(s)",
+            file=sys.stderr if args.json else sys.stdout,
         )
     stale = baseline - {f.fingerprint() for f in findings}
     if stale and not args.no_baseline:
@@ -229,4 +325,6 @@ def main(argv=None) -> int:
         )
     if args.check_env_docs:
         rc = max(rc, check_env_docs(root, args.readme))
+    if args.check_lock_docs:
+        rc = max(rc, check_lock_docs(root))
     return rc

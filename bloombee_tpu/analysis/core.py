@@ -43,6 +43,11 @@ class Finding:
     line: int  # 1-based line of the offending node
     message: str
     snippet: str = ""  # stripped source line (fingerprint input)
+    # call chain for interprocedural findings (display names, caller
+    # first). Not part of the fingerprint: a baselined finding survives
+    # an unrelated refactor of an intermediate helper's name only if its
+    # own site is untouched — which is the same contract as `snippet`.
+    chain: tuple[str, ...] = ()
 
     def fingerprint(self) -> str:
         """Line-number-independent identity: a baselined finding survives
@@ -93,7 +98,13 @@ class SourceFile:
                 return True
         return False
 
-    def finding(self, code: str, node: ast.AST, message: str):
+    def finding(
+        self,
+        code: str,
+        node: ast.AST,
+        message: str,
+        chain: tuple[str, ...] = (),
+    ):
         """Build a Finding for `node`, honoring noqa. Returns None when
         the site is suppressed."""
         lineno = getattr(node, "lineno", 1)
@@ -106,6 +117,7 @@ class SourceFile:
             line=lineno,
             message=message,
             snippet=self.line_text(lineno),
+            chain=chain,
         )
 
 
@@ -187,8 +199,16 @@ def write_baseline(path: Path, findings: list[Finding]) -> None:
 def run_rules(
     files: list[SourceFile], rules: list
 ) -> list[Finding]:
-    """Per-file pass then cross-file finalize (BB004/BB006 correlate
-    declarations in one file with surfacing in another)."""
+    """Call-graph prepare (interprocedural rules), per-file pass, then
+    cross-file finalize (BB004/BB006 correlate declarations in one file
+    with surfacing in another)."""
+    needs_graph = [r for r in rules if hasattr(r, "prepare")]
+    if needs_graph:
+        from bloombee_tpu.analysis.callgraph import CallGraph
+
+        graph = CallGraph(files)
+        for rule in needs_graph:
+            rule.prepare(files, graph)
     findings: list[Finding] = []
     for rule in rules:
         for sf in files:

@@ -1,0 +1,281 @@
+"""Declared lock hierarchy — the single source of truth.
+
+PR 9's BB003 hard-coded three lock names; since then the tree has grown
+asyncio locks in the server (session replication, the peer pool, lazy
+param/pruner loads), the registry client, and the wire layer, plus two
+leaf thread locks (ledger, transport stats). This module declares every
+package lock ONCE with a level in the acquisition partial order, and
+everything else derives from it:
+
+- the static pass (rules.BB003/BB009) classifies ``with``/``async with``
+  context expressions into declared locks via :func:`classify` and checks
+  nesting against :func:`edge_allowed`;
+- the runtime witness (utils/lockwatch.py) wraps the real lock objects
+  under these keys and validates every OBSERVED acquisition-order edge
+  against the same partial order;
+- ARCHITECTURE.md's "Lock hierarchy" table is generated from
+  :func:`describe` (marker-delimited like the README env table; drift
+  fails the analyze gate).
+
+Levels ascend in acquisition order: while holding a lock at level L you
+may only acquire locks at a STRICTLY higher level (reentrant locks may
+re-acquire themselves). Locks sharing a level are unordered peers — they
+must never nest in either direction. asyncio locks sit below the thread
+locks because the event loop's tasks hold them across awaits that fan
+into compute-thread work; the reverse direction (thread code acquiring
+an asyncio lock) is impossible by construction.
+
+Pure stdlib — imported by the AST lint, which must never import jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = [
+    "LockDecl",
+    "HIERARCHY",
+    "by_key",
+    "level_of",
+    "classify",
+    "edge_allowed",
+    "describe",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDecl:
+    key: str  # stable id, e.g. "server.repl" (lockwatch + findings)
+    level: int  # ascending acquisition order; equal = unordered peers
+    kind: str  # "asyncio.Lock" | "threading.Lock" | "threading.RLock" | ...
+    where: str  # declaring module + attribute (documentation)
+    doc: str  # one line: what the lock protects / why this level
+    reentrant: bool = False  # may re-acquire itself (RLock)
+    # lowercase substrings that identify this lock in a with-context
+    # expression (strings already stripped) in ANY file. Checked in
+    # HIERARCHY order, first match wins — keep specific names before
+    # generic ones.
+    patterns: tuple[str, ...] = ()
+    # patterns that only apply in the declaring module (for generic
+    # spellings like `self._lock` that mean a DIFFERENT lock per file);
+    # matched against paths ending with path_suffix, before the global
+    # pattern passes
+    path_suffix: str = ""
+    local_patterns: tuple[str, ...] = ()
+
+
+HIERARCHY: tuple[LockDecl, ...] = (
+    LockDecl(
+        key="server.repl",
+        level=10,
+        kind="asyncio.Lock",
+        where="server/block_server.py _Session.repl_lock",
+        doc=(
+            "serializes standby-replication sweeps per session; held "
+            "across compute export + peer push, so it is the OUTERMOST "
+            "lock in the tree"
+        ),
+        patterns=("repl_lock",),
+    ),
+    LockDecl(
+        key="server.peer_pool",
+        level=20,
+        kind="asyncio.Lock (per peer)",
+        where="server/block_server.py _PeerPool._locks",
+        doc=(
+            "one connect-or-reuse critical section per outbound peer so "
+            "an unreachable peer's connect timeout cannot stall pushes "
+            "to healthy peers"
+        ),
+        patterns=("_locks",),
+    ),
+    LockDecl(
+        key="registry.client",
+        level=30,
+        kind="asyncio.Lock",
+        where="swarm/registry.py RegistryClient._lock",
+        doc="guards the cached registry connection's connect-or-reuse",
+        path_suffix="swarm/registry.py",
+        local_patterns=("self._lock",),
+    ),
+    LockDecl(
+        key="server.client_params",
+        level=40,
+        kind="asyncio.Lock",
+        where="server/block_server.py BlockServer._client_params_lock",
+        doc=(
+            "single-flights the lazy multi-GB client-params load; peer "
+            "of server.pruner (they never nest)"
+        ),
+        patterns=("_client_params_lock",),
+    ),
+    LockDecl(
+        key="server.pruner",
+        level=40,
+        kind="asyncio.Lock",
+        where="server/block_server.py BlockServer._pruner_lock",
+        doc=(
+            "single-flights the lazy pruner-checkpoint load; peer of "
+            "server.client_params (they never nest)"
+        ),
+        patterns=("_pruner_lock",),
+    ),
+    LockDecl(
+        key="wire.flow",
+        level=45,
+        kind="asyncio.Condition",
+        where="wire/flow.py AdaptiveLimiter._cond",
+        doc=(
+            "bounds in-flight sends per connection; only bookkeeping runs "
+            "under it (the slot itself is held across the send, the "
+            "condition is not), so it sits just above the single-flight "
+            "locks and below rpc.send"
+        ),
+        path_suffix="wire/flow.py",
+        local_patterns=("_cond",),
+    ),
+    LockDecl(
+        key="rpc.send",
+        level=50,
+        kind="asyncio.Lock",
+        where="wire/rpc.py Connection._send_lock",
+        doc=(
+            "keeps one frame's write+drain atomic on the transport; "
+            "innermost asyncio lock — nothing may be acquired under it"
+        ),
+        patterns=("_send_lock",),
+    ),
+    LockDecl(
+        key="kv.cache_manager",
+        level=60,
+        kind="threading.RLock",
+        where="kv/cache_manager.py CacheManager._lock (@_locked)",
+        doc=(
+            "serializes table/arena mutations across the compute thread "
+            "and the event loop; reentrant because the reclaimer runs "
+            "inside write paths that already hold it"
+        ),
+        reentrant=True,
+        patterns=("manager", "cache"),
+        path_suffix="kv/cache_manager.py",
+        local_patterns=("self._lock", "self._cond"),
+    ),
+    LockDecl(
+        key="kv.paged_table",
+        level=70,
+        kind="(declared only — no lock object)",
+        where="kv/paged.py PagedKVTable",
+        doc=(
+            "the table deliberately carries NO lock (every mutation runs "
+            "under kv.cache_manager); the level fences any future table "
+            "lock BELOW the manager, matching the call direction"
+        ),
+        patterns=("table", "paged"),
+    ),
+    LockDecl(
+        key="server.compute_queue",
+        level=80,
+        kind="(declared only — no lock object)",
+        where="server/compute_queue.py ComputeQueue",
+        doc=(
+            "the queue is pure-asyncio today (no condition since the "
+            "PR 9 hierarchy was declared); the level fences any future "
+            "queue lock below the table, matching dispatch order"
+        ),
+        patterns=("compute", "queue"),
+    ),
+    LockDecl(
+        key="utils.ledger",
+        level=90,
+        kind="threading.Lock",
+        where="utils/ledger.py _lock",
+        doc=(
+            "guards the recovery-coverage counters; leaf — ledger points "
+            "fire from arbitrary lock contexts and must never nest"
+        ),
+        path_suffix="utils/ledger.py",
+        local_patterns=("_lock",),
+    ),
+    LockDecl(
+        key="wire.codec_stats",
+        level=90,
+        kind="threading.Lock",
+        where="wire/tensor_codec.py _TransportStats._lock",
+        doc=(
+            "guards the transport profiling counters; leaf — recorded "
+            "inside (de)serialization from arbitrary lock contexts"
+        ),
+        path_suffix="wire/tensor_codec.py",
+        local_patterns=("self._lock",),
+    ),
+)
+
+
+def by_key() -> dict[str, LockDecl]:
+    return {d.key: d for d in HIERARCHY}
+
+
+def level_of(key: str) -> int | None:
+    d = by_key().get(key)
+    return None if d is None else d.level
+
+
+def classify(text: str, path: str = "") -> str | None:
+    """Map a with-context expression (lowercased, string literals already
+    stripped) to a declared lock key, or None when it isn't one of ours.
+    Generic `self._lock` spellings resolve by declaring module; the
+    coarse manager/table/queue tokens keep PR 9's fixtures (and any
+    same-shaped future code) classifying exactly as before."""
+    if "lock" not in text and "cond" not in text:
+        return None
+    p = path.replace("\\", "/")
+    # path-scoped spellings first: `self._lock` means a DIFFERENT lock
+    # per module, so the global token passes must not claim those files
+    for d in HIERARCHY:
+        if d.path_suffix and p.endswith(d.path_suffix):
+            if any(t in text for t in d.local_patterns):
+                return d.key
+    for d in HIERARCHY:
+        if any(t in text for t in d.patterns):
+            return d.key
+    return None
+
+
+def edge_allowed(held: str, acquired: str) -> tuple[bool, str]:
+    """Is acquiring `acquired` while holding `held` consistent with the
+    declared partial order? Returns (ok, reason-when-not)."""
+    decls = by_key()
+    a, b = decls.get(held), decls.get(acquired)
+    if a is None or b is None:
+        return True, ""  # unknown locks are outside the declared order
+    if held == acquired:
+        if a.reentrant:
+            return True, ""
+        return False, f"{held} is not reentrant ({a.kind})"
+    if b.level > a.level:
+        return True, ""
+    if b.level == a.level:
+        return False, (
+            f"{acquired} and {held} are unordered peers (both level "
+            f"{a.level}) and must never nest"
+        )
+    return False, (
+        f"{acquired} (level {b.level}) acquired while holding {held} "
+        f"(level {a.level}); the declared order is ascending"
+    )
+
+
+def describe() -> str:
+    """The authoritative lock-hierarchy table (ARCHITECTURE.md's
+    generated "Lock hierarchy" section body)."""
+    lines = [
+        "| level | lock | kind | declared at | protects |",
+        "|---|---|---|---|---|",
+    ]
+    for d in HIERARCHY:
+        reent = " (reentrant)" if d.reentrant else ""
+        lines.append(
+            f"| {d.level} | `{d.key}` | {d.kind}{reent} | {d.where} "
+            f"| {d.doc} |"
+        )
+    return "\n".join(lines)

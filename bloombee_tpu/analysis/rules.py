@@ -1,10 +1,14 @@
-"""bbtpu-lint rules BB001–BB008.
+"""bbtpu-lint rules BB001–BB010.
 
 Each rule encodes one invariant this codebase has already been burned by
 (see ARCHITECTURE.md "Invariants"). Rules are plugin classes over the
 shared SourceFile from core.py: per-file `visit_file` plus a cross-file
 `finalize` for rules that correlate a declaration in one file with its
-surfacing in another (BB006) or need nothing global (most).
+surfacing in another (BB006) or need nothing global (most). Rules that
+define `prepare(files, graph)` additionally get the module-level call
+graph (analysis/callgraph.py) before the per-file pass — BB002/BB003/
+BB009 use it to follow lock effects across call edges and print the
+full call chain in the finding.
 
 Rule-authoring contract: a rule must be cheap (pure ast walk), must
 build findings via ``sf.finding(...)`` so `# bbtpu: noqa[...]` works,
@@ -18,6 +22,8 @@ from __future__ import annotations
 import ast
 import re
 
+from bloombee_tpu.analysis import lock_hierarchy
+from bloombee_tpu.analysis.callgraph import body_walk
 from bloombee_tpu.analysis.core import Finding, SourceFile
 
 _STRINGS_RE = re.compile(r"'[^']*'|\"[^\"]*\"")
@@ -176,13 +182,17 @@ class SpeculativeWriteRule(Rule):
 
 
 class BlockingUnderLockRule(Rule):
-    """BB002: no blocking call while a threading lock is held.
+    """BB002: no blocking call while a threading lock is held — now
+    TRANSITIVE across call edges.
 
     CacheManager serializes on one RLock (`@_locked`); a recv/sleep/
     future-result/device-sync inside it stalls every session on the
     server, which is exactly the head-of-line blocking PR 5/8 spent two
-    PRs removing from the dispatch path. asyncio locks are out of scope
-    (they don't pin a thread).
+    PRs removing from the dispatch path. v2: `with lock: flush()` where
+    flush() sleeps three helpers down is the same bug, so any resolved
+    call under the lock whose callee transitively reaches a blocking
+    site is flagged with the full call chain. asyncio locks are out of
+    scope here (they don't pin a thread) — BB009 owns the event loop.
     """
 
     code = "BB002"
@@ -197,6 +207,11 @@ class BlockingUnderLockRule(Rule):
         "resolve",
     }
 
+    def __init__(self):
+        self._graph = None
+        self._chains: dict[str, tuple[str, ...]] = {}
+        self._site: dict[str, str] = {}  # qname -> its blocking callee
+
     def _is_blocking(self, node: ast.Call) -> bool:
         f = node.func
         if isinstance(f, ast.Attribute):
@@ -208,15 +223,30 @@ class BlockingUnderLockRule(Rule):
                 return True
         return False
 
+    def prepare(self, files: list[SourceFile], graph) -> None:
+        self._graph = graph
+        for q, fi in graph.functions.items():
+            for n in body_walk(fi.node):
+                if isinstance(n, ast.Call) and self._is_blocking(n):
+                    self._site[q] = _expr_text(n.func)
+                    break
+        self._chains = graph.reach(set(self._site))
+
     def visit_file(self, sf: SourceFile) -> list[Finding]:
         out: list[Finding] = []
+        graph = self._graph
 
-        def walk(node: ast.AST, depth: int) -> None:
+        def walk(node: ast.AST, depth: int, cls, fname: str) -> None:
+            if isinstance(node, ast.ClassDef):
+                for child in ast.iter_child_nodes(node):
+                    walk(child, depth, node.name, fname)
+                return
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 # a nested def's body doesn't run under the outer lock
                 inner = 1 if _is_locked_decorated(node) else 0
+                label = f"{cls}.{node.name}" if cls else node.name
                 for child in ast.iter_child_nodes(node):
-                    walk(child, inner)
+                    walk(child, inner, cls, label)
                 return
             d = depth
             if isinstance(node, ast.With):  # sync only, not AsyncWith
@@ -225,93 +255,197 @@ class BlockingUnderLockRule(Rule):
                     for item in node.items
                 ):
                     d = depth + 1
-            if (
-                depth > 0
-                and isinstance(node, ast.Call)
-                and self._is_blocking(node)
-            ):
-                f = sf.finding(
-                    self.code,
-                    node,
-                    f"blocking call `{_expr_text(node.func)}(...)` while "
-                    "a threading lock is held stalls every thread "
-                    "contending for it; move it outside the lock",
-                )
-                if f:
-                    out.append(f)
+            if depth > 0 and isinstance(node, ast.Call):
+                if self._is_blocking(node):
+                    f = sf.finding(
+                        self.code,
+                        node,
+                        f"blocking call `{_expr_text(node.func)}(...)` "
+                        "while a threading lock is held stalls every "
+                        "thread contending for it; move it outside the "
+                        "lock",
+                    )
+                    if f:
+                        out.append(f)
+                elif graph is not None:
+                    q = graph.resolve(sf.path, cls, node)
+                    chain = self._chains.get(q) if q else None
+                    if chain:
+                        names = tuple(graph.display(x) for x in chain)
+                        if fname:
+                            names = (fname,) + names
+                        f = sf.finding(
+                            self.code,
+                            node,
+                            f"call `{_expr_text(node.func)}(...)` while "
+                            "a threading lock is held reaches blocking "
+                            f"`{self._site[chain[-1]]}(...)` via "
+                            f"{' -> '.join(names)}; move the blocking "
+                            "work outside the lock",
+                            chain=names,
+                        )
+                        if f:
+                            out.append(f)
             for child in ast.iter_child_nodes(node):
-                walk(child, d)
+                walk(child, d, cls, fname)
 
-        walk(sf.tree, 0)
+        walk(sf.tree, 0, None, "")
         return out
 
 
 class LockOrderRule(Rule):
-    """BB003: locks must be acquired in the declared hierarchy order
-    cache_manager(0) -> paged table(1) -> compute queue(2).
+    """BB003: locks must be acquired in the declared hierarchy
+    (analysis/lock_hierarchy.py) — now covering every package lock
+    (thread AND asyncio) and TRANSITIVE across call edges.
 
-    Acquiring a lower-numbered lock while holding a higher-numbered one
-    is the classic ABBA deadlock setup; the ordering matches the call
-    direction the code actually uses (manager methods reach into the
-    table, never the reverse).
+    Acquiring a lower-level lock while holding a higher-level one is the
+    classic ABBA deadlock setup; the levels in lock_hierarchy.HIERARCHY
+    match the call direction the code actually uses (replication sweep
+    reaches into the peer pool and the wire, manager methods reach into
+    the table — never the reverse). v2 also flags a call site under a
+    held lock whose callee transitively acquires an out-of-order lock,
+    with the full call chain, and resolves simple local aliases
+    (`lock = self._locks.setdefault(...)` then `async with lock:`).
     """
 
     code = "BB003"
     name = "lock-order-violation"
     summary = "lock acquired against the declared hierarchy"
 
-    HIERARCHY = ("cache_manager", "paged table", "compute queue")
+    def __init__(self):
+        self._graph = None
+        # lock key -> {qname: shortest chain to a direct acquirer}
+        self._chains: dict[str, dict[str, tuple[str, ...]]] = {}
 
-    def _level(self, sf: SourceFile, expr: ast.AST) -> int | None:
-        """Classify a with-context expression into a hierarchy level, or
-        None when it isn't a recognized lock."""
+    @staticmethod
+    def _classify(sf: SourceFile, expr: ast.AST, aliases: dict) -> str | None:
+        if isinstance(expr, ast.Name) and expr.id in aliases:
+            return aliases[expr.id]
         text = _STRINGS_RE.sub("", _expr_text(expr)).lower()
-        if "lock" not in text:
-            return None
-        if "manager" in text or "cache" in text:
-            return 0
-        if "table" in text or "paged" in text:
-            return 1
-        if "compute" in text or "queue" in text:
-            return 2
-        if text == "self._lock" and sf.path.endswith("kv/cache_manager.py"):
-            return 0
-        return None
+        return lock_hierarchy.classify(text, sf.path)
+
+    @classmethod
+    def _aliases(cls, sf: SourceFile, fn: ast.AST) -> dict[str, str]:
+        """name -> lock key for simple local lock aliases inside fn."""
+        out: dict[str, str] = {}
+        for n in body_walk(fn):
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+            ):
+                text = _STRINGS_RE.sub("", _expr_text(n.value)).lower()
+                key = lock_hierarchy.classify(text, sf.path)
+                if key:
+                    out[n.targets[0].id] = key
+        return out
+
+    @classmethod
+    def _direct_keys(cls, sf: SourceFile, fn: ast.AST) -> set[str]:
+        keys: set[str] = set()
+        if sf.path.endswith("kv/cache_manager.py") and _is_locked_decorated(
+            fn
+        ):
+            keys.add("kv.cache_manager")
+        aliases = cls._aliases(sf, fn)
+        for n in body_walk(fn):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    k = cls._classify(sf, item.context_expr, aliases)
+                    if k:
+                        keys.add(k)
+        return keys
+
+    def prepare(self, files: list[SourceFile], graph) -> None:
+        self._graph = graph
+        direct = {
+            q: self._direct_keys(fi.sf, fi.node)
+            for q, fi in graph.functions.items()
+        }
+        all_keys = set().union(*direct.values()) if direct else set()
+        self._chains = {
+            k: graph.reach({q for q, ks in direct.items() if k in ks})
+            for k in sorted(all_keys)
+        }
 
     def visit_file(self, sf: SourceFile) -> list[Finding]:
         out: list[Finding] = []
+        graph = self._graph
         in_cm = sf.path.endswith("kv/cache_manager.py")
 
-        def walk(node: ast.AST, held: list[int]) -> None:
+        def walk(node, held: list[str], cls, fname: str, aliases) -> None:
+            if isinstance(node, ast.ClassDef):
+                for child in ast.iter_child_nodes(node):
+                    walk(child, held, node.name, fname, aliases)
+                return
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 # @_locked methods run with the cache_manager lock held
-                inner = [0] if (in_cm and _is_locked_decorated(node)) else []
+                inner = (
+                    ["kv.cache_manager"]
+                    if (in_cm and _is_locked_decorated(node))
+                    else []
+                )
+                label = f"{cls}.{node.name}" if cls else node.name
+                fa = self._aliases(sf, node)
                 for child in ast.iter_child_nodes(node):
-                    walk(child, inner)
+                    walk(child, inner, cls, label, fa)
                 return
             h = held
-            if isinstance(node, ast.With):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
                 for item in node.items:
-                    lvl = self._level(sf, item.context_expr)
-                    if lvl is None:
+                    k = self._classify(sf, item.context_expr, aliases)
+                    if k is None:
                         continue
-                    worst = max((x for x in h if x > lvl), default=None)
-                    if worst is not None:
-                        f = sf.finding(
-                            self.code,
-                            node,
-                            f"acquires {self.HIERARCHY[lvl]} lock while "
-                            f"holding {self.HIERARCHY[worst]} lock; "
-                            "declared order is "
-                            f"{' -> '.join(self.HIERARCHY)}",
-                        )
-                        if f:
-                            out.append(f)
-                    h = h + [lvl]
+                    for prev in h:
+                        ok, why = lock_hierarchy.edge_allowed(prev, k)
+                        if not ok:
+                            f = sf.finding(
+                                self.code,
+                                node,
+                                f"acquires `{k}` while holding `{prev}`: "
+                                f"{why} (see analysis/lock_hierarchy.py)",
+                            )
+                            if f:
+                                out.append(f)
+                            break
+                    h = h + [k]
+            elif h and isinstance(node, ast.Call) and graph is not None:
+                q = graph.resolve(sf.path, cls, node)
+                if q:
+                    done = False
+                    for k, chains in self._chains.items():
+                        if done:
+                            break
+                        chain = chains.get(q)
+                        if not chain:
+                            continue
+                        for prev in h:
+                            ok, why = lock_hierarchy.edge_allowed(prev, k)
+                            if ok:
+                                continue
+                            names = tuple(
+                                graph.display(x) for x in chain
+                            )
+                            if fname:
+                                names = (fname,) + names
+                            f = sf.finding(
+                                self.code,
+                                node,
+                                f"call `{_expr_text(node.func)}(...)` "
+                                f"transitively acquires `{k}` via "
+                                f"{' -> '.join(names)} while holding "
+                                f"`{prev}`: {why} (see "
+                                "analysis/lock_hierarchy.py)",
+                                chain=names,
+                            )
+                            if f:
+                                out.append(f)
+                            done = True
+                            break
             for child in ast.iter_child_nodes(node):
-                walk(child, h)
+                walk(child, h, cls, fname, aliases)
 
-        walk(sf.tree, [])
+        walk(sf.tree, [], None, "", {})
         return out
 
 
@@ -707,6 +841,207 @@ class RawClockRule(Rule):
         return out
 
 
+class AsyncBlockingRule(Rule):
+    """BB009: blocking sync work on the event loop.
+
+    One stalled loop tick delays EVERY session on the server — an
+    event-loop stall is a time-between-tokens regression for the whole
+    swarm, the exact Orca-metric the batcher exists to protect. Two
+    modes on the shared call graph:
+
+    - direct: a blocking sync call (`clock.sleep`, d2h `.resolve()` /
+      `block_until_ready`, `open` file I/O, tensor (de)serialization)
+      written directly in a coroutine body. Awaited calls are exempt
+      (`await clock.async_sleep()` suspends, it doesn't block), and
+      callables passed to `to_thread`/`run_in_executor` never look like
+      call sites, so thread offload stays quiet by construction.
+    - transitive, inside an `async with <lock>` critical section: a
+      resolved call whose callee reaches a blocking site through the
+      call graph. Under an asyncio lock a stall is a convoy — every
+      task queued on the lock serializes behind the blocked tick — so
+      the deeper search is worth its false-positive risk there, and
+      only there.
+
+    Out-of-package harnesses (bench.py, scripts/) keep their blocking
+    I/O and are out of scope, like BB008.
+    """
+
+    code = "BB009"
+    name = "event-loop-blocking-call"
+    summary = "blocking sync call on the event loop / under an asyncio lock"
+
+    BLOCKING_ATTRS = {"sleep", "resolve", "block_until_ready"}
+    BLOCKING_NAMES = {"open", "serialize_tensors", "deserialize_tensors"}
+
+    def __init__(self):
+        self._graph = None
+        self._chains: dict[str, tuple[str, ...]] = {}
+        self._site: dict[str, str] = {}
+
+    def _in_scope(self, path: str) -> bool:
+        p = path.replace("\\", "/")
+        return "bloombee_tpu/" in p or p.startswith(
+            ("client/", "server/", "kv/", "swarm/", "wire/", "utils/",
+             "models/", "runtime/", "cli/", "analysis/")
+        )
+
+    def _is_blocking(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            return (
+                f.attr in self.BLOCKING_ATTRS
+                or f.attr in self.BLOCKING_NAMES
+            )
+        if isinstance(f, ast.Name):
+            return f.id in self.BLOCKING_NAMES
+        return False
+
+    def prepare(self, files: list[SourceFile], graph) -> None:
+        self._graph = graph
+        for q, fi in graph.functions.items():
+            if not self._in_scope(fi.path):
+                continue
+            nodes = list(body_walk(fi.node))
+            awaited = {
+                id(n.value)
+                for n in nodes
+                if isinstance(n, ast.Await)
+                and isinstance(n.value, ast.Call)
+            }
+            for n in nodes:
+                if (
+                    isinstance(n, ast.Call)
+                    and id(n) not in awaited
+                    and self._is_blocking(n)
+                ):
+                    self._site[q] = _expr_text(n.func)
+                    break
+        self._chains = graph.reach(set(self._site))
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        if not self._in_scope(sf.path):
+            return []
+        out: list[Finding] = []
+        graph = self._graph
+        awaited = {
+            id(n.value)
+            for n in ast.walk(sf.tree)
+            if isinstance(n, ast.Await) and isinstance(n.value, ast.Call)
+        }
+
+        def walk(node, cls, fname: str, in_async: bool, alock: int):
+            if isinstance(node, ast.ClassDef):
+                for child in ast.iter_child_nodes(node):
+                    walk(child, node.name, fname, False, 0)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def runs when called, not here; its body gets
+                # its own loop/lock context
+                label = f"{cls}.{node.name}" if cls else node.name
+                is_async = isinstance(node, ast.AsyncFunctionDef)
+                for child in ast.iter_child_nodes(node):
+                    walk(child, cls, label, is_async, 0)
+                return
+            a = alock
+            if isinstance(node, ast.AsyncWith):
+                if any(
+                    _mentions_lock(item.context_expr)
+                    for item in node.items
+                ):
+                    a = alock + 1
+            if isinstance(node, ast.Call):
+                if (
+                    in_async
+                    and id(node) not in awaited
+                    and self._is_blocking(node)
+                ):
+                    where = (
+                        "inside an `async with` lock critical section"
+                        if alock
+                        else "in a coroutine on the event loop"
+                    )
+                    f = sf.finding(
+                        self.code,
+                        node,
+                        "blocking sync call "
+                        f"`{_expr_text(node.func)}(...)` {where} stalls "
+                        "every task on the loop (a TBT regression for "
+                        "every session); await an async variant or move "
+                        "it to asyncio.to_thread/run_in_executor",
+                    )
+                    if f:
+                        out.append(f)
+                elif alock and in_async and graph is not None:
+                    q = graph.resolve(sf.path, cls, node)
+                    chain = self._chains.get(q) if q else None
+                    if chain:
+                        names = tuple(graph.display(x) for x in chain)
+                        if fname:
+                            names = (fname,) + names
+                        f = sf.finding(
+                            self.code,
+                            node,
+                            f"call `{_expr_text(node.func)}(...)` inside "
+                            "an `async with` lock critical section "
+                            "reaches blocking "
+                            f"`{self._site[chain[-1]]}(...)` via "
+                            f"{' -> '.join(names)}; the loop stalls with "
+                            "the lock held, convoying every task queued "
+                            "on it — move the blocking work to a thread "
+                            "or out of the critical section",
+                            chain=names,
+                        )
+                        if f:
+                            out.append(f)
+            for child in ast.iter_child_nodes(node):
+                walk(child, cls, fname, in_async, a)
+
+        walk(sf.tree, None, "", False, 0)
+        return out
+
+
+class FireAndForgetTaskRule(Rule):
+    """BB010: no fire-and-forget `create_task`/`ensure_future`.
+
+    A task whose handle is discarded loses its exception to the GC's
+    "Task exception was never retrieved" black hole — and the task
+    itself can be collected mid-flight (asyncio only holds a weak
+    reference). The promotion/announce loops died exactly this way
+    before the supervisor existed. Only a bare expression statement
+    counts: assigning the handle, returning it, passing it to a
+    gather/list, or chaining `.add_done_callback(...)` (the rpc._spawn
+    pattern) all keep an owner and stay quiet.
+    """
+
+    code = "BB010"
+    name = "fire-and-forget-task"
+    summary = "create_task/ensure_future handle discarded"
+
+    SPAWNERS = {"create_task", "ensure_future"}
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and _call_name(node.value) in self.SPAWNERS
+            ):
+                f = sf.finding(
+                    self.code,
+                    node,
+                    "task handle discarded: fire-and-forget "
+                    f"`{_expr_text(node.value.func)}(...)` loses the "
+                    "task's exception and the task itself can be GC'd "
+                    "mid-flight; keep the handle and attach "
+                    "add_done_callback (see wire/rpc.py _spawn) or "
+                    "register it with the supervisor",
+                )
+                if f:
+                    out.append(f)
+        return out
+
+
 def make_rules() -> list[Rule]:
     """Fresh rule instances (BB006 keeps cross-file state)."""
     return [
@@ -718,6 +1053,8 @@ def make_rules() -> list[Rule]:
         CounterSurfacingRule(),
         ExactTensorCompareRule(),
         RawClockRule(),
+        AsyncBlockingRule(),
+        FireAndForgetTaskRule(),
     ]
 
 

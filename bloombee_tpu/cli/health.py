@@ -224,6 +224,22 @@ def main(argv=None):
                         line += "  " + " ".join(
                             f"{k}={v}" for k, v in sorted(integ.items())
                         )
+                    # lock-witness counters (BBTPU_LOCKWATCH=1 runs):
+                    # observed acquisition-order edges and hierarchy
+                    # violations — ANY nonzero lock_violations is a
+                    # deadlock setup waiting for the right interleaving
+                    watch = {
+                        k: probe[k]
+                        for k in (
+                            "lock_order_edges",
+                            "lock_violations",
+                        )
+                        if probe.get(k)
+                    }
+                    if watch:
+                        line += "  " + " ".join(
+                            f"{k}={v}" for k, v in sorted(watch.items())
+                        )
                     # session lease counters: are leases reaping abandoned
                     # sessions, are clients resuming instead of replaying,
                     # and is keepalive traffic flowing on idle conns

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bloombee_tpu.kv import arena as arena_ops
-from bloombee_tpu.utils import clock, env
+from bloombee_tpu.utils import clock, env, lockwatch
 
 env.declare(
     "BBTPU_PARK_QUANT", bool, False,
@@ -272,7 +272,7 @@ class CacheManager:
         # reclaim-parking) and the event loop (session teardown): a
         # reentrant lock keeps them atomic (reentrant because the reclaimer
         # runs inside write_slots/ensure_resident which already hold it)
-        self._lock = threading.RLock()
+        self._lock = lockwatch.thread_lock("kv.cache_manager", reentrant=True)
 
     @property
     def admit_limit(self) -> int:
@@ -479,7 +479,6 @@ class CacheManager:
         for sid in handle.seq_ids:
             self.table.rollback(sid)
 
-    @_locked
     def accept_speculative(
         self, handle: CacheHandle, accepted_indices: list
     ) -> None:
@@ -491,8 +490,21 @@ class CacheManager:
         in path order (depth 0, 1, ...).
         """
         # an over-subscribed server may have parked this session between
-        # rounds; the accept operates on live table state
-        self.ensure_resident(handle)
+        # rounds. Unpark OUTSIDE the lock — ensure_resident's d2h resolve
+        # must not run with the manager lock held — then re-check under
+        # it: the reclaimer (serving another session) may park us again
+        # in the gap.
+        while True:
+            self.ensure_resident(handle)
+            with self._lock:
+                if any(sid in self._parked for sid in handle.seq_ids):
+                    continue
+                return self._accept_speculative(handle, accepted_indices)
+
+    @_locked
+    def _accept_speculative(
+        self, handle: CacheHandle, accepted_indices: list
+    ) -> None:
         src_all, dst_all = [], []
         for sid, idx in zip(handle.seq_ids, accepted_indices):
             st = self.table.seq(sid)
@@ -521,21 +533,42 @@ class CacheManager:
             jnp.asarray(src_p), jnp.asarray(dst_p),
         )
 
-    @_locked
     def ensure_resident(self, handle: CacheHandle) -> None:
         """Unpark any parked sequences of this handle before a step (the
         demand-paging half of over-subscription), reclaiming pages from
         idle sessions when tight. Raises OutOfPages when nothing can be
-        evicted — the client's retry path handles it."""
-        parked = [sid for sid in handle.seq_ids if sid in self._parked]
-        for sid in parked:
-            l_seq = self._parked[sid].l_seq
-            need = -(-l_seq // self.page_size)
-            if need > self.table.free_pages and self.reclaimer is not None:
-                self.reclaimer(
-                    need - self.table.free_pages, set(handle.seq_ids)
-                )
-            self.unpark_sequence(sid)
+        evicted — the client's retry path handles it.
+
+        Deliberately NOT @_locked: unpark_sequence resolves the parked
+        d2h future, and that resolve must run with the manager lock
+        RELEASED (its whole point — see unpark_sequence). An @_locked
+        wrapper here reentrantly defeats that and stalls every cache op
+        on the server behind one session's host copy. Page accounting
+        and the reclaimer still run under a short lock hold per
+        sequence."""
+        while True:
+            with self._lock:
+                parked = [
+                    sid for sid in handle.seq_ids if sid in self._parked
+                ]
+                if not parked:
+                    return
+                sid = parked[0]
+                l_seq = self._parked[sid].l_seq
+                need = -(-l_seq // self.page_size)
+                if (
+                    need > self.table.free_pages
+                    and self.reclaimer is not None
+                ):
+                    self.reclaimer(
+                        need - self.table.free_pages, set(handle.seq_ids)
+                    )
+            try:
+                self.unpark_sequence(sid)
+            except KeyError:
+                # raced with a lease-teardown purge between the scan and
+                # the unpark; the entry is gone, re-scan what's left
+                continue
 
     # ------------------------------------------------------- prefix cache
     def _apply_pending_copies(self) -> None:

@@ -46,7 +46,7 @@ from bloombee_tpu.server.compute_queue import (
     aged_chunk_priority,
 )
 from bloombee_tpu.swarm.data import ServerInfo, ServerState
-from bloombee_tpu.utils import clock, env, ledger
+from bloombee_tpu.utils import clock, env, ledger, lockwatch
 from bloombee_tpu.wire.flow import FlowLimiter
 from bloombee_tpu.wire.rpc import (
     Connection,
@@ -281,7 +281,7 @@ class _Session:
         self.repl_standby: tuple[str, int] | None = None
         self.repl_chains: list[list[str]] | None = None
         self.repl_sent: list[int] | None = None
-        self.repl_lock = asyncio.Lock()
+        self.repl_lock = lockwatch.async_lock("server.repl")
         # session lease / reconnect-resume state. The stream-opening RPC
         # handler OWNS the KV pages (allocate context) and survives stream
         # death: it parks, then waits on resume_waiter for either a resume
@@ -335,7 +335,9 @@ class _PeerPool:
 
     async def get(self, host: str, port: int) -> Connection:
         key = (host, port)
-        lock = self._locks.setdefault(key, asyncio.Lock())
+        lock = self._locks.get(key)
+        if lock is None:
+            lock = self._locks[key] = lockwatch.async_lock("server.peer_pool")
         async with lock:
             conn = self._conns.get(key)
             if conn is None or conn.is_closing():
@@ -1836,6 +1838,11 @@ class BlockServer:
             "audit_forwards": self.audit_forwards,
             "liar_steps": self.liar_steps,
             "seq_hash_extend_failures": self.seq_hash_extend_failures,
+            # lock-witness observability (BBTPU_LOCKWATCH=1): distinct
+            # acquisition-order edges observed in this process and
+            # hierarchy violations + cycles; both zero (and harmless)
+            # when the witness is off, so probes need no conditionals
+            **lockwatch.counters(),
             # overload observability: shed/admit counters, retry_after
             # histogram, and per-client fair-share debt (None with the
             # admission controller off; the live load snapshot itself rides
@@ -1947,7 +1954,13 @@ class BlockServer:
         if session.repl_lock.locked():
             return  # an earlier trigger is still draining the backlog
         async with session.repl_lock:
-            while await self._replicate_pass(session):
+            # BB009 owner: block-server team. The chain reaches
+            # Connection.call's wire serialization, but repl_lock is a
+            # per-session drain latch (sole contender is a concurrent
+            # trigger, which bails on locked() above) — nothing convoys
+            # behind it, and payload size is bounded by _repl_sem plus
+            # the per-pass page budget.
+            while await self._replicate_pass(session):  # bbtpu: noqa[BB009]
                 pass
 
     async def _replicate_pass(self, session: _Session) -> bool:
@@ -1986,15 +1999,18 @@ class BlockServer:
                     continue
                 # device [L, n*ps, kv, hd] -> host [n, L, ps, kv, hd]
                 # (one leading page axis so the standby scatters per hash)
-                k = await asyncio.to_thread(np.asarray, k_dev)
-                v = await asyncio.to_thread(np.asarray, v_dev)
-                shape = (k.shape[0], n, ps) + k.shape[2:]
-                k = np.ascontiguousarray(
-                    np.swapaxes(k.reshape(shape), 0, 1)
-                )
-                v = np.ascontiguousarray(
-                    np.swapaxes(v.reshape(shape), 0, 1)
-                )
+                def _export(dev, n=n, ps=ps):
+                    a = np.asarray(dev)
+                    shape = (a.shape[0], n, ps) + a.shape[2:]
+                    # the swapaxes copy is O(pages shipped) host work —
+                    # keep it on the same worker thread as the d2h pull,
+                    # not the event loop
+                    return np.ascontiguousarray(
+                        np.swapaxes(a.reshape(shape), 0, 1)
+                    )
+
+                k = await asyncio.to_thread(_export, k_dev)
+                v = await asyncio.to_thread(_export, v_dev)
                 try:
                     conn = await self.peers.get(*standby)
                     reply, _ = await conn.call(
@@ -3487,7 +3503,9 @@ class BlockServer:
             self._client_params_unavailable = True
             return
         if self._client_params_lock is None:
-            self._client_params_lock = asyncio.Lock()
+            self._client_params_lock = lockwatch.async_lock(
+                "server.client_params"
+            )
         async with self._client_params_lock:
             if (
                 self._client_params is None
@@ -4376,7 +4394,7 @@ class BlockServer:
         if self._pruner_manager is not None or self._pruner_unavailable:
             return
         if self._pruner_lock is None:
-            self._pruner_lock = asyncio.Lock()
+            self._pruner_lock = lockwatch.async_lock("server.pruner")
         async with self._pruner_lock:
             if self._pruner_manager is None and not self._pruner_unavailable:
                 await asyncio.to_thread(self._load_pruner)

@@ -20,7 +20,7 @@ import logging
 import os
 
 from bloombee_tpu.swarm.data import ModuleInfo, ServerInfo
-from bloombee_tpu.utils import clock
+from bloombee_tpu.utils import clock, lockwatch
 from bloombee_tpu.wire.rpc import Connection, RpcServer, connect
 
 logger = logging.getLogger(__name__)
@@ -159,8 +159,10 @@ class RegistryServer:
     async def start(self):
         if self.persist_path and os.path.exists(self.persist_path):
             try:
-                with open(self.persist_path) as f:
-                    self._store.load_snapshot(json.load(f))
+                # read + parse off-loop: a registry restarting into a big
+                # swarm snapshot must not stall peers already reconnecting
+                snap = await asyncio.to_thread(self._read_snapshot)
+                self._store.load_snapshot(snap)
             except Exception as e:
                 # a corrupt snapshot must not block bootstrap
                 self._note_swallow("snapshot load", e)
@@ -178,8 +180,14 @@ class RegistryServer:
                 await self._persist_task
             except (asyncio.CancelledError, Exception):
                 pass
-            self._write_snapshot()
+            # final write off-loop too: stop() runs while peer
+            # connections are still draining on this loop
+            await asyncio.to_thread(self._write_snapshot)
         await self.rpc.stop()
+
+    def _read_snapshot(self) -> dict:
+        with open(self.persist_path) as f:
+            return json.load(f)
 
     def _write_snapshot(self) -> None:
         tmp = f"{self.persist_path}.tmp"
@@ -248,7 +256,7 @@ class RegistryClient:
         self.host = host
         self.port = port
         self._conn: Connection | None = None
-        self._lock = asyncio.Lock()
+        self._lock = lockwatch.async_lock("registry.client")
 
     async def _connection(self) -> Connection:
         async with self._lock:

@@ -37,9 +37,8 @@ from __future__ import annotations
 import atexit
 import collections
 import json
-import threading
 
-from bloombee_tpu.utils import env
+from bloombee_tpu.utils import env, lockwatch
 
 env.declare(
     "BBTPU_CHAOS_LEDGER", str, "",
@@ -48,7 +47,7 @@ env.declare(
     "scripts/chaos.sh so the gate can fail entries that tested nothing",
 )
 
-_lock = threading.Lock()
+_lock = lockwatch.thread_lock("utils.ledger")
 _faults: collections.Counter = collections.Counter()
 _recoveries: collections.Counter = collections.Counter()
 _atexit_registered = False

@@ -1,0 +1,380 @@
+"""Runtime lock-order witness — the dynamic half of bbtpu-lint's
+concurrency story (the static half is analysis/callgraph.py + BB002/
+BB003/BB009).
+
+Static analysis proves what the code CAN do; this module records what a
+run ACTUALLY did. Opt-in via ``BBTPU_LOCKWATCH=1``: the package's locks
+are constructed through :func:`thread_lock` / :func:`async_lock`, which
+return plain stdlib locks when the switch is off (zero overhead, zero
+behavior change) and thin witness wrappers when it's on. Every wrapper
+acquisition records acquisition-order edges ``(held key, acquired key)``
+into one process-wide graph — per-task held-sets ride a ContextVar
+(copy-on-write tuples, so they survive await boundaries and propagate
+through ``asyncio.to_thread``), per-thread held-sets a threading.local —
+and checks each edge against the declared partial order
+(analysis/lock_hierarchy.py) as it happens.
+
+At interpreter exit the witness appends one JSON line to
+``BBTPU_LOCKWATCH_REPORT`` (append mode, multi-process merge — same
+shape as utils/ledger.py). ``python -m bloombee_tpu.utils.lockwatch PATH
+--require`` merges the lines, runs cycle detection over the union edge
+graph, and fails (exit 1) when the run observed ZERO cross-lock edges —
+a witness that watched nothing is a vacuous green, exactly like an
+empty chaos ledger — or when ANY hierarchy violation or cycle was
+observed. An observed edge the declared order calls impossible is the
+cross-validation failing: either the code or the declaration is wrong,
+and both are one file away.
+
+Scope: the package's Locks (thread and asyncio). Conditions
+(wire/flow.py limiter, cache_manager admission) stay unwatched — their
+critical sections are pure bookkeeping and wrapping wait/notify adds
+witness states the graph can't interpret. clock is deliberately NOT
+imported here (the ledger/clock/lockwatch utility layer must stay
+import-cycle-free).
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextvars
+import json
+import threading
+
+from bloombee_tpu.analysis import lock_hierarchy
+from bloombee_tpu.utils import env
+
+env.declare(
+    "BBTPU_LOCKWATCH", bool, False,
+    "wrap the package's locks in runtime lock-order witnesses: records "
+    "per-thread/per-task acquisition-order edges, validates them against "
+    "the declared hierarchy (analysis/lock_hierarchy.py) live, and "
+    "reports at exit. Off = plain stdlib locks, zero overhead",
+)
+env.declare(
+    "BBTPU_LOCKWATCH_REPORT", str, "",
+    "path to append this process's lock-witness report to at exit (one "
+    "JSON line: observed edges, hierarchy violations); empty = in-memory "
+    "only. Set by scripts/chaos.sh so the gate can cross-validate the "
+    "run against the static lock model",
+)
+
+_MAX_VIOLATIONS = 100  # keep the report bounded under a hot violation
+
+
+class _Witness:
+    """Process-wide acquisition-order graph. Internal mutex is a PLAIN
+    threading.Lock — the witness must never watch itself."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.edges: dict[tuple[str, str], int] = {}
+        self.violations: list[dict] = []
+        self._tls = threading.local()
+        self._task_held: contextvars.ContextVar[tuple[str, ...]] = (
+            contextvars.ContextVar("bbtpu_lockwatch_held", default=())
+        )
+
+    # ------------------------------------------------------------- stacks
+    def _thread_stack(self) -> list[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def held(self) -> tuple[str, ...]:
+        """Everything this execution context holds: the task's asyncio
+        holds (visible to sync code running inline on the loop, and to
+        to_thread workers via context propagation) plus this thread's
+        thread-lock holds."""
+        return self._task_held.get() + tuple(self._thread_stack())
+
+    # ------------------------------------------------------------ recording
+    def acquire(self, key: str, reentrant: bool, domain: str) -> None:
+        held = self.held()
+        with self._mu:
+            for h in held:
+                if h == key:
+                    if not reentrant:
+                        self._violation(
+                            h, key, f"{key} re-acquired (not reentrant)"
+                        )
+                    continue
+                pair = (h, key)
+                self.edges[pair] = self.edges.get(pair, 0) + 1
+                ok, why = lock_hierarchy.edge_allowed(h, key)
+                if not ok:
+                    self._violation(h, key, why)
+        if domain == "task":
+            self._task_held.set(self._task_held.get() + (key,))
+        else:
+            self._thread_stack().append(key)
+
+    def release(self, key: str, domain: str) -> None:
+        if domain == "task":
+            held = list(self._task_held.get())
+            if key in held:
+                held.reverse()
+                held.remove(key)
+                held.reverse()
+                self._task_held.set(tuple(held))
+        else:
+            st = self._thread_stack()
+            if key in st:
+                st.reverse()
+                st.remove(key)
+                st.reverse()
+
+    def _violation(self, held: str, acquired: str, why: str) -> None:
+        if len(self.violations) < _MAX_VIOLATIONS:
+            self.violations.append(
+                {"held": held, "acquired": acquired, "why": why}
+            )
+
+    # ------------------------------------------------------------- reading
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "edges": [
+                    [a, b, n] for (a, b), n in sorted(self.edges.items())
+                ],
+                "violations": list(self.violations),
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self.edges.clear()
+            self.violations.clear()
+        # also drop the CALLING context's held-state (other threads'
+        # stacks are theirs to unwind): a harness that leaked a hold
+        # would otherwise poison every later record with false edges
+        self._thread_stack().clear()
+        self._task_held.set(())
+
+
+_witness = _Witness()
+_atexit_registered = False
+
+
+def enabled() -> bool:
+    return bool(env.get("BBTPU_LOCKWATCH"))
+
+
+def _ensure_atexit() -> None:
+    global _atexit_registered
+    if not _atexit_registered:
+        _atexit_registered = True
+        if env.get("BBTPU_LOCKWATCH_REPORT"):
+            atexit.register(flush)
+
+
+# ------------------------------------------------------------ lock wrappers
+class _WatchedThreadLock:
+    def __init__(self, key: str, reentrant: bool):
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self._key = key
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _witness.acquire(self._key, self._reentrant, "thread")
+        return ok
+
+    def release(self) -> None:
+        _witness.release(self._key, "thread")
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _WatchedAsyncLock:
+    def __init__(self, key: str):
+        import asyncio
+
+        self._inner = asyncio.Lock()
+        self._key = key
+
+    async def acquire(self) -> bool:
+        await self._inner.acquire()
+        _witness.acquire(self._key, False, "task")
+        return True
+
+    def release(self) -> None:
+        _witness.release(self._key, "task")
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    async def __aenter__(self):
+        await self.acquire()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        self.release()
+
+
+def thread_lock(key: str, reentrant: bool = False):
+    """A threading.Lock/RLock for hierarchy key `key` — plain stdlib
+    object when the witness is off (the zero-overhead contract)."""
+    if not enabled():
+        return threading.RLock() if reentrant else threading.Lock()
+    _ensure_atexit()
+    return _WatchedThreadLock(key, reentrant)
+
+
+def async_lock(key: str):
+    """An asyncio.Lock for hierarchy key `key` — plain asyncio.Lock when
+    the witness is off. Construct on the loop, like asyncio.Lock."""
+    if not enabled():
+        import asyncio
+
+        return asyncio.Lock()
+    _ensure_atexit()
+    return _WatchedAsyncLock(key)
+
+
+# --------------------------------------------------------------- reporting
+def find_cycles(edges) -> list[list[str]]:
+    """Cycles in an edge iterable of (a, b) pairs — impossible while
+    every edge respects the ascending declared order, so any cycle means
+    undeclared locks interleaving in both directions."""
+    adj: dict[str, set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    cycles: list[list[str]] = []
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {k: WHITE for k in adj}
+    path: list[str] = []
+
+    def dfs(u: str) -> None:
+        color[u] = GRAY
+        path.append(u)
+        for v in adj.get(u, ()):
+            c = color.get(v, WHITE)
+            if c == GRAY:
+                cycles.append(path[path.index(v):] + [v])
+            elif c == WHITE:
+                dfs(v)
+        path.pop()
+        color[u] = BLACK
+
+    for k in list(adj):
+        if color.get(k, WHITE) == WHITE:
+            dfs(k)
+    return cycles
+
+
+def counters() -> dict:
+    """Live counter pair for rpc_info / health --probe."""
+    snap = _witness.snapshot()
+    return {
+        "lock_order_edges": len(snap["edges"]),
+        "lock_violations": (
+            len(snap["violations"])
+            + len(find_cycles((a, b) for a, b, _ in snap["edges"]))
+        ),
+    }
+
+
+def snapshot() -> dict:
+    return _witness.snapshot()
+
+
+def reset() -> None:
+    _witness.reset()
+
+
+def flush(path: str | None = None) -> None:
+    """Append this process's witness report as one JSON line (atexit
+    hook; callable directly by harnesses)."""
+    path = path or env.get("BBTPU_LOCKWATCH_REPORT")
+    if not path:
+        return
+    snap = _witness.snapshot()
+    if not snap["edges"] and not snap["violations"]:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(snap, sort_keys=True) + "\n")
+    except OSError:  # the witness must never take down the run it audits
+        pass
+
+
+def merge_lines(text: str) -> dict:
+    """Merge a multi-process report file into one edge/violation set."""
+    edges: dict[tuple[str, str], int] = {}
+    violations: list[dict] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            snap = json.loads(line)
+        except ValueError:
+            continue
+        for a, b, n in snap.get("edges") or []:
+            edges[(a, b)] = edges.get((a, b), 0) + int(n)
+        violations.extend(snap.get("violations") or [])
+    return {
+        "edges": [[a, b, n] for (a, b), n in sorted(edges.items())],
+        "violations": violations,
+    }
+
+
+def _main(argv=None) -> int:
+    """``python -m bloombee_tpu.utils.lockwatch PATH [--require]``: merge
+    and print a witness report; with --require, exit 1 unless the run
+    observed >=1 cross-lock edge (proof the witness wasn't vacuous) with
+    ZERO hierarchy violations and ZERO cycles."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(description=_main.__doc__)
+    ap.add_argument("path")
+    ap.add_argument("--require", action="store_true",
+                    help="fail (exit 1) on zero edges or any violation")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            text = f.read()
+    except OSError:
+        text = ""
+    merged = merge_lines(text)
+    cycles = find_cycles((a, b) for a, b, _ in merged["edges"])
+    print(
+        f"lockwatch: {len(merged['edges'])} edge(s), "
+        f"{len(merged['violations'])} violation(s), "
+        f"{len(cycles)} cycle(s)"
+    )
+    for a, b, n in merged["edges"]:
+        print(f"  edge {a} -> {b} x{n}")
+    for v in merged["violations"]:
+        print(f"  VIOLATION {v['held']} -> {v['acquired']}: {v['why']}")
+    for c in cycles:
+        print(f"  CYCLE {' -> '.join(c)}")
+    if args.require:
+        if not merged["edges"]:
+            print(
+                "lockwatch: EMPTY — a witness-enabled run must observe "
+                ">=1 cross-lock acquisition edge; a run that never nested "
+                "two watched locks validated nothing", file=sys.stderr,
+            )
+            return 1
+        if merged["violations"] or cycles:
+            print(
+                "lockwatch: observed lock order contradicts the declared "
+                "hierarchy (analysis/lock_hierarchy.py) — either the code "
+                "or the declaration is wrong; fix one", file=sys.stderr,
+            )
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

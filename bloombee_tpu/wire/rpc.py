@@ -9,6 +9,16 @@ any number of concurrent calls and streams by frame id.
 Frame layout: [u32 frame_len][u32 header_len][msgpack header][tensor blobs].
 The header carries method, metadata (msgpack dict — the reference's MSGPack
 sidecar), and per-tensor codec metas (see tensor_codec).
+
+Sync codec on the loop (the BB009 noqas below, owner: wire layer): every
+serialize/deserialize_tensors call in this module runs synchronously in a
+coroutine by design. This module IS the event loop's serialization
+boundary — payloads are bounded by the page/chunk budgets the callers
+enforce, codec time is profiled via tensor_codec's transport stats, and a
+per-frame asyncio.to_thread hop costs more in latency and ordering
+complexity than the sub-ms codec work it would offload. Callers holding an
+asyncio lock across these calls do NOT inherit this justification — the
+transitive BB009 pass flags them at their own site.
 """
 
 from __future__ import annotations
@@ -22,7 +32,7 @@ from typing import Awaitable, Callable
 import msgpack
 import numpy as np
 
-from bloombee_tpu.utils import clock, env
+from bloombee_tpu.utils import clock, env, lockwatch
 from bloombee_tpu.wire import faults
 from bloombee_tpu.wire.tensor_codec import (
     deserialize_tensors,
@@ -118,7 +128,7 @@ class Stream:
                    compression: bool = True) -> None:
         if self._closed_local:
             raise RpcError("stream closed")
-        tm, blobs = serialize_tensors(tensors or [], compression)
+        tm, blobs = serialize_tensors(tensors or [], compression)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
         await self.conn._send(
             {"t": "sitem", "id": self.id, "meta": meta, "tm": tm}, blobs
         )
@@ -179,7 +189,7 @@ class Connection:
         self._streams: dict[int, Stream] = {}
         self._unary_tasks: dict[int, asyncio.Task] = {}
         self._tasks: set[asyncio.Task] = set()
-        self._send_lock = asyncio.Lock()
+        self._send_lock = lockwatch.async_lock("rpc.send")
         self._reader_task: asyncio.Task | None = None
         self._closed = asyncio.Event()
         self.on_close: Callable[["Connection"], None] | None = None
@@ -262,7 +272,7 @@ class Connection:
         rid = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
-        tm, blobs = serialize_tensors(tensors or [], compression)
+        tm, blobs = serialize_tensors(tensors or [], compression)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
         await self._send(
             {"t": "req", "id": rid, "m": method, "meta": meta or {}, "tm": tm},
             blobs,
@@ -289,7 +299,7 @@ class Connection:
         compression: bool = True,
     ) -> None:
         """Fire-and-forget (the reference's rpc_push plane)."""
-        tm, blobs = serialize_tensors(tensors or [], compression)
+        tm, blobs = serialize_tensors(tensors or [], compression)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
         await self._send(
             {"t": "push", "id": 0, "m": method, "meta": meta or {}, "tm": tm},
             blobs,
@@ -305,7 +315,7 @@ class Connection:
         rid = next(self._ids)
         stream = Stream(self, rid, meta or {}, tensors or [])
         self._streams[rid] = stream
-        tm, blobs = serialize_tensors(tensors or [], compression)
+        tm, blobs = serialize_tensors(tensors or [], compression)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
         await self._send(
             {"t": "sopen", "id": rid, "m": method, "meta": meta or {}, "tm": tm},
             blobs,
@@ -417,14 +427,14 @@ class Connection:
         elif t == "push":
             self._spawn(self._handle_push(header, blobs))
         elif t == "sopen":
-            tensors = deserialize_tensors(header.get("tm", []), blobs)
+            tensors = deserialize_tensors(header.get("tm", []), blobs)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
             stream = Stream(self, rid, header.get("meta", {}), tensors)
             self._streams[rid] = stream
             self._spawn(self._handle_stream(header["m"], stream))
         elif t == "sitem":
             stream = self._streams.get(rid)
             if stream is not None:
-                tensors = deserialize_tensors(header.get("tm", []), blobs)
+                tensors = deserialize_tensors(header.get("tm", []), blobs)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
                 stream._push_inbound((header.get("meta", {}), tensors))
         elif t == "send":
             stream = self._streams.get(rid)
@@ -433,7 +443,7 @@ class Connection:
         elif t == "res":
             fut = self._pending.get(rid)
             if fut is not None and not fut.done():
-                tensors = deserialize_tensors(header.get("tm", []), blobs)
+                tensors = deserialize_tensors(header.get("tm", []), blobs)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
                 fut.set_result((header.get("meta", {}), tensors))
         elif t == "err":
             fut = self._pending.get(rid)
@@ -470,9 +480,9 @@ class Connection:
             handler = self.unary_handlers.get(method)
             if handler is None:
                 raise RpcError(f"no such method: {method}")
-            tensors = deserialize_tensors(header.get("tm", []), blobs)
+            tensors = deserialize_tensors(header.get("tm", []), blobs)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
             meta, out = await handler(header.get("meta", {}), tensors)
-            tm, oblobs = serialize_tensors(out)
+            tm, oblobs = serialize_tensors(out)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
             await self._send({"t": "res", "id": rid, "meta": meta, "tm": tm}, oblobs)
         except asyncio.CancelledError:
             # cancelled by a peer "cancel" frame (abandoned call) or by
@@ -492,7 +502,7 @@ class Connection:
         if handler is None:
             logger.warning("no push handler for %s", method)
             return
-        tensors = deserialize_tensors(header.get("tm", []), blobs)
+        tensors = deserialize_tensors(header.get("tm", []), blobs)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
         try:
             await handler(header.get("meta", {}), tensors)
         except Exception as e:

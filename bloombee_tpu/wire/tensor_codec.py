@@ -31,8 +31,8 @@ except Exception:  # pragma: no cover - zstandard is in the base image
     _zstd = None
 
 from bloombee_tpu.utils import env as _env
+from bloombee_tpu.utils import lockwatch as _lockwatch
 
-import threading
 import time as _time
 
 
@@ -44,7 +44,7 @@ class _TransportStats:
     per call site."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lockwatch.thread_lock("wire.codec_stats")
         self.reset()
 
     def reset(self):

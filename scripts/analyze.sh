@@ -1,10 +1,13 @@
 #!/usr/bin/env bash
-# bbtpu-lint gate: project-specific AST rules (BB001-BB006) plus the
-# README env-switch-table drift check, against the committed baseline.
+# bbtpu-lint gate: project-specific AST rules (BB001-BB010) plus the
+# README env-switch-table and ARCHITECTURE lock-hierarchy-table drift
+# checks, against the committed baseline.
 #
 #   scripts/analyze.sh                     # the CI gate
 #   scripts/analyze.sh --update-baseline   # accept current findings
 #   scripts/analyze.sh --fix-env-docs      # regenerate README table
+#   scripts/analyze.sh --fix-lock-docs     # regenerate ARCHITECTURE table
+#   scripts/analyze.sh --json              # machine-readable findings
 #   scripts/analyze.sh --list-rules
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -14,9 +17,9 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 case "${1:-}" in
-    --update-baseline|--fix-env-docs|--list-rules|--dump-env-table)
+    --update-baseline|--fix-env-docs|--fix-lock-docs|--list-rules|--dump-env-table)
         exec python -m bloombee_tpu.analysis "$@"
         ;;
 esac
 
-exec python -m bloombee_tpu.analysis --check-env-docs "$@"
+exec python -m bloombee_tpu.analysis --check-env-docs --check-lock-docs "$@"

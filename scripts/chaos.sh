@@ -24,6 +24,12 @@
 #                Forces BBTPU_INTEGRITY=1: only the client integrity layer
 #                (out_digest + sanity gate) can see this fault class, and
 #                the suite must stay green + token-identical through it
+#   LOCKWATCH    1 = runtime lock-order witness (BBTPU_LOCKWATCH): every
+#                package lock records acquisition-order edges, checked
+#                against the declared hierarchy. Gated ledger-style: the
+#                entry must observe >=1 cross-lock edge (a witness that
+#                watched nothing proved nothing) with zero violations
+#                and zero cycles
 #   TESTS        comma-separated test-file list for this entry (default:
 #                the whole chaos-marked suite). Feature entries target the
 #                files that actually exercise their flags — the per-entry
@@ -58,7 +64,7 @@ trap 'rm -rf "${compile_cache}"' EXIT
 
 MATRIX=(
     "SEED=23 DELAY_P=0.1"
-    "SEED=43 DELAY_P=0.02 PARTITION_P=0.02 TESTS=tests/test_session_lease.py,tests/test_chaos.py,tests/test_kv_replication.py"
+    "SEED=43 DELAY_P=0.02 PARTITION_P=0.02 LOCKWATCH=1 TESTS=tests/test_session_lease.py,tests/test_chaos.py,tests/test_kv_replication.py"
     "SEED=57 DELAY_P=0.05 MIXED=1 SPEC=1 TESTS=tests/test_mixed_batch.py,tests/test_spec_decode.py,tests/test_batched_decode.py,tests/test_chunked_prefill.py"
     "SEED=83 DELAY_P=0.05 ADMIT=1 REBALANCE=1 TESTS=tests/test_chaos.py,tests/test_promotion.py,tests/test_kv_replication.py,tests/test_prefix_cache.py"
     "SEED=97 DELAY_P=0.02 CORRUPT=0.05 TESTS=tests/test_chaos.py,tests/test_session_lease.py,tests/test_kv_replication.py"
@@ -66,10 +72,10 @@ MATRIX=(
 for entry in "${MATRIX[@]}"; do
     # per-entry defaults; each entry overrides only what it varies
     SEED=0 DELAY_P=0 ADMIT=0 PARTITION_P=0 MIXED=0 SPEC=0 REBALANCE=0
-    CORRUPT=0 TESTS=tests/
+    CORRUPT=0 LOCKWATCH=0 TESTS=tests/
     for tok in ${entry}; do
         case "${tok%%=*}" in
-            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|TESTS)
+            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|LOCKWATCH|TESTS)
                 declare "${tok}" ;;
             *)
                 echo "chaos: unknown matrix token '${tok}'" >&2
@@ -115,17 +121,22 @@ BBTPU_MIXED_BATCH=${MIXED} \
 BBTPU_SPEC_BATCH=${SPEC} \
 BBTPU_MEASURED_REBALANCE=${REBALANCE} \
 BBTPU_PROMOTE_HIGH_MS=${promote_high_ms} \
-BBTPU_PROMOTE_SUSTAIN_S=${promote_sustain_s}"
+BBTPU_PROMOTE_SUSTAIN_S=${promote_sustain_s} \
+BBTPU_LOCKWATCH=${LOCKWATCH}"
     # recovery-coverage ledger: every in-process fault/recovery point
     # appends here at interpreter exit; an entry that tested nothing
     # (zero faults or zero recoveries) fails the gate even if pytest
     # went green — a vacuous pass is a gate bug, not a pass
     ledger_file="$(mktemp "${TMPDIR:-/tmp}/bbtpu-chaos-ledger.XXXXXX")"
+    # lock-witness report, same multi-process append contract as the
+    # ledger; gated below with the same no-vacuous-green rule
+    lockwatch_file="$(mktemp "${TMPDIR:-/tmp}/bbtpu-chaos-lockwatch.XXXXXX")"
     echo "chaos: ${entry}" >&2
     entry_start=${SECONDS}
     rc=0
     test_targets="${TESTS//,/ }"
     env ${env_line} BBTPU_CHAOS_LEDGER="${ledger_file}" \
+        BBTPU_LOCKWATCH_REPORT="${lockwatch_file}" \
         JAX_COMPILATION_CACHE_DIR="${compile_cache}" \
         JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0.5 \
         python -m pytest ${test_targets} -q -m chaos \
@@ -134,15 +145,19 @@ BBTPU_PROMOTE_SUSTAIN_S=${promote_sustain_s}"
         python -m bloombee_tpu.utils.ledger "${ledger_file}" --require \
             >&2 || rc=$?
     fi
+    if [ "${rc}" -eq 0 ] && [ "${LOCKWATCH}" != "0" ]; then
+        python -m bloombee_tpu.utils.lockwatch "${lockwatch_file}" \
+            --require >&2 || rc=$?
+    fi
     elapsed=$(( SECONDS - entry_start ))
     if [ "${rc}" -ne 0 ]; then
         echo "chaos: RED entry '${entry}' after ${elapsed}s" >&2
         echo "chaos: reproduce with:" >&2
         echo "  ${env_line} python -m pytest ${test_targets} -q -m chaos" \
              "-p no:cacheprovider -p no:xdist -p no:randomly" >&2
-        rm -f "${ledger_file}"
+        rm -f "${ledger_file}" "${lockwatch_file}"
         exit "${rc}"
     fi
     echo "chaos: entry '${entry}' green in ${elapsed}s" >&2
-    rm -f "${ledger_file}"
+    rm -f "${ledger_file}" "${lockwatch_file}"
 done

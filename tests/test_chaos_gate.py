@@ -26,7 +26,7 @@ def test_matrix_entries_are_keyval_tokens():
     assert len(entries) >= 5, f"matrix lost entries: {entries}"
     known = {
         "SEED", "DELAY_P", "ADMIT", "PARTITION_P", "MIXED", "SPEC",
-        "REBALANCE", "CORRUPT", "TESTS",
+        "REBALANCE", "CORRUPT", "LOCKWATCH", "TESTS",
     }
     for entry in entries:
         for tok in entry.split():
@@ -71,6 +71,26 @@ def test_gate_requires_nonvacuous_ledger():
     assert re.search(
         r"python -m bloombee_tpu\.utils\.ledger .*--require", src
     ), "gate never checks the ledger with --require"
+
+
+def test_gate_requires_nonvacuous_lockwatch():
+    """The lock-witness entry follows the same no-vacuous-green contract
+    as the ledger: at least one matrix entry runs with BBTPU_LOCKWATCH=1
+    and its report is gated with --require, which fails on zero observed
+    cross-lock edges or any hierarchy violation/cycle."""
+    src = (REPO / "scripts" / "chaos.sh").read_text()
+    entries = re.findall(r'^\s+"([^"]+)"$', src, flags=re.M)
+    assert any("LOCKWATCH=1" in e for e in entries), (
+        "no lock-witness entry in the chaos matrix"
+    )
+    assert "BBTPU_LOCKWATCH_REPORT=" in src, (
+        "witness runs without a report file; nothing to gate on"
+    )
+    assert re.search(
+        r"python -m bloombee_tpu\.utils\.lockwatch .*\\\n\s*--require", src
+    ) or re.search(
+        r"python -m bloombee_tpu\.utils\.lockwatch .*--require", src
+    ), "gate never checks the lock-witness report with --require"
 
 
 def test_red_entry_prints_full_reproduction_line():

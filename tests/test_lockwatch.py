@@ -1,0 +1,316 @@
+"""Runtime lock-order witness (utils/lockwatch.py): edge recording,
+hierarchy-violation + cycle detection, the zero-overhead-when-off
+contract, the multi-process report/--require gate, and one live e2e
+swarm run with the witness on (replication guarantees a cross-lock
+edge: repl_lock is held across peer-pool and send-lock acquisitions).
+"""
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from bloombee_tpu.utils import lockwatch
+
+
+@pytest.fixture(autouse=True)
+def fresh_witness():
+    lockwatch.reset()
+    yield
+    lockwatch.reset()
+
+
+@pytest.fixture
+def watch_on(monkeypatch):
+    monkeypatch.setenv("BBTPU_LOCKWATCH", "1")
+    monkeypatch.delenv("BBTPU_LOCKWATCH_REPORT", raising=False)
+
+
+# ------------------------------------------------------- off = plain locks
+def test_off_returns_plain_stdlib_locks(monkeypatch):
+    """The zero-overhead contract: with the switch off the factories
+    return the stdlib objects themselves — no wrapper in the acquire
+    path, nothing recorded, nothing to misbehave in production."""
+    monkeypatch.delenv("BBTPU_LOCKWATCH", raising=False)
+    assert type(lockwatch.thread_lock("utils.ledger")) is type(
+        threading.Lock()
+    )
+    assert isinstance(
+        lockwatch.thread_lock("kv.cache_manager", reentrant=True),
+        type(threading.RLock()),
+    )
+
+    async def check_async():
+        assert isinstance(lockwatch.async_lock("rpc.send"), asyncio.Lock)
+
+    asyncio.run(check_async())
+    assert lockwatch.counters() == {
+        "lock_order_edges": 0, "lock_violations": 0,
+    }
+
+
+# ----------------------------------------------------------- edge recording
+def test_records_cross_lock_edges_in_order(watch_on):
+    a = lockwatch.thread_lock("kv.cache_manager", reentrant=True)
+    b = lockwatch.thread_lock("utils.ledger")
+    with a:
+        with b:
+            pass
+    snap = lockwatch.snapshot()
+    assert snap["edges"] == [["kv.cache_manager", "utils.ledger", 1]]
+    assert snap["violations"] == []
+    assert lockwatch.counters() == {
+        "lock_order_edges": 1, "lock_violations": 0,
+    }
+
+
+def test_reentrant_self_acquire_is_quiet(watch_on):
+    a = lockwatch.thread_lock("kv.cache_manager", reentrant=True)
+    with a:
+        with a:
+            pass
+    snap = lockwatch.snapshot()
+    assert snap["edges"] == []
+    assert snap["violations"] == []
+
+
+def test_nonreentrant_self_acquire_is_a_violation(watch_on):
+    # a plain Lock would deadlock here; exercise the witness's check
+    # through its recording API (the wrapper records after the inner
+    # acquire, which would never return)
+    lockwatch._witness.acquire("utils.ledger", False, "thread")
+    lockwatch._witness.acquire("utils.ledger", False, "thread")
+    snap = lockwatch.snapshot()
+    assert snap["violations"]
+    assert "re-acquired" in snap["violations"][0]["why"]
+    lockwatch._witness.release("utils.ledger", "thread")
+    lockwatch._witness.release("utils.ledger", "thread")
+
+
+def test_descending_order_is_a_violation(watch_on):
+    lo = lockwatch.thread_lock("kv.cache_manager", reentrant=True)
+    hi = lockwatch.thread_lock("utils.ledger")
+    with hi:
+        with lo:
+            pass
+    snap = lockwatch.snapshot()
+    assert snap["violations"], snap
+    v = snap["violations"][0]
+    assert (v["held"], v["acquired"]) == ("utils.ledger", "kv.cache_manager")
+    assert lockwatch.counters()["lock_violations"] >= 1
+
+
+def test_release_removes_innermost_hold(watch_on):
+    a = lockwatch.thread_lock("server.repl")
+    b = lockwatch.thread_lock("rpc.send")
+    with a:
+        with b:
+            pass
+        # b released: a new acquisition must see only `a` held
+        with b:
+            pass
+    snap = lockwatch.snapshot()
+    assert snap["edges"] == [["server.repl", "rpc.send", 2]]
+    assert snap["violations"] == []
+
+
+# ------------------------------------------------------------ async domain
+def test_async_locks_and_to_thread_propagation(watch_on):
+    """Task-held locks ride a ContextVar: sync code on the loop and
+    asyncio.to_thread workers (which copy the context) both see them,
+    so a thread-lock acquisition inside to_thread records the edge
+    from the task's asyncio hold."""
+
+    async def run():
+        r = lockwatch.async_lock("server.repl")
+        s = lockwatch.async_lock("rpc.send")
+        assert not r.locked()
+        async with r:
+            assert r.locked()  # block_server drain-trigger probe contract
+            async with s:
+                pass
+
+            def work():
+                with lockwatch.thread_lock("utils.ledger"):
+                    pass
+
+            await asyncio.to_thread(work)
+        assert not r.locked()
+
+    asyncio.run(run())
+    snap = lockwatch.snapshot()
+    assert ["server.repl", "rpc.send", 1] in snap["edges"]
+    assert ["server.repl", "utils.ledger", 1] in snap["edges"]
+    assert snap["violations"] == []
+
+
+# --------------------------------------------------------- cycle detection
+def test_find_cycles():
+    assert lockwatch.find_cycles([("a", "b"), ("b", "c")]) == []
+    cycles = lockwatch.find_cycles([("a", "b"), ("b", "c"), ("c", "a")])
+    assert cycles and set(cycles[0]) == {"a", "b", "c"}
+    # a cycle between undeclared keys still counts against counters()
+    lockwatch._witness.edges[("x", "y")] = 1
+    lockwatch._witness.edges[("y", "x")] = 1
+    assert lockwatch.counters()["lock_violations"] >= 1
+
+
+# ------------------------------------------------------- report + gate CLI
+def test_flush_merge_and_require_gate(tmp_path, watch_on, capsys):
+    report = tmp_path / "lockwatch.jsonl"
+
+    a = lockwatch.thread_lock("kv.cache_manager", reentrant=True)
+    b = lockwatch.thread_lock("utils.ledger")
+    with a:
+        with b:
+            pass
+    lockwatch.flush(str(report))
+    # second "process": same edge again, appended as its own line
+    lockwatch.flush(str(report))
+    assert len(report.read_text().splitlines()) == 2
+
+    merged = lockwatch.merge_lines(report.read_text())
+    assert merged["edges"] == [["kv.cache_manager", "utils.ledger", 2]]
+
+    assert lockwatch._main([str(report), "--require"]) == 0
+    out = capsys.readouterr().out
+    assert "1 edge(s)" in out and "0 violation(s)" in out
+
+
+def test_require_gate_fails_on_empty_report(tmp_path, capsys):
+    report = tmp_path / "empty.jsonl"
+    report.write_text("")
+    assert lockwatch._main([str(report), "--require"]) == 1
+    assert "EMPTY" in capsys.readouterr().err
+    # without --require an empty report only informs
+    assert lockwatch._main([str(report)]) == 0
+
+
+def test_require_gate_fails_on_violation_and_cycle(tmp_path, capsys):
+    report = tmp_path / "bad.jsonl"
+    report.write_text(json.dumps({
+        "edges": [["kv.cache_manager", "utils.ledger", 1],
+                  ["utils.ledger", "kv.cache_manager", 1]],
+        "violations": [{"held": "utils.ledger",
+                        "acquired": "kv.cache_manager",
+                        "why": "descending"}],
+    }) + "\n")
+    assert lockwatch._main([str(report), "--require"]) == 1
+    out = capsys.readouterr()
+    assert "VIOLATION" in out.out and "CYCLE" in out.out
+
+
+def test_flush_skips_empty_witness(tmp_path, watch_on):
+    report = tmp_path / "noop.jsonl"
+    lockwatch.flush(str(report))
+    assert not report.exists() or report.read_text() == ""
+
+
+# ------------------------------------------------------------- live e2e run
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=3,
+        vocab_size=128,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(config).eval().to(torch.float32)
+    d = tmp_path_factory.mktemp("tiny_llama_lockwatch")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), config
+
+
+def test_e2e_swarm_witness_observes_edges(tiny_model_dir, monkeypatch):
+    """The acceptance run: a live two-server swarm with KV replication
+    under BBTPU_LOCKWATCH=1 must observe at least one cross-lock
+    acquisition edge (replication holds repl_lock across the peer-pool
+    and send-lock acquisitions) with ZERO hierarchy violations and ZERO
+    cycles — the runtime cross-validation of the static lock model."""
+    import jax.numpy as jnp
+
+    from bloombee_tpu.client.config import ClientConfig
+    from bloombee_tpu.client.model import DistributedModelForCausalLM
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+
+    monkeypatch.setenv("BBTPU_LOCKWATCH", "1")
+    monkeypatch.delenv("BBTPU_LOCKWATCH_REPORT", raising=False)
+    model_dir, config = tiny_model_dir
+
+    async def run():
+        reg = RegistryServer(host="127.0.0.1")
+        await reg.start()
+
+        def rc():
+            return RegistryClient("127.0.0.1", reg.port)
+
+        def server(throughput):
+            return BlockServer(
+                model_uid="tiny", start=0, end=3, model_dir=model_dir,
+                registry=rc(), compute_dtype=jnp.float32, num_pages=64,
+                page_size=4, prefix_cache=True, throughput=throughput,
+            )
+
+        s_a, s_b = server(10.0), server(1.0)
+        await s_a.start()
+        await s_b.start()
+
+        cfg = ClientConfig(use_push=False, prefix_cache=True,
+                           kv_repl_every=1)
+        model = DistributedModelForCausalLM.from_pretrained(
+            model_dir, rc(), model_uid="tiny", config=cfg
+        )
+        input_ids = (np.arange(12)[None, :] * 5 + 3) % config.vocab_size
+        async with model.inference_session(28, 1) as sess:
+            assert sess._standby_peers()
+            out = await sess.step(model.embed(input_ids), ids=input_ids)
+            for _ in range(4):
+                logits = model.logits(out[:, -1:])[:, 0]
+                nxt = np.argmax(logits, axis=-1).astype(
+                    input_ids.dtype
+                )[:, None]
+                out = await sess.step(model.embed(nxt), ids=nxt)
+            # wait until a replication pass actually shipped pages —
+            # that pass is the guaranteed cross-lock nesting
+            primary_port = sess._spans[0].span.server_info.port
+            primary = s_a if s_a.port == primary_port else s_b
+            for _ in range(100):
+                if primary.repl_pages_sent >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert primary.repl_pages_sent >= 1
+
+            # the counters also ride rpc_info (BB006 surfacing)
+            from bloombee_tpu.wire.rpc import connect
+
+            conn = await connect("127.0.0.1", primary.port)
+            info, _ = await conn.call("rpc_info", {})
+            assert info["lock_order_edges"] >= 1
+            assert info["lock_violations"] == 0
+            await conn.close()
+
+        await s_a.stop()
+        await s_b.stop()
+        await reg.stop()
+
+    asyncio.run(run())
+
+    snap = lockwatch.snapshot()
+    edges = [(a, b) for a, b, _ in snap["edges"]]
+    assert ("server.repl", "rpc.send") in edges or (
+        "server.repl", "server.peer_pool"
+    ) in edges, snap["edges"]
+    assert snap["violations"] == [], snap["violations"]
+    assert lockwatch.find_cycles(edges) == []
