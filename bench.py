@@ -46,11 +46,40 @@ _EMITTED = threading.Lock()
 _emitted = False
 
 
+_compile_attr = {"phase": None, "compiles": 0, "ms": 0.0}
+
+
+def _flush_compile_stats() -> None:
+    """Attribute the XLA compiles observed since the last phase() call to
+    the most recently named phase (phases run sequentially, so the window
+    between two phase() calls belongs to the earlier one). Zeros when the
+    jitwatch witness is off; a recompile storm shows up as a phase whose
+    xla_compiles keeps growing across rounds."""
+    from bloombee_tpu.utils import jitwatch
+
+    c = jitwatch.counters()
+    prev, now_n, now_ms = (
+        _compile_attr["phase"], c["xla_compiles"], c["compile_ms_total"]
+    )
+    if prev is not None:
+        stats = RESULTS.setdefault("compile_stats", {}).setdefault(
+            prev, {"xla_compiles": 0, "compile_ms_total": 0.0}
+        )
+        stats["xla_compiles"] += now_n - _compile_attr["compiles"]
+        stats["compile_ms_total"] = round(
+            stats["compile_ms_total"] + now_ms - _compile_attr["ms"], 3
+        )
+    _compile_attr["compiles"] = now_n
+    _compile_attr["ms"] = now_ms
+
+
 def phase(name: str, status: str) -> None:
     """Phase ledger: every phase records started/ok/failed/skipped so a
     degraded run still shows WHICH phases are code-ready vs blocked (a
     bare rc=3 JSON is indistinguishable from missing phases — round-4
     verdict)."""
+    _flush_compile_stats()
+    _compile_attr["phase"] = name
     RESULTS.setdefault("phases", {})[name] = status
     log(f"[phase] {name}: {status}")
 
@@ -282,6 +311,11 @@ def _emit_json_locked():
         )
     if RESULTS.get("phases"):
         out["phases"] = RESULTS["phases"]
+    if RESULTS.get("compile_stats"):
+        # per-phase XLA compile counts/ms (jitwatch): a phase whose count
+        # grows run over run is a recompile storm, attributable here
+        # instead of showing up only as degraded rates
+        out["compile_stats"] = RESULTS["compile_stats"]
     if RESULTS.get("cpu_fallback"):
         # scrub EVERY rate/latency key, not just the headline: a consumer
         # plotting any per-second number must not ingest CPU-smoke rates
@@ -477,6 +511,12 @@ def _require_backend():
 
 def main():
     start_watchdog()
+    # the bench always runs under the compile witness: per-phase compile
+    # deltas ride the BENCH JSON (opt-out by exporting BBTPU_JITWATCH=0)
+    os.environ.setdefault("BBTPU_JITWATCH", "1")
+    from bloombee_tpu.utils import jitwatch
+
+    jitwatch.install()
     # the image's sitecustomize force-registers the TPU platform; honor an
     # explicit JAX_PLATFORMS=cpu (smoke/CI runs) the same way dryrun does
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":

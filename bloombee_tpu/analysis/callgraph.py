@@ -45,6 +45,7 @@ RECEIVER_CLASSES: dict[str, str] = {
     "manager": "CacheManager",
     "cache_manager": "CacheManager",
     "compute": "ComputeQueue",
+    "executor": "SpanExecutor",
     "conn": "Connection",
     "peers": "_PeerPool",
     "registry": "RegistryClient",

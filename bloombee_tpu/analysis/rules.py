@@ -1,4 +1,4 @@
-"""bbtpu-lint rules BB001–BB010.
+"""bbtpu-lint rules BB001–BB013.
 
 Each rule encodes one invariant this codebase has already been burned by
 (see ARCHITECTURE.md "Invariants"). Rules are plugin classes over the
@@ -20,6 +20,7 @@ on a healthy tree.
 from __future__ import annotations
 
 import ast
+import dataclasses
 import re
 
 from bloombee_tpu.analysis import lock_hierarchy
@@ -1042,6 +1043,622 @@ class FireAndForgetTaskRule(Rule):
         return out
 
 
+# --------------------------------------------------------------------------
+# JIT-boundary rules (BB011–BB013). Shared scanner: every jax.jit entry
+# point in the tree, with its static (shape-bearing) and donated argument
+# names. Two defining idioms are recognized:
+#
+#   span_step = functools.partial(jax.jit, static_argnames=(...),
+#                                 donate_argnames=(...))(span_step_impl)
+#   @functools.partial(jax.jit, donate_argnames=(...))
+#   def _arena_write_all(arena_k, arena_v, ...): ...
+#
+# plus plain @jax.jit / name = jax.jit(impl). argnums variants map to
+# names through the impl's positional parameter order.
+
+
+@dataclasses.dataclass
+class _JitEntry:
+    name: str
+    path: str
+    params: list[str]  # positional parameter order of the impl
+    statics: set[str]
+    donated: set[str]
+
+
+def _str_tuple(node: ast.AST) -> list[str]:
+    vals = []
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            vals.append(e.value)
+    return vals
+
+
+def _int_tuple(node: ast.AST) -> list[int]:
+    vals = []
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+            vals.append(e.value)
+    return vals
+
+
+def _jit_keywords(call: ast.Call) -> dict[str, ast.AST] | None:
+    """If `call` is a jax.jit(...) / functools.partial(jax.jit, ...)
+    configuration call, its keyword nodes; else None."""
+    text = _expr_text(call.func)
+    if text.endswith("jit"):
+        return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    if _call_name(call) == "partial" and call.args:
+        if _expr_text(call.args[0]).endswith("jit"):
+            return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    return None
+
+
+def _param_names(fn: ast.AST) -> list[str]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _outermost_functions(tree: ast.AST):
+    """Function defs not nested inside another function def: closures
+    are analyzed via their enclosing function's walk (they share its
+    frame), and walking them twice would duplicate findings."""
+    nested: set[int] = set()
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(fn):
+                if sub is not fn and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    nested.add(id(sub))
+    for fn in ast.walk(tree):
+        if (
+            isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and id(fn) not in nested
+        ):
+            yield fn
+
+
+def scan_jit_entries(files: list[SourceFile]) -> dict[str, _JitEntry]:
+    """Name -> entry for every recognized jit entry point. First
+    definition wins on a (pathological) name collision."""
+    out: dict[str, _JitEntry] = {}
+
+    def add(name, path, params, kws):
+        statics = set(_str_tuple(kws.get("static_argnames", ast.Tuple([], None))))
+        donated = set(_str_tuple(kws.get("donate_argnames", ast.Tuple([], None))))
+        for i in _int_tuple(kws.get("static_argnums", ast.Tuple([], None))):
+            if 0 <= i < len(params):
+                statics.add(params[i])
+        for i in _int_tuple(kws.get("donate_argnums", ast.Tuple([], None))):
+            if 0 <= i < len(params):
+                donated.add(params[i])
+        out.setdefault(
+            name, _JitEntry(name, path, params, statics, donated)
+        )
+
+    for sf in files:
+        defs = {
+            n.name: n
+            for n in ast.walk(sf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        kws = _jit_keywords(dec)
+                        if kws is not None:
+                            add(node.name, sf.path, _param_names(node), kws)
+                    elif _expr_text(dec).endswith("jit"):
+                        add(node.name, sf.path, _param_names(node), {})
+            elif (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                # name = functools.partial(jax.jit, ...)(impl)  or
+                # name = jax.jit(impl, static_argnames=...)
+                inner = node.value
+                kws = None
+                impl = None
+                if isinstance(inner.func, ast.Call):
+                    kws = _jit_keywords(inner.func)
+                    impl = inner.args[0] if inner.args else None
+                else:
+                    text = _expr_text(inner.func)
+                    if text.endswith("jit"):
+                        kws = {
+                            kw.arg: kw.value
+                            for kw in inner.keywords
+                            if kw.arg
+                        }
+                        impl = inner.args[0] if inner.args else None
+                if kws is None:
+                    continue
+                params: list[str] = []
+                if isinstance(impl, ast.Name) and impl.id in defs:
+                    params = _param_names(defs[impl.id])
+                add(node.targets[0].id, sf.path, params, kws)
+    return out
+
+
+class HotPathHostSyncRule(Rule):
+    """BB011: no implicit device→host sync reachable from a decode hot
+    path.
+
+    The compute queue serializes every session's device work; one
+    `.item()` / `float(out)` / `np.asarray(out)` / `block_until_ready`
+    inside the dispatch subtree stalls the whole pipeline for a device
+    round trip per step — the convoy PR 5/8 removed by making fetch an
+    off-queue operation. Hot roots are the group dispatchers and the
+    step driver; reachability rides the PR-14 call graph, and each
+    finding prints the chain from its root. `float()`/`int()`/`bool()`/
+    `np.asarray` only fire on device-ish value names (out/logits/
+    dev/...) — host-side numpy bookkeeping (`int(lens.max())`) is not a
+    sync. The one deliberate sync (executor.fetch, wire-bound by
+    contract) carries an owner noqa.
+    """
+
+    code = "BB011"
+    name = "hot-path-host-sync"
+    summary = "implicit device->host sync reachable from a decode hot path"
+
+    HOT_ROOTS = {
+        "decode_group", "mixed_group", "tree_group", "prefill_chunk",
+        "_run_step",
+    }
+    ALWAYS_SYNC_ATTRS = {"item", "block_until_ready", "device_get"}
+    CAST_NAMES = {"float", "int", "bool"}
+    NP_ALIASES = {"np", "numpy", "onp"}
+    DEVICEISH = {"out", "dev", "device", "logits", "toks"}
+    # code shipped to another thread is off the compute queue / event
+    # loop by construction — the entire point of these wrappers
+    OFFLOAD_CALLS = {"to_thread", "run_in_executor"}
+    # a name bound from one of these is a HOST value: the d2h round
+    # trip already happened, deliberately, at the one chokepoint
+    HOST_PRODUCERS = {"to_thread", "run_in_executor", "fetch"}
+
+    def __init__(self):
+        self._graph = None
+        self._hot: dict[str, tuple[str, ...]] = {}  # qname -> chain
+
+    def prepare(self, files: list[SourceFile], graph) -> None:
+        self._graph = graph
+        roots = [
+            q for q, fi in graph.functions.items()
+            if fi.name in self.HOT_ROOTS
+        ]
+        parent: dict[str, str] = {}
+        seen = set(roots)
+        queue = list(roots)
+        while queue:
+            q = queue.pop(0)
+            for callee, _ in graph.edges.get(q, ()):
+                if callee not in seen:
+                    seen.add(callee)
+                    parent[callee] = q
+                    queue.append(callee)
+        for q in seen:
+            chain = [q]
+            while chain[-1] in parent:
+                chain.append(parent[chain[-1]])
+            self._hot[q] = tuple(reversed(chain))
+
+    def _deviceish(self, node: ast.AST, host_names: set[str]) -> bool:
+        for n in ast.walk(node):
+            name = None
+            if isinstance(n, ast.Name):
+                name = n.id
+            elif isinstance(n, ast.Attribute):
+                name = n.attr
+            if name is None or name in host_names:
+                continue
+            if any(p in self.DEVICEISH for p in name.lower().split("_")):
+                return True
+        return False
+
+    @classmethod
+    def _host_names(cls, fn: ast.AST) -> set[str]:
+        """Names this function declares host-side: parameters annotated
+        np.ndarray, and names bound from an offload wrapper or a
+        fetch() — the sync already happened where it belongs."""
+        out: set[str] = set()
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for p in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+                ann = _expr_text(p.annotation) if p.annotation else ""
+                if "ndarray" in ann:
+                    out.add(p.arg)
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Assign):
+                continue
+            v = n.value
+            if isinstance(v, ast.Await):
+                v = v.value
+            if (
+                isinstance(v, ast.Call)
+                and _call_name(v) in cls.HOST_PRODUCERS
+            ):
+                for t in n.targets:
+                    elts = (
+                        t.elts if isinstance(t, (ast.Tuple, ast.List))
+                        else [t]
+                    )
+                    out.update(
+                        e.id for e in elts if isinstance(e, ast.Name)
+                    )
+        return out
+
+    @classmethod
+    def _offloaded_ids(cls, fn: ast.AST) -> set[int]:
+        """Ids of nodes inside the argument subtrees of
+        asyncio.to_thread / loop.run_in_executor calls: that code runs
+        on another thread, off the compute queue."""
+        out: set[int] = set()
+        for n in ast.walk(fn):
+            if (
+                isinstance(n, ast.Call)
+                and _call_name(n) in cls.OFFLOAD_CALLS
+            ):
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    out.update(id(x) for x in ast.walk(a))
+        return out
+
+    def _sync_site(
+        self, node: ast.Call, host_names: set[str]
+    ) -> str | None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in self.ALWAYS_SYNC_ATTRS:
+                return f.attr
+            if (
+                f.attr in ("asarray", "array")
+                and isinstance(f.value, ast.Name)
+                and f.value.id in self.NP_ALIASES
+                and node.args
+                and self._deviceish(node.args[0], host_names)
+            ):
+                return f"np.{f.attr}"
+        elif isinstance(f, ast.Name) and f.id in self.CAST_NAMES:
+            if len(node.args) == 1 and self._deviceish(
+                node.args[0], host_names
+            ):
+                return f.id
+        return None
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        graph = self._graph
+        if graph is None:
+            return out
+        seen_sites: set[int] = set()  # closures appear under their
+        # enclosing function's qname too; flag each site once
+        for q, chain in self._hot.items():
+            fi = graph.functions[q]
+            if fi.sf is not sf:
+                continue
+            names = " -> ".join(graph.display(x) for x in chain)
+            host_names = self._host_names(fi.node)
+            offloaded = self._offloaded_ids(fi.node)
+            # full walk, nested closures included: the dispatchers run
+            # their `_run` closures inline on the compute thread
+            for n in ast.walk(fi.node):
+                if not isinstance(n, ast.Call) or id(n) in seen_sites:
+                    continue
+                if id(n) in offloaded:
+                    continue  # runs on another thread, off-queue
+                site = self._sync_site(n, host_names)
+                if site is None:
+                    continue
+                seen_sites.add(id(n))
+                f = sf.finding(
+                    self.code,
+                    n,
+                    f"implicit device->host sync `{site}` on the decode "
+                    f"hot path (reachable via {names}): it blocks the "
+                    "serialized compute queue for a device round trip — "
+                    "return the lazy array and fetch off-queue "
+                    "(executor.fetch), or mark the deliberate sync with "
+                    "`# bbtpu: noqa[BB011]` naming the owner",
+                    chain=tuple(graph.display(x) for x in chain),
+                )
+                if f:
+                    out.append(f)
+        return out
+
+
+class UnbucketedJitShapeRule(Rule):
+    """BB012: a static (shape-bearing) argument of a jit entry call must
+    not derive from a data-dependent Python value without a bucketer on
+    the path.
+
+    Every distinct static-arg tuple is a full XLA retrace+recompile;
+    feeding a request-dependent raw size (`t = hidden.shape[1]`,
+    `r = sum(counts)`) straight into `t=`/`r=`/`max_pages=` compiles
+    once PER REQUEST SHAPE — the recompile storm the pow2 bucketing
+    discipline (next_pow2 / plan_prefill_chunks) exists to cap at
+    O(log T). The rule follows simple local assignments (closures read
+    their enclosing frame): a bucketer call anywhere on the derivation
+    path clears the value; a derivation showing data sources (.shape,
+    len()/int()/sum()/max()/min()) with no bucketer is flagged; anything
+    else (attributes, constants, config) stays quiet. Scope: entries
+    defined in runtime/ and ops/.
+    """
+
+    code = "BB012"
+    name = "unbucketed-jit-shape-arg"
+    summary = "data-dependent static jit arg with no pow2 bucketing"
+
+    BUCKETERS = ("next_pow2", "plan_prefill_chunks")
+    _DATA_RE = re.compile(
+        r"\bint\(|\blen\(|\bsum\(|\bmax\(|\bmin\(|\.shape\b"
+    )
+    _BUCKET_RE = re.compile(r"\bnext_pow2\(|\bplan_prefill_chunks\(")
+
+    def __init__(self):
+        self._entries: dict[str, _JitEntry] = {}
+
+    def prepare(self, files: list[SourceFile], graph) -> None:
+        self._entries = {
+            name: e
+            for name, e in scan_jit_entries(files).items()
+            if "runtime/" in e.path or "ops/" in e.path
+        }
+
+    @staticmethod
+    def _assign_map(fn: ast.AST) -> dict[str, list[str]]:
+        """name -> [assigned expr text, ...] over the whole function,
+        nested closures included (they read the enclosing frame)."""
+        out: dict[str, list[str]] = {}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign):
+                targets = []
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        targets.append(t.id)
+                    elif isinstance(t, ast.Tuple):
+                        targets.extend(
+                            e.id for e in t.elts if isinstance(e, ast.Name)
+                        )
+                text = _expr_text(n.value)
+                for t in targets:
+                    out.setdefault(t, []).append(text)
+            elif isinstance(n, ast.AugAssign) and isinstance(
+                n.target, ast.Name
+            ):
+                out.setdefault(n.target.id, []).append(_expr_text(n.value))
+        return out
+
+    def _classify(
+        self, expr: ast.AST, assigns: dict[str, list[str]]
+    ) -> str | None:
+        """'bucketed' | 'raw' | None (unknown/benign). Bucketer wins."""
+        texts = [_expr_text(expr)]
+        names = [
+            n.id for n in ast.walk(expr) if isinstance(n, ast.Name)
+        ]
+        seen = set()
+        for _ in range(5):  # bounded transitive expansion
+            nxt: list[str] = []
+            for name in names:
+                if name in seen:
+                    continue
+                seen.add(name)
+                for text in assigns.get(name, ()):
+                    texts.append(text)
+                    try:
+                        nxt.extend(
+                            n.id
+                            for n in ast.walk(ast.parse(text, mode="eval"))
+                            if isinstance(n, ast.Name)
+                        )
+                    except SyntaxError:
+                        pass
+            if not nxt:
+                break
+            names = nxt
+        blob = " ".join(texts)
+        if self._BUCKET_RE.search(blob):
+            return "bucketed"
+        if self._DATA_RE.search(blob):
+            return "raw"
+        return None
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        if not self._entries:
+            return out
+        for fn in _outermost_functions(sf.tree):
+            assigns = self._assign_map(fn)
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                entry = self._entries.get(_call_name(n))
+                if entry is None:
+                    continue
+                checks: list[tuple[str, ast.AST]] = []
+                for kw in n.keywords:
+                    if kw.arg and kw.arg in entry.statics:
+                        checks.append((kw.arg, kw.value))
+                for i, a in enumerate(n.args):
+                    if i < len(entry.params) and (
+                        entry.params[i] in entry.statics
+                    ):
+                        checks.append((entry.params[i], a))
+                for arg_name, val in checks:
+                    if self._classify(val, assigns) != "raw":
+                        continue
+                    f = sf.finding(
+                        self.code,
+                        n,
+                        f"jit entry `{entry.name}(...)`: static shape "
+                        f"arg `{arg_name}={_expr_text(val)}` derives "
+                        "from a data-dependent value with no bucketer "
+                        "(next_pow2/plan_prefill_chunks) on the path — "
+                        "every distinct value is a full XLA recompile; "
+                        "bucket it like executor._step's bb/tb/pb",
+                    )
+                    if f:
+                        out.append(f)
+        return out
+
+
+class UseAfterDonationRule(Rule):
+    """BB013: no read of a donated argument after the jitted call
+    returns.
+
+    `donate_argnames` hands the argument's buffer to XLA — after the
+    call it is DELETED; any later read raises (or worse, on some
+    backends, reads garbage). The `arena_k`/`arena_v` slabs are exactly
+    this class: every step donates the KV arena and must thread the
+    RETURNED arena forward. The rule tracks the donated argument
+    expressions (and the manager-attribute they alias) per function,
+    lineno-ordered; a Load of the same expression after the donating
+    call is flagged. Reads inside except handlers stay quiet — the
+    `_arena_consumed` self-heal contract probes donated buffers
+    deliberately — and a reassignment of the root name kills tracking
+    (rebinding to the returned buffers is the correct pattern).
+    """
+
+    code = "BB013"
+    name = "use-after-donation"
+    summary = "donated jit argument read after the call"
+
+    def __init__(self):
+        self._donating: dict[str, _JitEntry] = {}
+
+    def prepare(self, files: list[SourceFile], graph) -> None:
+        self._donating = {
+            name: e
+            for name, e in scan_jit_entries(files).items()
+            if e.donated
+        }
+
+    @staticmethod
+    def _in_handler(node: ast.AST, handlers: list[set[int]]) -> bool:
+        return any(id(node) in h for h in handlers)
+
+    def visit_file(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        if not self._donating:
+            return out
+        handler_sets = [
+            {id(x) for stmt in h.body for x in ast.walk(stmt)}
+            for h in ast.walk(sf.tree)
+            if isinstance(h, ast.ExceptHandler)
+        ]
+        for fn in _outermost_functions(sf.tree):
+            # donating calls in source order, with their donated exprs
+            donations: list[tuple[int, ast.Call, list[str]]] = []
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                entry = self._donating.get(_call_name(n))
+                if entry is None:
+                    continue
+                exprs: list[str] = []
+                for kw in n.keywords:
+                    if kw.arg and kw.arg in entry.donated:
+                        exprs.append(_expr_text(kw.value))
+                for i, a in enumerate(n.args):
+                    if i < len(entry.params) and (
+                        entry.params[i] in entry.donated
+                    ):
+                        exprs.append(_expr_text(a))
+                if exprs:
+                    donations.append((n.lineno, n, exprs))
+            if not donations:
+                continue
+            # a Store of the donated expression (or its root name) after
+            # the call rebinds it to the RETURNED buffers — the correct
+            # pattern (`ak, av = span_step(ak, av, ...)`) — and kills
+            # tracking from that line on. Same-line counts: the rebind
+            # statement IS the donating call.
+            kills: dict[str, list[int]] = {}
+            for n in ast.walk(fn):
+                targets: list[ast.AST] = []
+                if isinstance(n, ast.Assign):
+                    targets = list(n.targets)
+                elif isinstance(n, (ast.AugAssign, ast.For)):
+                    targets = [n.target]
+                for t in targets:
+                    elts = (
+                        t.elts if isinstance(t, (ast.Tuple, ast.List))
+                        else [t]
+                    )
+                    for e in elts:
+                        text = _expr_text(e)
+                        if text:
+                            kills.setdefault(text, []).append(n.lineno)
+            # mutually exclusive if/else arms: a read in the sibling arm
+            # of the donating call never executes after it
+            branch_pairs: list[tuple[set[int], set[int]]] = []
+            for n in ast.walk(fn):
+                if isinstance(n, ast.If) and n.orelse:
+                    body_ids = {
+                        id(x) for s in n.body for x in ast.walk(s)
+                    }
+                    else_ids = {
+                        id(x) for s in n.orelse for x in ast.walk(s)
+                    }
+                    branch_pairs.append((body_ids, else_ids))
+            for call_line, call, exprs in donations:
+                call_ids = {id(x) for x in ast.walk(call)}
+                flagged: set[str] = set()
+                for n in ast.walk(fn):
+                    if id(n) in call_ids:
+                        continue  # the donating call's own arguments
+                    if not isinstance(
+                        n, (ast.Subscript, ast.Attribute, ast.Name)
+                    ):
+                        continue
+                    if not isinstance(
+                        getattr(n, "ctx", None), ast.Load
+                    ):
+                        continue
+                    line = getattr(n, "lineno", 0)
+                    if line <= call_line:
+                        continue
+                    text = _expr_text(n)
+                    if text not in exprs or text in flagged:
+                        continue
+                    root = text.split("[")[0].split(".")[0]
+                    if any(
+                        call_line <= k <= line
+                        for k in kills.get(text, [])
+                        + kills.get(root, [])
+                    ):
+                        continue  # rebound to the returned buffers
+                    if self._in_handler(n, handler_sets):
+                        continue  # _arena_consumed recovery contract
+                    if any(
+                        (id(call) in b and id(n) in e)
+                        or (id(call) in e and id(n) in b)
+                        for b, e in branch_pairs
+                    ):
+                        continue  # mutually exclusive branches
+                    f = sf.finding(
+                        self.code,
+                        n,
+                        f"`{text}` was DONATED to "
+                        f"`{_call_name(call)}(...)` on line {call_line} "
+                        "(donate_argnames) — its buffer is deleted when "
+                        "the call returns; thread the returned arrays "
+                        "forward instead of re-reading the donated ones",
+                    )
+                    if f:
+                        out.append(f)
+                    # at most one finding per donated expr per call:
+                    # every later read is the same defect
+                    flagged.add(text)
+        return out
+
+
 def make_rules() -> list[Rule]:
     """Fresh rule instances (BB006 keeps cross-file state)."""
     return [
@@ -1055,6 +1672,9 @@ def make_rules() -> list[Rule]:
         RawClockRule(),
         AsyncBlockingRule(),
         FireAndForgetTaskRule(),
+        HotPathHostSyncRule(),
+        UnbucketedJitShapeRule(),
+        UseAfterDonationRule(),
     ]
 
 

@@ -240,6 +240,25 @@ def main(argv=None):
                         line += "  " + " ".join(
                             f"{k}={v}" for k, v in sorted(watch.items())
                         )
+                    # compile-witness counters (BBTPU_JITWATCH=1 runs):
+                    # ANY nonzero steady_state_recompiles means a decode
+                    # bucket escaped warmup — a first-token compile stall
+                    # some session actually paid
+                    jit = {
+                        k: probe[k]
+                        for k in (
+                            "xla_compiles",
+                            "compile_ms_total",
+                            "warmup_compiles",
+                            "steady_state_recompiles",
+                            "host_syncs_hot_path",
+                        )
+                        if probe.get(k)
+                    }
+                    if jit:
+                        line += "  " + " ".join(
+                            f"{k}={v}" for k, v in sorted(jit.items())
+                        )
                     # session lease counters: are leases reaping abandoned
                     # sessions, are clients resuming instead of replaying,
                     # and is keepalive traffic flowing on idle conns

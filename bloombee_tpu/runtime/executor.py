@@ -28,7 +28,7 @@ from bloombee_tpu.runtime.step import (
     span_step_packed,
     span_step_ragged,
 )
-from bloombee_tpu.utils import env
+from bloombee_tpu.utils import env, jitwatch
 
 env.declare(
     "BBTPU_FLASH_ATTENTION", bool, True,
@@ -403,9 +403,10 @@ class SpanExecutor:
         h_pad = np.zeros((b, t_pad, d), dtype=self.transfer_dtype)
         h_pad[:, :t] = hidden.astype(self.transfer_dtype)
         slots = self.manager.write_slots(handle, t, commit=True)  # [b*t]
-        out, ks, vs = sp_prefill(
-            self._sp_params, h_pad, self.sp_mesh, spec=self.spec
-        )
+        with jitwatch.region("sp_prefill", f"b{b},t{t_pad}"):
+            out, ks, vs = sp_prefill(
+                self._sp_params, h_pad, self.sp_mesh, spec=self.spec
+            )
         # pad tokens write to the drop slot; real tokens land in their
         # assigned pages
         oob = self.manager.capacity_tokens
@@ -423,10 +424,11 @@ class SpanExecutor:
         )
         arena = self.manager.arena
         try:
-            new_k, new_v = _arena_write_all(
-                arena["k"], arena["v"],
-                jnp.asarray(slots_pad.reshape(-1)), k_new, v_new,
-            )
+            with jitwatch.region("arena_write_all", f"b{b},t{t_pad}"):
+                new_k, new_v = _arena_write_all(
+                    arena["k"], arena["v"],
+                    jnp.asarray(slots_pad.reshape(-1)), k_new, v_new,
+                )
         except Exception:
             # same contract as every other donated-arena step: a runtime
             # failure after donation leaves deleted buffers — rebuild so
@@ -438,7 +440,7 @@ class SpanExecutor:
         out = out[:, :t]
         if not fetch:
             return out
-        return np.asarray(out).astype(self.transfer_dtype)
+        return self.fetch(out)
 
     def decode(
         self,
@@ -621,20 +623,21 @@ class SpanExecutor:
         arena = self.manager.arena
 
         def _run(use_kernel_now: bool):
-            return span_step_ragged(
-                self.params,
-                arena["k"],
-                arena["v"],
-                payload_dev,
-                lora,
-                spec=spec,
-                r=rb,
-                n_seqs=sb,
-                page_size=self.page_size,
-                max_pages=pb,
-                windows=self.windows,
-                use_kernel=use_kernel_now,
-            )
+            with jitwatch.region("span_step_ragged", f"r{rb},s{sb},p{pb}"):
+                return span_step_ragged(
+                    self.params,
+                    arena["k"],
+                    arena["v"],
+                    payload_dev,
+                    lora,
+                    spec=spec,
+                    r=rb,
+                    n_seqs=sb,
+                    page_size=self.page_size,
+                    max_pages=pb,
+                    windows=self.windows,
+                    use_kernel=use_kernel_now,
+                )
 
         try:
             out, new_k, new_v = _run(use_kernel)
@@ -798,21 +801,24 @@ class SpanExecutor:
         arena = self.manager.arena
 
         def _run(use_kernel_now: bool):
-            return span_step_ragged(
-                self.params,
-                arena["k"],
-                arena["v"],
-                payload_dev,
-                lora,
-                spec=spec,
-                r=rb,
-                n_seqs=sb,
-                page_size=self.page_size,
-                max_pages=pb,
-                windows=self.windows,
-                use_kernel=use_kernel_now,
-                t_max=t_max,
-            )
+            with jitwatch.region(
+                "span_step_ragged", f"r{rb},s{sb},p{pb},t{t_max}"
+            ):
+                return span_step_ragged(
+                    self.params,
+                    arena["k"],
+                    arena["v"],
+                    payload_dev,
+                    lora,
+                    spec=spec,
+                    r=rb,
+                    n_seqs=sb,
+                    page_size=self.page_size,
+                    max_pages=pb,
+                    windows=self.windows,
+                    use_kernel=use_kernel_now,
+                    t_max=t_max,
+                )
 
         try:
             out, new_k, new_v = _run(use_kernel)
@@ -838,12 +844,19 @@ class SpanExecutor:
     def fetch(self, out) -> np.ndarray:
         """Materialize a fetch=False result on host in the wire dtype
         (blocks on the device round trip — call off the compute queue).
-        A list of per-chunk results concatenates along the token axis."""
+        A list of per-chunk results concatenates along the token axis.
+
+        This is the package's ONE deliberate d2h chokepoint: results go
+        straight onto the wire, so the sync is the contract, not a leak.
+        Dispatchers pass fetch=False and call this off-queue (jitwatch
+        counts any call that lands on the compute thread as a hot-path
+        sync — the convoy BB011 flags statically)."""
+        jitwatch.host_sync("executor.fetch")
         if isinstance(out, (list, tuple)):
-            return np.concatenate(
+            return np.concatenate(  # bbtpu: noqa[BB011] wire-bound d2h by contract; hot dispatchers use fetch=False and fetch off-queue
                 [np.asarray(o) for o in out], axis=1
             ).astype(self.transfer_dtype)
-        return np.asarray(out).astype(self.transfer_dtype)
+        return np.asarray(out).astype(self.transfer_dtype)  # bbtpu: noqa[BB011] wire-bound d2h by contract; hot dispatchers use fetch=False and fetch off-queue
 
     def decode_n(
         self,
@@ -951,15 +964,19 @@ class SpanExecutor:
         from bloombee_tpu.runtime.decode_loop import decode_loop
 
         def _run(use_paged_now: bool):
-            return decode_loop(
-                client_params, self.params, arena["k"], arena["v"],
-                jnp.asarray(ids_pad), jnp.asarray(fin_pad),
-                jnp.asarray(plans), lora,
-                spec=spec, page_size=self.page_size, max_pages=pb,
-                eos_id=-1 if eos_token_id is None else int(eos_token_id),
-                compute_dtype=self.compute_dtype, windows=self.windows,
-                use_paged=use_paged_now,
-            )
+            with jitwatch.region("decode_loop", f"b{bb},n{nb},p{pb}"):
+                return decode_loop(  # bbtpu: noqa[BB012] eos_id is a per-model token constant (cardinality 1 per checkpoint), not a request shape
+                    client_params, self.params, arena["k"], arena["v"],
+                    jnp.asarray(ids_pad), jnp.asarray(fin_pad),
+                    jnp.asarray(plans), lora,
+                    spec=spec, page_size=self.page_size, max_pages=pb,
+                    eos_id=(
+                        -1 if eos_token_id is None else int(eos_token_id)
+                    ),
+                    compute_dtype=self.compute_dtype,
+                    windows=self.windows,
+                    use_paged=use_paged_now,
+                )
 
         try:
             toks, new_k, new_v = _run(use_paged)
@@ -1107,7 +1124,7 @@ class SpanExecutor:
                 jax.tree.map(lambda x: x[l], lora)
                 if lora is not None else None
             )
-            hidden, ak, av = layer_step(
+            hidden, ak, av = layer_step(  # bbtpu: noqa[BB012] window is per-layer checkpoint config (few distinct values per model), not a request shape
                 cur, ak, av, hidden, plan1, jnp.int32(l), tm_dev, lora_l,
                 spec=self.spec, page_size=self.page_size, max_pages=pb,
                 use_tree_mask=use_tm, window=int(self.windows[l]),
@@ -1265,11 +1282,12 @@ class SpanExecutor:
         arena = self.manager.arena
         if self.host_layers:
             def _run_off(use_paged_now: bool):
-                return self._run_offloaded(
-                    h_pad, slots_pad, pt_pad, positions, lens_pad,
-                    layer_active, tm_pad, lora, bb, tb, pb, use_flash,
-                    use_paged_now, attn_topk, t_real=t,
-                )
+                with jitwatch.region("layer_step", f"b{bb},t{tb},p{pb}"):
+                    return self._run_offloaded(
+                        h_pad, slots_pad, pt_pad, positions, lens_pad,
+                        layer_active, tm_pad, lora, bb, tb, pb, use_flash,
+                        use_paged_now, attn_topk, t_real=t,
+                    )
 
             try:
                 out, new_k, new_v = _run_off(use_paged)
@@ -1296,23 +1314,26 @@ class SpanExecutor:
 
             payload_dev, tm_dev = self._place_step_inputs(h_pad, plan, tm_pad)
             try:
-                out, new_k, new_v = span_step_hetero(
-                    self.params,
-                    arena["k"],
-                    arena["v"],
-                    payload_dev,
-                    tm_dev,
-                    lora,
-                    spec=spec,
-                    b=bb,
-                    t=tb,
-                    page_size=self.page_size,
-                    max_pages=pb,
-                    use_tree_mask=tree_mask is not None,
-                    start_block=self.start_block,
-                    layer_active=tuple(int(x) for x in layer_active),
-                    attn_topk=attn_topk,
-                )
+                with jitwatch.region(
+                    "span_step_hetero", f"b{bb},t{tb},p{pb}"
+                ):
+                    out, new_k, new_v = span_step_hetero(  # bbtpu: noqa[BB012] layer_active is the hetero residency mask — one value per (span, offload split), not per request
+                        self.params,
+                        arena["k"],
+                        arena["v"],
+                        payload_dev,
+                        tm_dev,
+                        lora,
+                        spec=spec,
+                        b=bb,
+                        t=tb,
+                        page_size=self.page_size,
+                        max_pages=pb,
+                        use_tree_mask=tree_mask is not None,
+                        start_block=self.start_block,
+                        layer_active=tuple(int(x) for x in layer_active),
+                        attn_topk=attn_topk,
+                    )
             except Exception:
                 # same donated-arena contract as the dense branch: a
                 # runtime failure after donation must rebuild so the
@@ -1324,25 +1345,26 @@ class SpanExecutor:
             payload_dev, tm_dev = self._place_step_inputs(h_pad, plan, tm_pad)
 
             def _run(use_paged_now: bool):
-                return span_step_packed(
-                    self.params,
-                    arena["k"],
-                    arena["v"],
-                    payload_dev,
-                    tm_dev,
-                    lora,
-                    attn_topk=attn_topk,
-                    spec=spec,
-                    b=bb,
-                    t=tb,
-                    page_size=self.page_size,
-                    max_pages=pb,
-                    use_tree_mask=tree_mask is not None,
-                    windows=self.windows,
-                    use_flash=use_flash,
-                    use_paged=use_paged_now,
-                    t_real=t,
-                )
+                with jitwatch.region("span_step", f"b{bb},t{tb},p{pb}"):
+                    return span_step_packed(
+                        self.params,
+                        arena["k"],
+                        arena["v"],
+                        payload_dev,
+                        tm_dev,
+                        lora,
+                        attn_topk=attn_topk,
+                        spec=spec,
+                        b=bb,
+                        t=tb,
+                        page_size=self.page_size,
+                        max_pages=pb,
+                        use_tree_mask=tree_mask is not None,
+                        windows=self.windows,
+                        use_flash=use_flash,
+                        use_paged=use_paged_now,
+                        t_real=t,
+                    )
 
             try:
                 out, new_k, new_v = _run(use_paged)
@@ -1374,4 +1396,4 @@ class SpanExecutor:
             return out  # lazy device array; caller fetches off-queue
         # keep the transfer dtype (bf16 when computing in bf16): this array
         # goes straight onto the wire (reply or server-to-server push)
-        return np.asarray(out).astype(self.transfer_dtype)
+        return self.fetch(out)

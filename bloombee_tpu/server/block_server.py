@@ -46,7 +46,7 @@ from bloombee_tpu.server.compute_queue import (
     aged_chunk_priority,
 )
 from bloombee_tpu.swarm.data import ServerInfo, ServerState
-from bloombee_tpu.utils import clock, env, ledger, lockwatch
+from bloombee_tpu.utils import clock, env, jitwatch, ledger, lockwatch
 from bloombee_tpu.wire.flow import FlowLimiter
 from bloombee_tpu.wire.rpc import (
     Connection,
@@ -880,6 +880,7 @@ class BlockServer:
         return self.rpc.port
 
     async def start(self) -> None:
+        jitwatch.install()  # no-op unless BBTPU_JITWATCH=1
         await self.rpc.start()
         self.compute.start()
         if self.session_lease_s > 0:
@@ -1048,7 +1049,23 @@ class BlockServer:
         real request skips multi-second XLA compiles (the role of the
         reference's CUDA-graph warmup + startup throughput measurement,
         throughput.py:244-345). Runs at training priority so any real
-        inference outranks it."""
+        inference outranks it.
+
+        jitwatch phase contract: everything compiled in here is warmup;
+        the fence drops when the LAST bucket is in, and any dispatch-
+        attributed compile after that is a steady-state recompile the
+        --require gate fails on. Re-entrant warmups (elastic rebalance,
+        span moves) re-open the warmup phase the same way."""
+        jitwatch.install()
+        jitwatch.set_phase("warmup")
+        try:
+            await self._warmup_buckets(batch_sizes, prefill_tokens)
+        finally:
+            jitwatch.fence()
+
+    async def _warmup_buckets(
+        self, batch_sizes, prefill_tokens: int
+    ) -> None:
         for b in batch_sizes:
             try:
                 async with self.manager.allocate(
@@ -1118,6 +1135,79 @@ class BlockServer:
                 logger.info("warmed sp prefill (%d tokens)", sp_tokens)
             except Exception as e:
                 logger.warning("sp warmup failed: %s", e)
+        await self._warmup_ragged(prefill_tokens)
+
+    async def _warmup_ragged(self, prefill_tokens: int) -> None:
+        """Pre-compile the RAGGED-row buckets the fused group paths hit:
+        mixed_group's grouped decode (r=2, s=2 rows over the prefill-depth
+        page bucket) and tree_group's default-drafter tree verify. Without
+        this the first grouped step after warmup eats the compile stall —
+        exactly the steady-state recompile the jitwatch gate forbids."""
+        mixed_on = bool(env.get("BBTPU_MIXED_BATCH"))
+        spec_on = bool(env.get("BBTPU_SPEC_BATCH"))
+        if not (mixed_on or spec_on):
+            return
+        if self.executor.mixed_unsupported() is not None:
+            return
+        d = self.spec.hidden_size
+        try:
+            async with self.manager.allocate(
+                1, prefill_tokens + 20, timeout=5.0
+            ) as h_a, self.manager.allocate(
+                1, prefill_tokens + 20, timeout=5.0
+            ) as h_b:
+                handles = [h_a, h_b]
+                hidden = np.zeros((1, prefill_tokens, d), np.float32)
+                for h in handles:
+                    # buckets already warm from the solo pass; this seeds
+                    # realistic context depths so pb matches steady state
+                    await self.compute.submit(
+                        PRIORITY_TRAINING, self.executor.prefill,
+                        h, hidden, True, None, False,
+                    )
+                if mixed_on:
+                    snaps = [
+                        [int(x) for x in self.manager.context_lens(h)]
+                        for h in handles
+                    ]
+                    step = [np.zeros((1, 1, d), np.float32)] * 2
+                    await self.compute.submit(
+                        PRIORITY_TRAINING, self.executor.mixed_group,
+                        handles, step,
+                    )
+                    for h, snap in zip(handles, snaps):
+                        self.manager.truncate_speculative(h, snap)
+                    logger.info("warmed mixed ragged buckets (2 rows)")
+                if (
+                    spec_on
+                    and self.executor.tree_group_unsupported() is None
+                ):
+                    snaps = [
+                        [int(x) for x in self.manager.context_lens(h)]
+                        for h in handles
+                    ]
+                    # default GreedyTreeDrafter branching (2, 2, 1):
+                    # 11 linearized nodes per tree — the t_max/rb bucket
+                    # real spec-decode rounds dispatch
+                    t_i = 11
+                    tree = [np.zeros((1, t_i, d), np.float32)] * 2
+                    mask = [
+                        np.tril(np.ones((1, t_i, t_i), dtype=bool))
+                    ] * 2
+                    depths = [
+                        np.arange(t_i, dtype=np.int32)[None, :]
+                    ] * 2
+                    await self.compute.submit(
+                        PRIORITY_TRAINING, self.executor.tree_group,
+                        handles, tree, mask, depths,
+                    )
+                    for h, snap in zip(handles, snaps):
+                        self.manager.truncate_speculative(h, snap)
+                    logger.info(
+                        "warmed tree ragged buckets (2 trees of %d)", t_i
+                    )
+        except Exception as e:
+            logger.warning("ragged warmup failed: %s", e)
 
     async def _supervisor_loop(self) -> None:
         """Keep the server's background tasks alive and the span balanced.
@@ -1843,6 +1933,7 @@ class BlockServer:
             # hierarchy violations + cycles; both zero (and harmless)
             # when the witness is off, so probes need no conditionals
             **lockwatch.counters(),
+            **jitwatch.counters(),
             # overload observability: shed/admit counters, retry_after
             # histogram, and per-client fair-share debt (None with the
             # admission controller off; the live load snapshot itself rides
@@ -4229,7 +4320,8 @@ class BlockServer:
         return freed
 
     def _dump_activations(
-        self, dump_dir: str, session: _Session, meta: dict, hidden, out
+        self, dump_dir: str, session: _Session, meta: dict,
+        hidden: np.ndarray, out: np.ndarray
     ) -> None:
         """Capture real per-step hidden states for compression research
         (reference utils/real_activation_dumper.py, hooked at

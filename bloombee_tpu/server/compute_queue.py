@@ -26,7 +26,7 @@ import itertools
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Hashable
 
-from bloombee_tpu.utils import clock, env
+from bloombee_tpu.utils import clock, env, jitwatch
 
 PRIORITY_INFERENCE = 0.0  # reference DummyTaskPrioritizer: inference=1.0
 # resumable prefill chunks re-enter the queue BETWEEN decode steps and
@@ -288,7 +288,12 @@ class ComputeQueue:
         if self._expired(task):
             return
         try:
-            result = await loop.run_in_executor(self._thread, task.fn)
+            # hot_wrap: while this runs on the compute thread any host
+            # sync counts against jitwatch's hot-path budget (the queue
+            # serializes device work, so a sync here convoys every session)
+            result = await loop.run_in_executor(
+                self._thread, jitwatch.hot_wrap(task.fn)
+            )
             if not task.fut.done():
                 task.fut.set_result(result)
         except asyncio.CancelledError:
@@ -334,9 +339,9 @@ class ComputeQueue:
                 return
             outcomes = await loop.run_in_executor(
                 self._thread,
-                functools.partial(
+                jitwatch.hot_wrap(functools.partial(
                     first.run_group, [m.payload for m in live]
-                ),
+                )),
             )
             if len(outcomes) != len(live):
                 raise RuntimeError(

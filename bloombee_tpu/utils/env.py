@@ -81,6 +81,7 @@ def import_declaring_modules() -> None:
     import bloombee_tpu.server.block_selection  # noqa: F401
     import bloombee_tpu.server.block_server  # noqa: F401
     import bloombee_tpu.utils.clock  # noqa: F401
+    import bloombee_tpu.utils.jitwatch  # noqa: F401
     import bloombee_tpu.utils.ledger  # noqa: F401
     import bloombee_tpu.utils.lockwatch  # noqa: F401
     import bloombee_tpu.wire.faults  # noqa: F401

@@ -1,0 +1,414 @@
+"""Runtime compile/transfer witness — the dynamic half of bbtpu-lint's
+JIT-boundary story (the static half is BB011/BB012/BB013 in
+analysis/rules.py).
+
+Static analysis proves which call sites CAN recompile or sync; this
+module records what a run ACTUALLY compiled and transferred. Opt-in via
+``BBTPU_JITWATCH=1``: :func:`install` registers one
+``jax.monitoring`` event-duration listener that ledgers every XLA
+backend compile as ``(function, shape_signature, compile_ms, phase)``.
+Attribution rides a thread-local region stack: the executor wraps each
+dispatch in :func:`region` naming the jit entry and its bucket
+signature, so a compile that fires inside the dispatch is pinned to the
+exact (function, bucket) that caused it. Compiles outside any region
+(model load, client-side jnp work sharing the process) are ledgered as
+``(unattributed)`` — counted, visible, but not gated, because only
+region-attributed compiles are provably the serving path's fault.
+
+Phases split the compile budget: every process starts in ``warmup``;
+``BlockServer.warmup`` drops the fence (:func:`fence`) when its bucket
+pre-compilation finishes, and every region-attributed compile after the
+fence is a **steady-state recompile** — the recompile-storm signal this
+witness exists to catch. Host syncs are recorded by the explicit d2h
+sites (``executor.fetch``) via :func:`host_sync`; ones that fire while
+the compute-queue worker is mid-task (:func:`hot_wrap`) count as
+``host_syncs_hot_path`` — a device stall inside the serialized step
+pipeline, the convoy BB011 flags statically.
+
+At interpreter exit the witness appends one JSON line to
+``BBTPU_JITWATCH_REPORT`` (append mode, multi-process merge — same
+contract as lockwatch/ledger). ``python -m bloombee_tpu.utils.jitwatch
+PATH --require`` merges the lines and FAILS on: zero observed compiles
+(vacuous green — a witness that saw no XLA activity validated nothing),
+no warmup fence in any line (the steady window never opened, so "zero
+steady recompiles" is also vacuous), zero warmup compiles (same), or
+ANY steady-state recompile. clock is deliberately NOT imported here
+(the ledger/clock/*watch utility layer stays import-cycle-free).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import threading
+
+from bloombee_tpu.utils import env
+
+env.declare(
+    "BBTPU_JITWATCH", bool, False,
+    "install the runtime compile/transfer witness: ledgers every XLA "
+    "backend compile with (function, shape bucket, ms, phase) via the "
+    "jax.monitoring hook, counts host syncs on the compute hot path, "
+    "and reports at exit. Off = listener never registered, zero overhead",
+)
+env.declare(
+    "BBTPU_JITWATCH_REPORT", str, "",
+    "path to append this process's compile-witness report to at exit "
+    "(one JSON line: compile ledger, warmup/steady split, hot-path host "
+    "syncs); empty = in-memory only. Set by scripts/chaos.sh so the "
+    "gate can require zero steady-state recompiles",
+)
+
+_MAX_COMPILES = 200  # keep each report line bounded under a compile storm
+_UNATTRIBUTED = "(unattributed)"
+
+
+class _Witness:
+    """Process-wide compile/transfer ledger. Internal mutex is a PLAIN
+    threading.Lock — the witness must never watch itself. Phase is
+    process-wide (one warmup fence per server process); the attribution
+    region and hot-path marks are thread-local because dispatches run
+    synchronously on the compute worker thread."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self.compiles: list[dict] = []
+        self.xla_compiles = 0
+        self.compile_ms_total = 0.0
+        self.warmup_compiles = 0
+        self.steady_state_recompiles = 0
+        self.host_syncs: dict[str, int] = {}
+        self.host_syncs_hot_path = 0
+        self.phase = "warmup"
+        self.fenced = False
+
+    # ---------------------------------------------------- thread context
+    def _regions(self) -> list[tuple[str, str]]:
+        st = getattr(self._tls, "regions", None)
+        if st is None:
+            st = self._tls.regions = []
+        return st
+
+    def _hot_depth(self) -> int:
+        return getattr(self._tls, "hot", 0)
+
+    # ------------------------------------------------------------ record
+    def record_compile(self, duration_s: float) -> None:
+        regions = self._regions()
+        function, shape = regions[-1] if regions else (_UNATTRIBUTED, "")
+        ms = float(duration_s) * 1000.0
+        with self._mu:
+            phase = self.phase
+            self.xla_compiles += 1
+            self.compile_ms_total += ms
+            if phase == "warmup":
+                self.warmup_compiles += 1
+            elif function != _UNATTRIBUTED:
+                # only region-attributed compiles gate: the serving path
+                # owns its dispatch buckets, not the client-side jnp work
+                # that may share a test process
+                self.steady_state_recompiles += 1
+            if len(self.compiles) < _MAX_COMPILES:
+                self.compiles.append({
+                    "function": function,
+                    "shape": shape,
+                    "compile_ms": round(ms, 3),
+                    "phase": phase,
+                })
+
+    def record_host_sync(self, tag: str) -> None:
+        hot = self._hot_depth() > 0
+        with self._mu:
+            self.host_syncs[tag] = self.host_syncs.get(tag, 0) + 1
+            if hot:
+                self.host_syncs_hot_path += 1
+
+    # ------------------------------------------------------------- phase
+    def set_phase(self, phase: str) -> None:
+        with self._mu:
+            self.phase = phase
+
+    def fence(self) -> None:
+        with self._mu:
+            self.phase = "steady"
+            self.fenced = True
+
+    # ------------------------------------------------------------ reading
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "compiles": [dict(c) for c in self.compiles],
+                "xla_compiles": self.xla_compiles,
+                "compile_ms_total": round(self.compile_ms_total, 3),
+                "warmup_compiles": self.warmup_compiles,
+                "steady_state_recompiles": self.steady_state_recompiles,
+                "host_syncs": dict(self.host_syncs),
+                "host_syncs_hot_path": self.host_syncs_hot_path,
+                "fenced": self.fenced,
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self.compiles.clear()
+            self.xla_compiles = 0
+            self.compile_ms_total = 0.0
+            self.warmup_compiles = 0
+            self.steady_state_recompiles = 0
+            self.host_syncs.clear()
+            self.host_syncs_hot_path = 0
+            self.phase = "warmup"
+            self.fenced = False
+        # the CALLING thread's context only (other threads' region stacks
+        # are theirs to unwind) — a harness that leaked a region would
+        # otherwise misattribute every later compile
+        self._regions().clear()
+        self._tls.hot = 0
+
+
+_witness = _Witness()
+_installed = False
+_atexit_registered = False
+
+
+def enabled() -> bool:
+    return bool(env.get("BBTPU_JITWATCH"))
+
+
+def install() -> bool:
+    """Register the XLA compile listener (idempotent; no-op when the
+    switch is off). Called by BlockServer/bench startup — the listener
+    is process-global and permanent, so the callback re-checks
+    :func:`enabled` per event to honor env flips in tests."""
+    global _installed, _atexit_registered
+    if not enabled():
+        return False
+    if not _atexit_registered:
+        _atexit_registered = True
+        if env.get("BBTPU_JITWATCH_REPORT"):
+            atexit.register(flush)
+    if _installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:  # jax-free analysis/CLI contexts: witness stays off
+        return False
+
+    def _on_event(event: str, duration_s: float, **kwargs) -> None:
+        # one jit call can emit several backend_compile events (aux
+        # computations); each is a real XLA compile, ledger them all
+        if "backend_compile" in event and enabled():
+            _witness.record_compile(duration_s)
+
+    monitoring.register_event_duration_secs_listener(_on_event)
+    _installed = True
+    return True
+
+
+# ------------------------------------------------------------ attribution
+class _Region:
+    """Thread-local attribution frame for one dispatch: compiles fired
+    while entered are pinned to (function, shape_signature)."""
+
+    __slots__ = ("_function", "_shape", "_on")
+
+    def __init__(self, function: str, shape: str):
+        self._function = function
+        self._shape = shape
+        self._on = enabled()
+
+    def __enter__(self):
+        if self._on:
+            _witness._regions().append((self._function, self._shape))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._on:
+            st = _witness._regions()
+            if st:
+                st.pop()
+
+
+def region(function: str, shape: str) -> _Region:
+    """Wrap one jit dispatch: ``with jitwatch.region("span_step",
+    "b2,t1,p64"): ...``. Cheap no-op frame when the witness is off."""
+    return _Region(function, shape)
+
+
+def hot_wrap(fn):
+    """Mark `fn` as compute-queue hot-path work: host syncs recorded
+    while it runs count as ``host_syncs_hot_path``. Returns `fn`
+    unchanged when the witness is off (zero-overhead contract)."""
+    if not enabled():
+        return fn
+
+    def _hot(*args, **kwargs):
+        _witness._tls.hot = _witness._hot_depth() + 1
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _witness._tls.hot = _witness._hot_depth() - 1
+
+    return _hot
+
+
+def host_sync(tag: str) -> None:
+    """Record one device→host sync at an instrumented site (the BB011
+    sites that survive triage call this next to the transfer)."""
+    if enabled():
+        _witness.record_host_sync(tag)
+
+
+# ------------------------------------------------------------------ phase
+def set_phase(phase: str) -> None:
+    """Re-open a phase (BlockServer.warmup sets "warmup" so re-entrant
+    warmups — e.g. after elastic rebalance — ledger under warmup)."""
+    if enabled():
+        _witness.set_phase(phase)
+
+
+def fence() -> None:
+    """Drop the warmup fence: every region-attributed compile after this
+    is a steady-state recompile and fails the --require gate."""
+    if enabled():
+        _witness.fence()
+
+
+# -------------------------------------------------------------- reporting
+def counters() -> dict:
+    """Live counter group for rpc_info / health --probe."""
+    snap = _witness.snapshot()
+    return {
+        "xla_compiles": snap["xla_compiles"],
+        "compile_ms_total": snap["compile_ms_total"],
+        "warmup_compiles": snap["warmup_compiles"],
+        "steady_state_recompiles": snap["steady_state_recompiles"],
+        "host_syncs_hot_path": snap["host_syncs_hot_path"],
+    }
+
+
+def snapshot() -> dict:
+    return _witness.snapshot()
+
+
+def reset() -> None:
+    _witness.reset()
+
+
+def flush(path: str | None = None) -> None:
+    """Append this process's witness report as one JSON line (atexit
+    hook; callable directly by harnesses)."""
+    path = path or env.get("BBTPU_JITWATCH_REPORT")
+    if not path:
+        return
+    snap = _witness.snapshot()
+    if not snap["xla_compiles"] and not snap["host_syncs"]:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(snap, sort_keys=True) + "\n")
+    except OSError:  # the witness must never take down the run it audits
+        pass
+
+
+def merge_lines(text: str) -> dict:
+    """Merge a multi-process report file into one compile/sync ledger."""
+    merged = {
+        "compiles": [],
+        "xla_compiles": 0,
+        "compile_ms_total": 0.0,
+        "warmup_compiles": 0,
+        "steady_state_recompiles": 0,
+        "host_syncs": {},
+        "host_syncs_hot_path": 0,
+        "fenced": False,
+    }
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            snap = json.loads(line)
+        except ValueError:
+            continue
+        merged["compiles"].extend(snap.get("compiles") or [])
+        for key in ("xla_compiles", "warmup_compiles",
+                    "steady_state_recompiles", "host_syncs_hot_path"):
+            merged[key] += int(snap.get(key) or 0)
+        merged["compile_ms_total"] += float(snap.get("compile_ms_total") or 0)
+        for tag, n in (snap.get("host_syncs") or {}).items():
+            merged["host_syncs"][tag] = (
+                merged["host_syncs"].get(tag, 0) + int(n)
+            )
+        merged["fenced"] = merged["fenced"] or bool(snap.get("fenced"))
+    merged["compile_ms_total"] = round(merged["compile_ms_total"], 3)
+    return merged
+
+
+def _main(argv=None) -> int:
+    """``python -m bloombee_tpu.utils.jitwatch PATH [--require]``: merge
+    and print a witness report; with --require, exit 1 unless the run
+    observed >=1 warmup compile behind a dropped fence (proof the
+    witness and the warmup both ran) with ZERO steady-state recompiles."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(description=_main.__doc__)
+    ap.add_argument("path")
+    ap.add_argument("--require", action="store_true",
+                    help="fail (exit 1) on zero compiles, a missing "
+                         "warmup fence, or any steady-state recompile")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            text = f.read()
+    except OSError:
+        text = ""
+    merged = merge_lines(text)
+    steady = [c for c in merged["compiles"]
+              if c.get("phase") == "steady"
+              and c.get("function") != _UNATTRIBUTED]
+    print(
+        f"jitwatch: {merged['xla_compiles']} compile(s) "
+        f"({merged['warmup_compiles']} warmup, "
+        f"{merged['steady_state_recompiles']} steady-state), "
+        f"{merged['compile_ms_total']:.0f}ms total, "
+        f"{merged['host_syncs_hot_path']} hot-path host sync(s), "
+        f"fenced={merged['fenced']}"
+    )
+    for tag, n in sorted(merged["host_syncs"].items()):
+        print(f"  sync {tag} x{n}")
+    for c in steady:
+        print(
+            f"  STEADY RECOMPILE {c['function']}[{c['shape']}] "
+            f"{c['compile_ms']}ms"
+        )
+    if args.require:
+        if not merged["xla_compiles"]:
+            print(
+                "jitwatch: EMPTY — a witness-enabled run must observe "
+                ">=1 XLA compile; a run that compiled nothing validated "
+                "nothing", file=sys.stderr,
+            )
+            return 1
+        if not merged["fenced"] or not merged["warmup_compiles"]:
+            print(
+                "jitwatch: NO WARMUP FENCE — no process dropped the "
+                "warmup fence after >=1 warmup compile, so the "
+                "steady-state window never opened and 'zero recompiles' "
+                "is vacuous", file=sys.stderr,
+            )
+            return 1
+        if merged["steady_state_recompiles"]:
+            print(
+                "jitwatch: steady-state recompile(s) observed — a decode "
+                "bucket escaped BlockServer.warmup or a shape escaped its "
+                "pow2 bucketer (BB012); the ledger above names the "
+                "(function, shape) to pre-compile", file=sys.stderr,
+            )
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# bbtpu-lint gate: project-specific AST rules (BB001-BB010) plus the
+# bbtpu-lint gate: project-specific AST rules (BB001-BB013) plus the
 # README env-switch-table and ARCHITECTURE lock-hierarchy-table drift
 # checks, against the committed baseline.
 #

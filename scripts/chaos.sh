@@ -30,6 +30,14 @@
 #                entry must observe >=1 cross-lock edge (a witness that
 #                watched nothing proved nothing) with zero violations
 #                and zero cycles
+#   JITWATCH     1 = runtime compile/transfer witness (BBTPU_JITWATCH):
+#                every XLA backend compile is ledgered with its
+#                (function, shape bucket, phase) attribution. Gated the
+#                same no-vacuous-green way: the entry must observe >=1
+#                warmup compile behind a dropped warmup fence and ZERO
+#                steady-state recompiles (a decode bucket that escaped
+#                BlockServer.warmup is a first-token compile stall some
+#                session actually paid)
 #   TESTS        comma-separated test-file list for this entry (default:
 #                the whole chaos-marked suite). Feature entries target the
 #                files that actually exercise their flags — the per-entry
@@ -68,14 +76,15 @@ MATRIX=(
     "SEED=57 DELAY_P=0.05 MIXED=1 SPEC=1 TESTS=tests/test_mixed_batch.py,tests/test_spec_decode.py,tests/test_batched_decode.py,tests/test_chunked_prefill.py"
     "SEED=83 DELAY_P=0.05 ADMIT=1 REBALANCE=1 TESTS=tests/test_chaos.py,tests/test_promotion.py,tests/test_kv_replication.py,tests/test_prefix_cache.py"
     "SEED=97 DELAY_P=0.02 CORRUPT=0.05 TESTS=tests/test_chaos.py,tests/test_session_lease.py,tests/test_kv_replication.py"
+    "SEED=31 DELAY_P=0.02 JITWATCH=1 TESTS=tests/test_jitwatch.py,tests/test_chaos.py"
 )
 for entry in "${MATRIX[@]}"; do
     # per-entry defaults; each entry overrides only what it varies
     SEED=0 DELAY_P=0 ADMIT=0 PARTITION_P=0 MIXED=0 SPEC=0 REBALANCE=0
-    CORRUPT=0 LOCKWATCH=0 TESTS=tests/
+    CORRUPT=0 LOCKWATCH=0 JITWATCH=0 TESTS=tests/
     for tok in ${entry}; do
         case "${tok%%=*}" in
-            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|LOCKWATCH|TESTS)
+            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|LOCKWATCH|JITWATCH|TESTS)
                 declare "${tok}" ;;
             *)
                 echo "chaos: unknown matrix token '${tok}'" >&2
@@ -122,7 +131,8 @@ BBTPU_SPEC_BATCH=${SPEC} \
 BBTPU_MEASURED_REBALANCE=${REBALANCE} \
 BBTPU_PROMOTE_HIGH_MS=${promote_high_ms} \
 BBTPU_PROMOTE_SUSTAIN_S=${promote_sustain_s} \
-BBTPU_LOCKWATCH=${LOCKWATCH}"
+BBTPU_LOCKWATCH=${LOCKWATCH} \
+BBTPU_JITWATCH=${JITWATCH}"
     # recovery-coverage ledger: every in-process fault/recovery point
     # appends here at interpreter exit; an entry that tested nothing
     # (zero faults or zero recoveries) fails the gate even if pytest
@@ -131,12 +141,15 @@ BBTPU_LOCKWATCH=${LOCKWATCH}"
     # lock-witness report, same multi-process append contract as the
     # ledger; gated below with the same no-vacuous-green rule
     lockwatch_file="$(mktemp "${TMPDIR:-/tmp}/bbtpu-chaos-lockwatch.XXXXXX")"
+    # compile-witness report (BBTPU_JITWATCH entries), same contract
+    jitwatch_file="$(mktemp "${TMPDIR:-/tmp}/bbtpu-chaos-jitwatch.XXXXXX")"
     echo "chaos: ${entry}" >&2
     entry_start=${SECONDS}
     rc=0
     test_targets="${TESTS//,/ }"
     env ${env_line} BBTPU_CHAOS_LEDGER="${ledger_file}" \
         BBTPU_LOCKWATCH_REPORT="${lockwatch_file}" \
+        BBTPU_JITWATCH_REPORT="${jitwatch_file}" \
         JAX_COMPILATION_CACHE_DIR="${compile_cache}" \
         JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0.5 \
         python -m pytest ${test_targets} -q -m chaos \
@@ -149,15 +162,19 @@ BBTPU_LOCKWATCH=${LOCKWATCH}"
         python -m bloombee_tpu.utils.lockwatch "${lockwatch_file}" \
             --require >&2 || rc=$?
     fi
+    if [ "${rc}" -eq 0 ] && [ "${JITWATCH}" != "0" ]; then
+        python -m bloombee_tpu.utils.jitwatch "${jitwatch_file}" \
+            --require >&2 || rc=$?
+    fi
     elapsed=$(( SECONDS - entry_start ))
     if [ "${rc}" -ne 0 ]; then
         echo "chaos: RED entry '${entry}' after ${elapsed}s" >&2
         echo "chaos: reproduce with:" >&2
         echo "  ${env_line} python -m pytest ${test_targets} -q -m chaos" \
              "-p no:cacheprovider -p no:xdist -p no:randomly" >&2
-        rm -f "${ledger_file}" "${lockwatch_file}"
+        rm -f "${ledger_file}" "${lockwatch_file}" "${jitwatch_file}"
         exit "${rc}"
     fi
     echo "chaos: entry '${entry}' green in ${elapsed}s" >&2
-    rm -f "${ledger_file}" "${lockwatch_file}"
+    rm -f "${ledger_file}" "${lockwatch_file}" "${jitwatch_file}"
 done

@@ -377,6 +377,288 @@ def test_bb010_true_negative():
     assert codes(BB010_TN) == []
 
 
+# ------------------------------------------------------------------ BB011
+BB011_TP = """
+    class BlockServer:
+        def decode_group(self, out_dev):
+            return self._finish(out_dev)
+
+        def _finish(self, out_dev):
+            return float(out_dev.sum())
+"""
+
+BB011_TN = """
+    class BlockServer:
+        def cold_path(self, out_dev):
+            return out_dev.item()
+
+        def decode_group(self, lens):
+            return int(lens.max())
+"""
+
+
+def test_bb011_true_positive_transitive_chain():
+    fs = findings(BB011_TP)
+    assert [f.code for f in fs] == ["BB011"]
+    assert "decode_group" in " -> ".join(fs[0].chain)
+    assert "_finish" in " -> ".join(fs[0].chain)
+
+
+def test_bb011_true_negative():
+    # .item() off the hot path, and int() of a host-side length, are
+    # both quiet
+    assert codes(BB011_TN) == []
+
+
+def test_bb011_direct_sync_in_hot_root():
+    assert codes(
+        """
+        class BlockServer:
+            def tree_group(self, members):
+                out = self.executor.tree_group(members)
+                out.block_until_ready()
+                return out
+        """
+    ) == ["BB011"]
+
+
+def test_bb011_offloaded_and_host_bound_are_quiet():
+    # the one deliberate d2h runs via asyncio.to_thread (off the
+    # compute queue), and names bound from to_thread/fetch are host
+    # values — converting them again is not a sync
+    assert codes(
+        """
+        class BlockServer:
+            async def decode_group(self, out_dev):
+                out = await asyncio.to_thread(self.executor.fetch, out_dev)
+                arr = np.asarray(out, dtype=np.int32)
+                toks = await asyncio.to_thread(
+                    lambda: np.asarray(out_dev, dtype=np.int32)
+                )
+                return arr, toks
+        """
+    ) == []
+
+
+def test_bb011_ndarray_annotated_param_is_quiet():
+    # an np.ndarray-annotated parameter declares the value host-side:
+    # the fetch already happened at the caller's chokepoint
+    assert codes(
+        """
+        class BlockServer:
+            def decode_group(self, out: np.ndarray):
+                return np.asarray(out, dtype=np.float32)
+        """
+    ) == []
+
+
+def test_bb011_noqa_suppresses():
+    assert codes(
+        """
+        class BlockServer:
+            def decode_group(self, out_dev):
+                return np.asarray(out_dev)  # bbtpu: noqa[BB011] wire-bound
+        """
+    ) == []
+
+
+# ------------------------------------------------------------------ BB012
+RUNTIME = "bloombee_tpu/runtime/mod.py"
+
+
+def jit_src(body: str) -> str:
+    """Prelude (a runtime-style jit entry) + a test body, each dedented
+    on its own so their indent levels need not match."""
+    return textwrap.dedent(BB012_PRELUDE) + textwrap.dedent(body)
+
+
+BB012_PRELUDE = """
+    import functools
+    import jax
+
+    def span_step_impl(params, ak, av, h, *, b, t):
+        return h, ak, av
+
+    span_step = functools.partial(
+        jax.jit, static_argnames=("b", "t"),
+        donate_argnames=("ak", "av"),
+    )(span_step_impl)
+"""
+
+BB012_TP = BB012_PRELUDE + """
+    class Exec:
+        def step(self, params, arena, hidden):
+            t = hidden.shape[1]
+            h, ak, av = span_step(
+                params, arena["k"], arena["v"], hidden, b=2, t=t
+            )
+            return h, ak, av
+"""
+
+BB012_TN = BB012_PRELUDE + """
+    class Exec:
+        def step(self, params, arena, hidden):
+            t = next_pow2(hidden.shape[1])
+            h, ak, av = span_step(
+                params, arena["k"], arena["v"], hidden, b=2, t=t
+            )
+            return h, ak, av
+"""
+
+
+def test_bb012_true_positive_raw_shape():
+    fs = findings(BB012_TP, path=RUNTIME)
+    assert [f.code for f in fs] == ["BB012"]
+    assert "t=t" in fs[0].message
+
+
+def test_bb012_true_negative_bucketed():
+    # the bucketer anywhere on the derivation path clears the value
+    assert codes(BB012_TN, path=RUNTIME) == []
+
+
+def test_bb012_constant_static_is_quiet():
+    assert codes(
+        jit_src("""
+        class Exec:
+            def step(self, params, arena, hidden):
+                h, ak, av = span_step(
+                    params, arena["k"], arena["v"], hidden, b=2, t=8
+                )
+                return h, ak, av
+        """),
+        path=RUNTIME,
+    ) == []
+
+
+def test_bb012_transitive_derivation_is_flagged():
+    # t -> t_raw -> len(rows): two assignment hops, still raw
+    assert codes(
+        jit_src("""
+        class Exec:
+            def step(self, params, arena, hidden, rows):
+                t_raw = len(rows)
+                t = t_raw + 1
+                h, ak, av = span_step(
+                    params, arena["k"], arena["v"], hidden, b=2, t=t
+                )
+                return h, ak, av
+        """),
+        path=RUNTIME,
+    ) == ["BB012"]
+
+
+def test_bb012_entries_outside_runtime_are_out_of_scope():
+    # client-side jit helpers are not serving hot paths
+    assert codes(BB012_TP, path=CLIENT) == []
+
+
+# ------------------------------------------------------------------ BB013
+BB013_TP = BB012_PRELUDE + """
+    class Exec:
+        def step(self, params, arena, hidden):
+            h, ak, av = span_step(
+                params, arena["k"], arena["v"], hidden, b=2, t=8
+            )
+            leak = arena["k"].sum()
+            return h, leak
+"""
+
+BB013_TN = BB012_PRELUDE + """
+    class Exec:
+        def step(self, params, arena, hidden):
+            ak, av = arena["k"], arena["v"]
+            h, ak, av = span_step(params, ak, av, hidden, b=2, t=8)
+            return h, ak, av
+"""
+
+
+def test_bb013_true_positive():
+    fs = findings(BB013_TP, path=RUNTIME)
+    assert [f.code for f in fs] == ["BB013"]
+    assert "DONATED" in fs[0].message
+    assert "arena['k']" in fs[0].message
+
+
+def test_bb013_true_negative_rebound():
+    # rebinding to the returned arrays (same statement) is THE correct
+    # donation pattern
+    assert codes(BB013_TN, path=RUNTIME) == []
+
+
+def test_bb013_later_rebind_kills_tracking():
+    assert codes(
+        jit_src("""
+        class Exec:
+            def step(self, params, arena, hidden):
+                h, ak, av = span_step(
+                    params, arena["k"], arena["v"], hidden, b=2, t=8
+                )
+                arena["k"], arena["v"] = ak, av
+                return h, arena["k"].sum()
+        """),
+        path=RUNTIME,
+    ) == []
+
+
+def test_bb013_except_handler_read_is_quiet():
+    # the donated-arena self-heal contract probes consumed buffers in
+    # the except handler on purpose (_arena_consumed)
+    assert codes(
+        jit_src("""
+        class Exec:
+            def step(self, params, arena, hidden):
+                try:
+                    h, ak, av = span_step(
+                        params, arena["k"], arena["v"], hidden, b=2, t=8
+                    )
+                except Exception:
+                    if self._arena_consumed(arena["k"]):
+                        self._rebuild_after_failure("step")
+                    raise
+                return h, ak, av
+        """),
+        path=RUNTIME,
+    ) == []
+
+
+def test_bb013_sibling_branch_read_is_quiet():
+    # mutually exclusive if/else arms never execute in sequence
+    assert codes(
+        jit_src("""
+        class Exec:
+            def step(self, params, arena, hidden, fancy):
+                if fancy:
+                    h, ak, av = span_step(
+                        params, arena["k"], arena["v"], hidden, b=2, t=8
+                    )
+                else:
+                    h = hidden
+                    ak, av = arena["k"], arena["v"]
+                return h, ak, av
+        """),
+        path=RUNTIME,
+    ) == []
+
+
+def test_bb013_decorated_jit_form_and_noqa():
+    src = jit_src("""
+    @functools.partial(jax.jit, donate_argnames=("ak",))
+    def write_all(ak, xs):
+        return ak
+
+    class Exec:
+        def flush(self, arena, xs):
+            ak = write_all(arena["k"], xs)
+            return arena["k"].shape{noqa}
+    """)
+    assert codes(src.format(noqa=""), path=RUNTIME) == ["BB013"]
+    assert codes(
+        src.format(noqa="  # bbtpu: noqa[BB013] probe only"),
+        path=RUNTIME,
+    ) == []
+
+
 # ------------------------------------------------------------------ BB004
 BB004_TP = """
     import dataclasses

@@ -26,7 +26,7 @@ def test_matrix_entries_are_keyval_tokens():
     assert len(entries) >= 5, f"matrix lost entries: {entries}"
     known = {
         "SEED", "DELAY_P", "ADMIT", "PARTITION_P", "MIXED", "SPEC",
-        "REBALANCE", "CORRUPT", "LOCKWATCH", "TESTS",
+        "REBALANCE", "CORRUPT", "LOCKWATCH", "JITWATCH", "TESTS",
     }
     for entry in entries:
         for tok in entry.split():
@@ -91,6 +91,26 @@ def test_gate_requires_nonvacuous_lockwatch():
     ) or re.search(
         r"python -m bloombee_tpu\.utils\.lockwatch .*--require", src
     ), "gate never checks the lock-witness report with --require"
+
+
+def test_gate_requires_nonvacuous_jitwatch():
+    """The compile-witness entry follows the same contract: at least one
+    matrix entry runs with BBTPU_JITWATCH=1 and its report is gated with
+    --require, which fails on zero observed compiles (vacuous green), a
+    missing warmup fence, or any steady-state recompile."""
+    src = (REPO / "scripts" / "chaos.sh").read_text()
+    entries = re.findall(r'^\s+"([^"]+)"$', src, flags=re.M)
+    assert any("JITWATCH=1" in e for e in entries), (
+        "no compile-witness entry in the chaos matrix"
+    )
+    assert "BBTPU_JITWATCH_REPORT=" in src, (
+        "witness runs without a report file; nothing to gate on"
+    )
+    assert re.search(
+        r"python -m bloombee_tpu\.utils\.jitwatch .*\\\n\s*--require", src
+    ) or re.search(
+        r"python -m bloombee_tpu\.utils\.jitwatch .*--require", src
+    ), "gate never checks the compile-witness report with --require"
 
 
 def test_red_entry_prints_full_reproduction_line():
