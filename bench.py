@@ -309,6 +309,24 @@ def _emit_json_locked():
         out["autoscale_recover_promotions"] = int(
             rec.get("promotions", 0)
         )
+        # zero-cold-start recovery: promotion-to-first-token with the
+        # swarm-shared compile-artifact cache pre-installed on the standby
+        # vs the cold local-compile baseline (in-memory jit cache cleared
+        # at the promotion boundary in BOTH variants, so the delta is
+        # exactly what pre-install buys a fresh process)
+        pre = asc.get("recovery_preinstall") or {}
+        out["autoscale_promotion_to_first_token_cold_ms"] = round(
+            rec.get("first_token_ms", 0.0), 1
+        )
+        out["autoscale_promotion_to_first_token_preinstall_ms"] = round(
+            pre.get("first_token_ms", 0.0), 1
+        )
+        out["autoscale_artifact_preinstalled"] = bool(
+            pre.get("preinstalled", False)
+        )
+        out["autoscale_preinstall_token_identical"] = bool(
+            pre.get("token_identical", False)
+        )
     if RESULTS.get("phases"):
         out["phases"] = RESULTS["phases"]
     if RESULTS.get("compile_stats"):
@@ -1713,10 +1731,13 @@ def run_autoscale(spec, params, smoke: bool) -> None:
     LIGHT_BUDGET = 1000 if smoke else 2048
     VOCAB_EFF = min(1024, spec.vocab_size)
 
-    def _server(rc, *, standby=False, elastic=True, uid="bench_as"):
+    def _server(rc, *, standby=False, elastic=True, uid="bench_as",
+                artifact_dir=None):
         kw = {}
+        if artifact_dir:
+            kw["artifact_dir"] = artifact_dir
         if standby:
-            kw = {
+            kw |= {
                 "standby": True,
                 # OFF mode parks the high watermark at infinity: the
                 # standby stays warm but the control loop never fires,
@@ -1870,12 +1891,29 @@ def run_autoscale(spec, params, smoke: bool) -> None:
                 except Exception:  # noqa: BLE001
                     pass
 
-    async def recovery_leg() -> dict:
+    async def recovery_leg(preinstall: bool = False) -> dict:
+        """Kill-recovery leg. With preinstall=True the primary writes a
+        compile-artifact store, the standby pre-fetches it over the wire
+        before the kill, and the promoted standby's first token is served
+        from persistent-cache loads; the caller clears jax's in-memory jit
+        cache at the promotion boundary either way, so both variants pay
+        a fresh process's compile bill and promotion_to_first_token_ms
+        isolates exactly what pre-install buys."""
+        import shutil
+        import tempfile
+
+        from bloombee_tpu.server import artifacts as _artifacts
+
         reg = RegistryServer(host="127.0.0.1")
         await reg.start()
 
         def rc():
             return RegistryClient("127.0.0.1", reg.port)
+
+        art_a = art_b = None
+        if preinstall:
+            art_a = tempfile.mkdtemp(prefix="bbtpu-bench-art-src.")
+            art_b = tempfile.mkdtemp(prefix="bbtpu-bench-art-dst.")
 
         keys = _jax.random.split(_jax.random.PRNGKey(29), 2)
         client_params = {
@@ -1887,10 +1925,19 @@ def run_autoscale(spec, params, smoke: bool) -> None:
                 keys[1], (spec.hidden_size, VOCAB_EFF), _jnp.float32
             ) * 0.02,
         }
-        primary = _server(rc(), uid="bench_asr")
-        standby = _server(rc(), standby=True, uid="bench_asr")
+        # construct the standby FIRST: BlockServer points the process-wide
+        # persistent-cache config at its artifact dir, and the PRIMARY'S
+        # store must be the one the live compiles land in
+        standby = _server(rc(), standby=True, uid="bench_asr",
+                          artifact_dir=art_b)
+        primary = _server(rc(), uid="bench_asr", artifact_dir=art_a)
         await primary.start()
         await standby.start()
+        if preinstall:
+            # re-trace so this leg's compiles are real events that land in
+            # the primary's store (earlier legs warmed the same shapes
+            # in-memory, which persists nothing)
+            _jax.clear_caches()
         rng = np.random.default_rng(31)
         prompt = rng.integers(0, VOCAB_EFF, size=(1, 8))
         K = 12 if smoke else 24
@@ -1925,21 +1972,36 @@ def run_autoscale(spec, params, smoke: bool) -> None:
             hard_failures = 0
             got = None
             stall_ms = 0.0
+            first_token_ms = 0.0
             try:
                 async with sess:
                     ids1 = await m.generate(
                         prompt, max_new_tokens=K1, session=sess,
                         server_decode=False,
                     )
+                    if preinstall:
+                        await standby.prefetch_artifacts()
                     await primary.stop()
+                    # both variants pay a fresh process's compile bill at
+                    # the promotion boundary; the preinstall variant gets
+                    # to pay it with persistent-cache loads
+                    _jax.clear_caches()
+                    if preinstall:
+                        _artifacts.enable_persistent_cache(art_b)
                     t0 = time.time()
                     ids2 = await m.generate(
-                        ids1[:, -1:], max_new_tokens=K - K1, session=sess,
+                        ids1[:, -1:], max_new_tokens=1, session=sess,
                         server_decode=False,
+                    )
+                    first_token_ms = (time.time() - t0) * 1000.0
+                    ids3 = await m.generate(
+                        ids2[:, -1:], max_new_tokens=K - K1 - 1,
+                        session=sess, server_decode=False,
                     )
                     stall_ms = (time.time() - t0) * 1000.0
                 got = np.concatenate(
-                    [np.asarray(ids1), np.asarray(ids2)[:, 1:]], axis=1
+                    [np.asarray(ids1), np.asarray(ids2)[:, 1:],
+                     np.asarray(ids3)[:, 1:]], axis=1
                 )
             except Exception as e:  # noqa: BLE001
                 hard_failures = 1
@@ -1949,9 +2011,11 @@ def run_autoscale(spec, params, smoke: bool) -> None:
             )
             return {
                 "stall_ms": stall_ms,
+                "first_token_ms": first_token_ms,
                 "token_identical": identical,
                 "hard_failures": hard_failures,
                 "promotions": standby.promotions,
+                "preinstalled": bool(standby._artifacts_preinstalled),
             }
         finally:
             for stopper in (standby.stop, reg.stop):
@@ -1959,14 +2023,42 @@ def run_autoscale(spec, params, smoke: bool) -> None:
                     await asyncio.wait_for(stopper(), timeout=30.0)
                 except Exception:  # noqa: BLE001
                     pass
+            for d in (art_a, art_b):
+                if d:
+                    shutil.rmtree(d, ignore_errors=True)
 
     elastic = asyncio.run(tbt_mode(True))
     static = asyncio.run(tbt_mode(False))
-    recovery = asyncio.run(recovery_leg())
+    # the preinstall leg repoints jax's process-wide persistent-cache
+    # config at throwaway artifact dirs; later phases must not inherit it
+    _cfg = {
+        k: getattr(_jax.config, k)
+        for k in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+            "jax_persistent_cache_enable_xla_caches",
+        )
+    }
+    try:
+        recovery = asyncio.run(recovery_leg(False))
+        recovery_pre = asyncio.run(recovery_leg(True))
+    finally:
+        for k, v in _cfg.items():
+            _jax.config.update(k, v)
+        # the persistent-cache object latches the dir it initialized
+        # with; re-latch against the restored config so later phases
+        # don't write into the deleted artifact tmp dirs
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+
+        _cc.reset_cache()
     RESULTS["autoscale"] = {
         "elastic": elastic,
         "static": static,
         "recovery": recovery,
+        "recovery_preinstall": recovery_pre,
         "heavy_prefill_tokens": HEAVY,
         "tbt_p95_speedup": (
             static["tbt_p95_ms"] / max(elastic["tbt_p95_ms"], 1e-9)
@@ -1977,6 +2069,8 @@ def run_autoscale(spec, params, smoke: bool) -> None:
         and recovery["hard_failures"] == 0
         and recovery["promotions"] >= 1
         and elastic["promotions"] >= 1
+        and recovery_pre["token_identical"]
+        and recovery_pre["hard_failures"] == 0
     )
     phase("autoscale", "ok" if ok else "failed: see autoscale ledger")
     log(
@@ -1989,7 +2083,10 @@ def run_autoscale(spec, params, smoke: bool) -> None:
         f"— {RESULTS['autoscale']['tbt_p95_speedup']:.2f}x; recovery "
         f"stall {recovery['stall_ms']:.0f} ms, token_identical="
         f"{recovery['token_identical']}, hard_failures="
-        f"{recovery['hard_failures']}"
+        f"{recovery['hard_failures']}; promotion-to-first-token "
+        f"cold {recovery['first_token_ms']:.0f} ms vs pre-installed "
+        f"{recovery_pre['first_token_ms']:.0f} ms (preinstalled="
+        f"{recovery_pre['preinstalled']})"
     )
 
 

@@ -250,7 +250,10 @@ def main(argv=None):
                             "xla_compiles",
                             "compile_ms_total",
                             "warmup_compiles",
+                            "warmup_failures",
                             "steady_state_recompiles",
+                            "compile_cache_hits",
+                            "preinstalled_warmup_misses",
                             "host_syncs_hot_path",
                         )
                         if probe.get(k)
@@ -258,6 +261,30 @@ def main(argv=None):
                     if jit:
                         line += "  " + " ".join(
                             f"{k}={v}" for k, v in sorted(jit.items())
+                        )
+                    # compile-artifact counters (BBTPU_ARTIFACT_DIR runs):
+                    # fallback_compiles > 0 means a server abandoned
+                    # pre-installed artifacts and paid local compiles;
+                    # declines/evictions show the store defending itself
+                    art = {
+                        k: probe[k]
+                        for k in (
+                            "artifact_preinstalled",
+                            "artifact_fallback_compiles",
+                            "artifact_gets_served",
+                            "artifact_puts_installed",
+                            "artifact_puts_declined",
+                            "artifact_blobs_fetched",
+                            "artifact_fetch_retries",
+                            "artifact_store_bytes",
+                            "artifact_evictions",
+                            "artifact_store_declined",
+                        )
+                        if probe.get(k)
+                    }
+                    if art:
+                        line += "  " + " ".join(
+                            f"{k}={v}" for k, v in sorted(art.items())
                         )
                     # session lease counters: are leases reaping abandoned
                     # sessions, are clients resuming instead of replaying,

@@ -196,6 +196,13 @@ def main(argv=None):
     parser.add_argument("--warmup-batches", default="1",
                         help="comma-separated batch buckets to pre-compile "
                         "at startup ('' = skip)")
+    parser.add_argument("--artifact-dir", default=None,
+                        help="directory for the swarm-shared compile-"
+                             "artifact store: persistent JAX compilation "
+                             "cache served to peers over artifact_get and "
+                             "pre-fetched from covering peers before "
+                             "warmup compiles anything (default follows "
+                             "BBTPU_ARTIFACT_DIR; unset = no store)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
     logging.basicConfig(level=args.log_level)
@@ -279,6 +286,7 @@ def main(argv=None):
             promote_low_ms=args.promote_low_ms,
             promote_sustain_s=args.promote_sustain_s,
             promote_jitter_s=args.promote_jitter_s,
+            artifact_dir=args.artifact_dir,
         )
         await server.start()
         if args.warmup_batches:

@@ -38,6 +38,7 @@ from bloombee_tpu.kv.cache_manager import (
 )
 from bloombee_tpu.models.spec import ModelSpec
 from bloombee_tpu.runtime.executor import SpanExecutor, plan_prefill_chunks
+from bloombee_tpu.server import artifacts
 from bloombee_tpu.server.compute_queue import (
     PRIORITY_INFERENCE,
     PRIORITY_TRAINING,
@@ -476,6 +477,13 @@ class BlockServer:
         # env; never enable in real serving)
         liar_seed: int | None = None,  # RNG seed for the liar hook
         # (None -> BBTPU_LIAR_SEED env)
+        artifact_dir: str | None = None,  # swarm-shared compile-artifact
+        # store (doubles as this process's JAX persistent compilation
+        # cache dir): serve artifact_get, push artifacts to replication
+        # standbys via artifact_put, and pre-install fetched artifacts
+        # before warmup so a standby/JOINed server loads executables
+        # instead of compiling them (None -> BBTPU_ARTIFACT_DIR env;
+        # empty = artifact path off)
     ):
         self.model_dir = model_dir
         if weight_quant is None:
@@ -856,6 +864,29 @@ class BlockServer:
         self.audit_forwards = 0
         self.liar_steps = 0
         self.seq_hash_extend_failures = 0
+        # zero-cold-start recovery: the swarm-shared compile-artifact
+        # store (server/artifacts.py). Enabling it points JAX's
+        # persistent compilation cache at the store dir, so this server's
+        # own warmup compiles become servable artifacts with no extra
+        # step. warmup_failures counts the per-bucket warmup errors the
+        # warmup loop swallows (each one is a bucket that will compile on
+        # its first real request — previously invisible behind a bare
+        # logger.warning); the artifact_* counters make every install/
+        # decline/fallback on the artifact path operator-visible
+        if artifact_dir is None:
+            artifact_dir = env.get("BBTPU_ARTIFACT_DIR")
+        self.artifact_store: artifacts.ArtifactStore | None = None
+        if artifact_dir and artifacts.enable_persistent_cache(artifact_dir):
+            self.artifact_store = artifacts.ArtifactStore(artifact_dir)
+        self._artifacts_preinstalled = False
+        self._artifact_pushed_standbys: set[tuple[str, int]] = set()
+        self.warmup_failures = 0
+        self.artifact_fallback_compiles = 0
+        self.artifact_gets_served = 0
+        self.artifact_puts_installed = 0
+        self.artifact_puts_declined = 0
+        self.artifact_blobs_fetched = 0
+        self.artifact_fetch_retries = 0
         self._kv_quant = kv_quant
         self._num_pages = num_pages
         self._adapter_dirs = adapter_dirs
@@ -866,6 +897,8 @@ class BlockServer:
                 "rpc_forward": self._rpc_forward,
                 "rpc_backward": self._rpc_backward,
                 "kv_put": self._kv_put,
+                "artifact_get": self._artifact_get,
+                "artifact_put": self._artifact_put,
             },
             stream_handlers={"rpc_inference": self._rpc_inference},
             push_handlers={"rpc_push": self._rpc_push},
@@ -1055,9 +1088,22 @@ class BlockServer:
         the fence drops when the LAST bucket is in, and any dispatch-
         attributed compile after that is a steady-state recompile the
         --require gate fails on. Re-entrant warmups (elastic rebalance,
-        span moves) re-open the warmup phase the same way."""
+        span moves) re-open the warmup phase the same way.
+
+        With an artifact store configured, warmup first pre-installs the
+        span's compile artifacts from covering peers (JOIN-time fetch);
+        when that succeeds, the bucket loop below LOADS executables from
+        the persistent cache instead of compiling them — the
+        zero-cold-start path ``jitwatch --require --preinstalled``
+        gates. Any fetch failure falls back to plain local compile."""
         jitwatch.install()
         jitwatch.set_phase("warmup")
+        if (
+            self.artifact_store is not None
+            and self.registry is not None
+            and not self._artifacts_preinstalled
+        ):
+            await self.prefetch_artifacts()
         try:
             await self._warmup_buckets(batch_sizes, prefill_tokens)
         finally:
@@ -1086,6 +1132,7 @@ class BlockServer:
                     )
                 logger.info("warmed buckets for batch %d", b)
             except Exception as e:
+                self._note_warmup_failure()
                 logger.warning("warmup(batch=%d) failed: %s", b, e)
         budget = self._chunk_budget()
         if budget > 0 and self.executor.sp_mesh is None:
@@ -1115,6 +1162,7 @@ class BlockServer:
                     "tokens)", len(spans), spans[0][1] - spans[0][0],
                 )
             except Exception as e:
+                self._note_warmup_failure()
                 logger.warning("chunk warmup failed: %s", e)
         if self.executor.sp_mesh is not None:
             # pre-compile the sp-prefill program at its smallest bucket:
@@ -1134,6 +1182,7 @@ class BlockServer:
                     )
                 logger.info("warmed sp prefill (%d tokens)", sp_tokens)
             except Exception as e:
+                self._note_warmup_failure()
                 logger.warning("sp warmup failed: %s", e)
         await self._warmup_ragged(prefill_tokens)
 
@@ -1207,7 +1256,17 @@ class BlockServer:
                         "warmed tree ragged buckets (2 trees of %d)", t_i
                     )
         except Exception as e:
+            self._note_warmup_failure()
             logger.warning("ragged warmup failed: %s", e)
+
+    def _note_warmup_failure(self) -> None:
+        """Audit a swallowed per-bucket warmup failure: the fence still
+        drops (partial warmth beats none), but the bucket that failed
+        will compile on its first real request. Counted in rpc_info /
+        health --probe and flagged in the jitwatch report as
+        warmup_degraded so a zero-recompile green can't mask it."""
+        self.warmup_failures += 1
+        jitwatch.note_warmup_failure()
 
     async def _supervisor_loop(self) -> None:
         """Keep the server's background tasks alive and the span balanced.
@@ -1716,6 +1775,10 @@ class BlockServer:
             # integrity-enabled clients verify our replies' out_digest
             # stamps; old clients drop the field (from_wire filtering)
             out_digest=self.integrity,
+            # JOINing servers/standbys fetch compile artifacts from peers
+            # advertising a store; a draining server is about to leave
+            # and must not attract artifact fetch traffic
+            artifacts=self.artifact_store is not None and not self._draining,
         )
 
     async def _announce(self, state: ServerState) -> None:
@@ -1928,6 +1991,32 @@ class BlockServer:
             "audit_forwards": self.audit_forwards,
             "liar_steps": self.liar_steps,
             "seq_hash_extend_failures": self.seq_hash_extend_failures,
+            # warmup/artifact observability: swallowed per-bucket warmup
+            # failures (each is a bucket that compiles on its first real
+            # request), plus the compile-artifact path — blobs served/
+            # installed/fetched, declines, per-peer fetch retries, the
+            # ledgered local-compile fallbacks, and the bounded store's
+            # occupancy/eviction gauges
+            "warmup_failures": self.warmup_failures,
+            "artifact_preinstalled": self._artifacts_preinstalled,
+            "artifact_fallback_compiles": self.artifact_fallback_compiles,
+            "artifact_gets_served": self.artifact_gets_served,
+            "artifact_puts_installed": self.artifact_puts_installed,
+            "artifact_puts_declined": self.artifact_puts_declined,
+            "artifact_blobs_fetched": self.artifact_blobs_fetched,
+            "artifact_fetch_retries": self.artifact_fetch_retries,
+            "artifact_store_bytes": (
+                self.artifact_store.total_bytes()
+                if self.artifact_store is not None else 0
+            ),
+            "artifact_evictions": (
+                self.artifact_store.evictions
+                if self.artifact_store is not None else 0
+            ),
+            "artifact_store_declined": (
+                self.artifact_store.declined
+                if self.artifact_store is not None else 0
+            ),
             # lock-witness observability (BBTPU_LOCKWATCH=1): distinct
             # acquisition-order edges observed in this process and
             # hierarchy violations + cycles; both zero (and harmless)
@@ -2032,6 +2121,19 @@ class BlockServer:
                 "extend_seq_hashes failed (%d so far): %s",
                 self.seq_hash_extend_failures, e,
             )
+        if (
+            self.artifact_store is not None
+            and session.repl_standby not in self._artifact_pushed_standbys
+        ):
+            # one-time per standby: ship the compile-artifact set
+            # alongside the KV pages, so a later promotion warms by
+            # loading executables instead of compiling them
+            self._artifact_pushed_standbys.add(session.repl_standby)
+            push = asyncio.create_task(
+                self._push_artifacts(session.repl_standby)
+            )
+            session.step_tasks.add(push)
+            push.add_done_callback(session.step_tasks.discard)
         task = asyncio.create_task(self._replicate_session(session))
         # step_tasks membership matters: the session loop gathers these
         # before the allocate context frees the pages a sweep is exporting
@@ -2147,6 +2249,262 @@ class BlockServer:
                 if row < len(s.repl_sent):
                     lag += max(0, len(chain) - s.repl_sent[row])
         return lag
+
+    # ---------------------------------------- compile-artifact replication
+    def _artifact_fp(self) -> dict:
+        """This server's artifact-compatibility fingerprint (jax/jaxlib
+        version, backend, topology, model spec hash, span, compute dtype,
+        KV page geometry). Installing past a mismatch could at best be a
+        silent cache miss and at worst a refused deserialize — so both
+        ends check it and decline."""
+        return artifacts.fingerprint(
+            self.spec, self.start_block, self.end_block,
+            name_for_dtype(self.compute_dtype), self.manager.page_size,
+        )
+
+    def _note_artifact_fallback(self, reason: str) -> None:
+        """Every path that abandons pre-installed artifacts funnels here:
+        counted, ledgered (the chaos gate requires the fallback path
+        actually ran when faulted), and loud. The fallback itself is
+        plain local compile — always correct, never a crash."""
+        self.artifact_fallback_compiles += 1
+        ledger.recovery("server.artifact_fallback_compile")
+        logger.warning(
+            "compile-artifact fallback: %s; warmup will compile locally",
+            reason,
+        )
+
+    async def _artifact_get(self, meta: dict, tensors):
+        """Serving side of the swarm-shared compile-artifact cache:
+        {"manifest": True} returns the digest-stamped blob listing plus
+        our fingerprint; {"name": ...} returns one blob (as a uint8
+        tensor). Declines with a reason instead of erroring, mirroring
+        kv_put; the "artifact" meta stamp marks these frames for the
+        chaos harness's artifact-stream fault predicates."""
+        store = self.artifact_store
+        if store is None:
+            return {"artifact": True, "reason": "no artifact store"}, []
+        if self._draining or self._crashed:
+            return {"artifact": True, "reason": "draining"}, []
+        if meta.get("manifest"):
+            self.artifact_gets_served += 1
+            return {
+                "artifact": True,
+                "manifest": store.manifest(),
+                "fp": self._artifact_fp(),
+            }, []
+        name = str(meta.get("name") or "")
+        blob = store.read_blob(name)
+        if blob is None:
+            return {"artifact": True, "reason": f"unknown artifact {name!r}"}, []
+        self.artifact_gets_served += 1
+        return {
+            "artifact": True,
+            "name": name,
+            "digest": artifacts.blob_digest(blob),
+        }, [np.frombuffer(blob, dtype=np.uint8)]
+
+    async def _artifact_put(self, meta: dict, tensors):
+        """Standby side of artifact replication: install one pushed blob
+        into the local store, digest- and fingerprint-checked. Declines
+        (installed=0 + reason) instead of erroring so mixed swarms — and
+        corrupt or incompatible pushes — degrade to local compile."""
+        store = self.artifact_store
+        if store is None:
+            return {
+                "artifact": True, "installed": 0,
+                "reason": "no artifact store",
+            }, []
+        if self._draining:
+            return {"artifact": True, "installed": 0,
+                    "reason": "draining"}, []
+        mismatch = artifacts.fingerprint_compatible(
+            self._artifact_fp(), dict(meta.get("fp") or {})
+        )
+        if mismatch is not None:
+            self.artifact_puts_declined += 1
+            return {
+                "artifact": True, "installed": 0,
+                "reason": f"fingerprint mismatch: {mismatch}",
+            }, []
+        if len(tensors) != 1:
+            return {"artifact": True, "installed": 0,
+                    "reason": "malformed payload"}, []
+        blob = np.asarray(tensors[0], dtype=np.uint8).tobytes()
+        decline = store.install(
+            str(meta.get("name") or ""), blob, str(meta.get("digest") or "")
+        )
+        if decline is not None:
+            self.artifact_puts_declined += 1
+            return {"artifact": True, "installed": 0, "reason": decline}, []
+        self.artifact_puts_installed += 1
+        return {"artifact": True, "installed": 1}, []
+
+    async def prefetch_artifacts(self) -> bool:
+        """JOIN/standby-side fetch: pull this span's compile artifacts
+        from covering ONLINE peers before warmup, so warmup loads
+        executables instead of compiling them. Fault-tolerant by
+        construction: a dead/declining peer retries on the next covering
+        peer with the remaining blob set; a corrupt blob (manifest-digest
+        mismatch) is declined and dropped; ANY shortfall — no peers, no
+        manifest, declined or unfetched blobs — falls back to local
+        compile, ledgered. Only a complete install marks the run
+        pre-installed (a partial install would turn the jitwatch
+        pre-installed gate red on the missing buckets, and rightly so).
+        Never raises."""
+        store = self.artifact_store
+        if store is None or self.registry is None:
+            return False
+        timeout = float(env.get("BBTPU_ARTIFACT_FETCH_TIMEOUT_S"))
+        my_fp = self._artifact_fp()
+        try:
+            infos = await self.registry.get_module_infos(
+                self.model_uid, range(self.start_block, self.end_block)
+            )
+        except Exception as e:
+            self._note_artifact_fallback(
+                f"registry fetch failed: {e.__class__.__name__}"
+            )
+            return False
+        peers: dict[tuple[str, int], None] = {}
+        for info in infos or []:
+            for sid, s in (info.servers if info else {}).items():
+                if (
+                    sid != self.server_id
+                    and getattr(s, "artifacts", False)
+                    and s.state == ServerState.ONLINE
+                    and s.start_block <= self.start_block
+                    and s.end_block >= self.end_block
+                ):
+                    peers.setdefault((str(s.host), int(s.port)))
+        if not peers:
+            self._note_artifact_fallback("no covering peer with artifacts")
+            return False
+        pending: dict[str, str] | None = None  # name -> manifest digest
+        declined = 0
+        installed = 0
+        for i, addr in enumerate(peers):
+            if i:
+                self.artifact_fetch_retries += 1
+            try:
+                conn = await self.peers.get(*addr)
+                reply, _ = await conn.call(
+                    "artifact_get", {"artifact": True, "manifest": True},
+                    [], timeout=timeout,
+                )
+                if not isinstance(reply, dict) or reply.get("reason"):
+                    continue
+                mismatch = artifacts.fingerprint_compatible(
+                    my_fp, dict(reply.get("fp") or {})
+                )
+                if mismatch is not None:
+                    logger.info(
+                        "peer %s:%d artifact fingerprint mismatch (%s); "
+                        "trying next peer", addr[0], addr[1], mismatch,
+                    )
+                    continue
+                if pending is None:
+                    pending = {
+                        str(e["name"]): str(e["digest"])
+                        for e in (reply.get("manifest") or [])
+                        if isinstance(e, dict) and e.get("name")
+                    }
+                for name in list(pending):
+                    r2, blobs = await conn.call(
+                        "artifact_get", {"artifact": True, "name": name},
+                        [], timeout=timeout,
+                    )
+                    if (
+                        not isinstance(r2, dict) or r2.get("reason")
+                        or len(blobs) != 1
+                    ):
+                        declined += 1
+                        pending.pop(name)
+                        continue
+                    blob = np.asarray(blobs[0], dtype=np.uint8).tobytes()
+                    # verify against the MANIFEST digest, not the one
+                    # riding the blob reply: the manifest fetch is the
+                    # trust anchor, so a blob corrupted in flight can't
+                    # vouch for itself
+                    why = store.install(name, blob, pending[name])
+                    if why is not None:
+                        declined += 1
+                        logger.warning(
+                            "artifact %s declined: %s", name, why
+                        )
+                    else:
+                        installed += 1
+                        self.artifact_blobs_fetched += 1
+                    pending.pop(name)
+                if not pending:
+                    break
+            except Exception as e:
+                # peer death mid-fetch: the remaining pending set retries
+                # verbatim on the next covering peer
+                logger.warning(
+                    "artifact fetch from %s:%d failed mid-stream: %s",
+                    addr[0], addr[1], e,
+                )
+                continue
+        if pending is None:
+            self._note_artifact_fallback("no usable manifest from any peer")
+            return False
+        if declined or pending:
+            self._note_artifact_fallback(
+                f"{declined} blob(s) declined, {len(pending)} unfetched"
+            )
+            return False
+        if not installed:
+            self._note_artifact_fallback("peer manifest was empty")
+            return False
+        self._artifacts_preinstalled = True
+        jitwatch.mark_preinstalled()
+        logger.info(
+            "pre-installed %d compile artifact(s); warmup will load, "
+            "not compile", installed,
+        )
+        return True
+
+    async def _push_artifacts(self, standby: tuple[str, int]) -> None:
+        """Primary side: best-effort ship of the artifact store to a
+        replication standby (bounded by _repl_sem so artifact traffic
+        never crowds out live inference, same as KV sweeps). A decline
+        stops the push; any failure just leaves the standby to prefetch
+        at its own next warmup."""
+        store = self.artifact_store
+        if store is None:
+            return
+        fp = self._artifact_fp()
+        try:
+            for entry in store.manifest():
+                blob = store.read_blob(entry["name"])
+                if blob is None:
+                    continue  # evicted mid-push
+                async with self._repl_sem:
+                    conn = await self.peers.get(*standby)
+                    reply, _ = await conn.call(
+                        "artifact_put",
+                        {
+                            "artifact": True,
+                            "name": entry["name"],
+                            "digest": entry["digest"],
+                            "fp": fp,
+                        },
+                        [np.frombuffer(blob, dtype=np.uint8)],
+                        timeout=30.0,
+                    )
+                if not (isinstance(reply, dict) and reply.get("installed")):
+                    logger.info(
+                        "standby %s:%d declined artifact_put (%s); "
+                        "stopping artifact push", standby[0], standby[1],
+                        (reply or {}).get("reason", "?"),
+                    )
+                    return
+        except Exception as e:
+            logger.debug(
+                "artifact push to %s:%d failed: %s", standby[0],
+                standby[1], e,
+            )
 
     async def _rpc_inference(self, stream: Stream) -> None:
         """One decode session. Open meta: {session_id, batch_size, max_length,

@@ -79,6 +79,13 @@ class ServerInfo:
     # drop the field via from_wire filtering and default False, so clients
     # simply skip digest checks against them (audits still work).
     out_digest: bool = False
+    # True when this server serves a compile-artifact store over
+    # artifact_get (swarm-shared persistent compilation cache). JOINing
+    # servers and standbys fetch their span's artifacts from covering
+    # peers advertising this before falling back to local compile. Old
+    # peers drop the field via from_wire filtering and default False, so
+    # mixed swarms simply never trade artifacts.
+    artifacts: bool = False
 
     def to_wire(self) -> dict:
         d = dataclasses.asdict(self)

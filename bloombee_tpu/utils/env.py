@@ -78,6 +78,7 @@ def import_declaring_modules() -> None:
     import bloombee_tpu.models.hub  # noqa: F401
     import bloombee_tpu.runtime.executor  # noqa: F401
     import bloombee_tpu.server.admission  # noqa: F401
+    import bloombee_tpu.server.artifacts  # noqa: F401
     import bloombee_tpu.server.block_selection  # noqa: F401
     import bloombee_tpu.server.block_server  # noqa: F401
     import bloombee_tpu.utils.clock  # noqa: F401

@@ -34,6 +34,19 @@ no warmup fence in any line (the steady window never opened, so "zero
 steady recompiles" is also vacuous), zero warmup compiles (same), or
 ANY steady-state recompile. clock is deliberately NOT imported here
 (the ledger/clock/*watch utility layer stays import-cycle-free).
+
+The witness also understands the persistent compile-artifact cache
+(server/artifacts.py): a cache hit still fires backend_compile_duration,
+but ``/jax/compilation_cache/cache_retrieval_time_sec`` fires first on
+the same thread, so hits are ledgered as ``cached`` loads — they spend
+no warmup budget and never count as steady recompiles. A server that
+pre-installed fetched artifacts calls :func:`mark_preinstalled`; any
+non-cached region-attributed warmup compile after that is a
+``preinstalled_warmup_miss``, and ``--require --preinstalled`` fails on
+any miss (or on zero cache hits — a vacuous pre-install). Swallowed
+per-bucket warmup failures are recorded via :func:`note_warmup_failure`
+and fail plain ``--require`` (``warmup_degraded``), so a zero-recompile
+green can't mask buckets that never warmed.
 """
 
 from __future__ import annotations
@@ -78,6 +91,10 @@ class _Witness:
         self.compile_ms_total = 0.0
         self.warmup_compiles = 0
         self.steady_state_recompiles = 0
+        self.compile_cache_hits = 0
+        self.preinstalled = False
+        self.preinstalled_warmup_misses = 0
+        self.warmup_failures = 0
         self.host_syncs: dict[str, int] = {}
         self.host_syncs_hot_path = 0
         self.phase = "warmup"
@@ -94,7 +111,16 @@ class _Witness:
         return getattr(self._tls, "hot", 0)
 
     # ------------------------------------------------------------ record
+    def note_cache_retrieval(self) -> None:
+        # a persistent-cache hit still fires backend_compile_duration
+        # immediately after cache_retrieval_time_sec on the same thread;
+        # flag the thread so the next record_compile knows the executable
+        # was LOADED, not compiled
+        self._tls.cache_hit = True
+
     def record_compile(self, duration_s: float) -> None:
+        cached = getattr(self._tls, "cache_hit", False)
+        self._tls.cache_hit = False
         regions = self._regions()
         function, shape = regions[-1] if regions else (_UNATTRIBUTED, "")
         ms = float(duration_s) * 1000.0
@@ -102,8 +128,24 @@ class _Witness:
             phase = self.phase
             self.xla_compiles += 1
             self.compile_ms_total += ms
-            if phase == "warmup":
+            if cached:
+                # loaded from the persistent compile-artifact cache: not a
+                # real XLA compile, so it never counts as a steady-state
+                # recompile — but a warmup-phase load still populates its
+                # dispatch bucket, so it satisfies the warmup fence (the
+                # shared chaos-matrix cache can legitimately serve EVERY
+                # warmup bucket; only --preinstalled mode cares whether the
+                # load was a hit, via preinstalled_warmup_misses)
+                self.compile_cache_hits += 1
+                if phase == "warmup":
+                    self.warmup_compiles += 1
+            elif phase == "warmup":
                 self.warmup_compiles += 1
+                if self.preinstalled and function != _UNATTRIBUTED:
+                    # pre-installed artifacts promised this bucket would
+                    # load, not compile — a miss is the cold start the
+                    # artifact path exists to eliminate
+                    self.preinstalled_warmup_misses += 1
             elif function != _UNATTRIBUTED:
                 # only region-attributed compiles gate: the serving path
                 # owns its dispatch buckets, not the client-side jnp work
@@ -115,6 +157,7 @@ class _Witness:
                     "shape": shape,
                     "compile_ms": round(ms, 3),
                     "phase": phase,
+                    "cached": cached,
                 })
 
     def record_host_sync(self, tag: str) -> None:
@@ -123,6 +166,14 @@ class _Witness:
             self.host_syncs[tag] = self.host_syncs.get(tag, 0) + 1
             if hot:
                 self.host_syncs_hot_path += 1
+
+    def note_warmup_failure(self) -> None:
+        with self._mu:
+            self.warmup_failures += 1
+
+    def mark_preinstalled(self) -> None:
+        with self._mu:
+            self.preinstalled = True
 
     # ------------------------------------------------------------- phase
     def set_phase(self, phase: str) -> None:
@@ -143,6 +194,11 @@ class _Witness:
                 "compile_ms_total": round(self.compile_ms_total, 3),
                 "warmup_compiles": self.warmup_compiles,
                 "steady_state_recompiles": self.steady_state_recompiles,
+                "compile_cache_hits": self.compile_cache_hits,
+                "preinstalled": self.preinstalled,
+                "preinstalled_warmup_misses": self.preinstalled_warmup_misses,
+                "warmup_failures": self.warmup_failures,
+                "warmup_degraded": bool(self.warmup_failures),
                 "host_syncs": dict(self.host_syncs),
                 "host_syncs_hot_path": self.host_syncs_hot_path,
                 "fenced": self.fenced,
@@ -155,6 +211,10 @@ class _Witness:
             self.compile_ms_total = 0.0
             self.warmup_compiles = 0
             self.steady_state_recompiles = 0
+            self.compile_cache_hits = 0
+            self.preinstalled = False
+            self.preinstalled_warmup_misses = 0
+            self.warmup_failures = 0
             self.host_syncs.clear()
             self.host_syncs_hot_path = 0
             self.phase = "warmup"
@@ -164,6 +224,7 @@ class _Witness:
         # otherwise misattribute every later compile
         self._regions().clear()
         self._tls.hot = 0
+        self._tls.cache_hit = False
 
 
 _witness = _Witness()
@@ -195,9 +256,17 @@ def install() -> bool:
         return False
 
     def _on_event(event: str, duration_s: float, **kwargs) -> None:
+        if not enabled():
+            return
+        # a persistent-cache hit emits cache_retrieval_time_sec and THEN
+        # backend_compile_duration for the same executable on the same
+        # thread — note the retrieval first so the compile record can
+        # tell a cache load from a true XLA compile
+        if "cache_retrieval" in event:
+            _witness.note_cache_retrieval()
         # one jit call can emit several backend_compile events (aux
         # computations); each is a real XLA compile, ledger them all
-        if "backend_compile" in event and enabled():
+        elif "backend_compile" in event:
             _witness.record_compile(duration_s)
 
     monitoring.register_event_duration_secs_listener(_on_event)
@@ -274,6 +343,22 @@ def fence() -> None:
         _witness.fence()
 
 
+def note_warmup_failure() -> None:
+    """Record one swallowed per-bucket warmup failure: the fence still
+    drops, but the report carries ``warmup_degraded`` so a zero-recompile
+    green can't mask buckets that never warmed."""
+    if enabled():
+        _witness.note_warmup_failure()
+
+
+def mark_preinstalled() -> None:
+    """Declare that compile artifacts were pre-installed before warmup:
+    from here on, any non-cached region-attributed warmup compile is a
+    ``preinstalled_warmup_miss`` and fails ``--require --preinstalled``."""
+    if enabled():
+        _witness.mark_preinstalled()
+
+
 # -------------------------------------------------------------- reporting
 def counters() -> dict:
     """Live counter group for rpc_info / health --probe."""
@@ -283,6 +368,8 @@ def counters() -> dict:
         "compile_ms_total": snap["compile_ms_total"],
         "warmup_compiles": snap["warmup_compiles"],
         "steady_state_recompiles": snap["steady_state_recompiles"],
+        "compile_cache_hits": snap["compile_cache_hits"],
+        "preinstalled_warmup_misses": snap["preinstalled_warmup_misses"],
         "host_syncs_hot_path": snap["host_syncs_hot_path"],
     }
 
@@ -319,6 +406,11 @@ def merge_lines(text: str) -> dict:
         "compile_ms_total": 0.0,
         "warmup_compiles": 0,
         "steady_state_recompiles": 0,
+        "compile_cache_hits": 0,
+        "preinstalled": False,
+        "preinstalled_warmup_misses": 0,
+        "warmup_failures": 0,
+        "warmup_degraded": False,
         "host_syncs": {},
         "host_syncs_hot_path": 0,
         "fenced": False,
@@ -333,7 +425,9 @@ def merge_lines(text: str) -> dict:
             continue
         merged["compiles"].extend(snap.get("compiles") or [])
         for key in ("xla_compiles", "warmup_compiles",
-                    "steady_state_recompiles", "host_syncs_hot_path"):
+                    "steady_state_recompiles", "compile_cache_hits",
+                    "preinstalled_warmup_misses", "warmup_failures",
+                    "host_syncs_hot_path"):
             merged[key] += int(snap.get(key) or 0)
         merged["compile_ms_total"] += float(snap.get("compile_ms_total") or 0)
         for tag, n in (snap.get("host_syncs") or {}).items():
@@ -341,7 +435,11 @@ def merge_lines(text: str) -> dict:
                 merged["host_syncs"].get(tag, 0) + int(n)
             )
         merged["fenced"] = merged["fenced"] or bool(snap.get("fenced"))
+        merged["preinstalled"] = (
+            merged["preinstalled"] or bool(snap.get("preinstalled"))
+        )
     merged["compile_ms_total"] = round(merged["compile_ms_total"], 3)
+    merged["warmup_degraded"] = bool(merged["warmup_failures"])
     return merged
 
 
@@ -357,7 +455,14 @@ def _main(argv=None) -> int:
     ap.add_argument("path")
     ap.add_argument("--require", action="store_true",
                     help="fail (exit 1) on zero compiles, a missing "
-                         "warmup fence, or any steady-state recompile")
+                         "warmup fence, any steady-state recompile, or a "
+                         "degraded warmup (swallowed per-bucket failures)")
+    ap.add_argument("--preinstalled", action="store_true",
+                    help="with --require: expect a pre-installed "
+                         "compile-artifact run — fail unless some process "
+                         "marked itself preinstalled AND loaded >=1 "
+                         "executable from the artifact cache AND showed "
+                         "zero non-cached warmup compiles for its buckets")
     args = ap.parse_args(argv)
     try:
         with open(args.path) as f:
@@ -371,10 +476,14 @@ def _main(argv=None) -> int:
     print(
         f"jitwatch: {merged['xla_compiles']} compile(s) "
         f"({merged['warmup_compiles']} warmup, "
-        f"{merged['steady_state_recompiles']} steady-state), "
+        f"{merged['steady_state_recompiles']} steady-state, "
+        f"{merged['compile_cache_hits']} cache hit(s)), "
         f"{merged['compile_ms_total']:.0f}ms total, "
         f"{merged['host_syncs_hot_path']} hot-path host sync(s), "
-        f"fenced={merged['fenced']}"
+        f"fenced={merged['fenced']}, "
+        f"preinstalled={merged['preinstalled']} "
+        f"(misses={merged['preinstalled_warmup_misses']}), "
+        f"warmup_failures={merged['warmup_failures']}"
     )
     for tag, n in sorted(merged["host_syncs"].items()):
         print(f"  sync {tag} x{n}")
@@ -391,7 +500,43 @@ def _main(argv=None) -> int:
                 "nothing", file=sys.stderr,
             )
             return 1
-        if not merged["fenced"] or not merged["warmup_compiles"]:
+        if args.preinstalled:
+            # pre-installed mode: warmup may legitimately compile NOTHING
+            # (everything loads from the artifact cache), so the vacuity
+            # proof shifts from warmup compiles to cache hits
+            if not merged["preinstalled"]:
+                print(
+                    "jitwatch: NOT PREINSTALLED — no process marked "
+                    "compile artifacts as pre-installed, so the "
+                    "zero-cold-start claim was never put to the test",
+                    file=sys.stderr,
+                )
+                return 1
+            if not merged["compile_cache_hits"]:
+                print(
+                    "jitwatch: NO CACHE HITS — a pre-installed run loaded "
+                    "zero executables from the artifact cache; the "
+                    "artifacts installed were never exercised",
+                    file=sys.stderr,
+                )
+                return 1
+            if not merged["fenced"]:
+                print(
+                    "jitwatch: NO WARMUP FENCE — the pre-installed run "
+                    "never fenced, so its steady window never opened",
+                    file=sys.stderr,
+                )
+                return 1
+            if merged["preinstalled_warmup_misses"]:
+                print(
+                    "jitwatch: preinstalled warmup miss(es) — a promoted "
+                    "replica with pre-installed artifacts still compiled "
+                    "during warmup; the artifact for that (function, "
+                    "bucket) was missing, stale, or declined",
+                    file=sys.stderr,
+                )
+                return 1
+        elif not merged["fenced"] or not merged["warmup_compiles"]:
             print(
                 "jitwatch: NO WARMUP FENCE — no process dropped the "
                 "warmup fence after >=1 warmup compile, so the "
@@ -405,6 +550,15 @@ def _main(argv=None) -> int:
                 "bucket escaped BlockServer.warmup or a shape escaped its "
                 "pow2 bucketer (BB012); the ledger above names the "
                 "(function, shape) to pre-compile", file=sys.stderr,
+            )
+            return 1
+        if merged["warmup_degraded"]:
+            print(
+                "jitwatch: DEGRADED WARMUP — per-bucket warmup failures "
+                "were swallowed (warmup_failures="
+                f"{merged['warmup_failures']}); the fence dropped over "
+                "buckets that never warmed, so this green is hollow",
+                file=sys.stderr,
             )
             return 1
     return 0

@@ -26,7 +26,10 @@ recoveries
   ``server.lease_park`` / ``server.lease_reap`` — disconnected session
   parked / force-expired; ``server.promotion`` — standby promoted to
   serving; ``server.rebalance_reannounce`` — measured-load rebalance
-  re-announced a new span.
+  re-announced a new span; ``server.artifact_fallback_compile`` — the
+  compile-artifact path (corrupt/declined/unfetchable blobs, fingerprint
+  mismatch, peer death mid-fetch, no covering peer) fell back to local
+  compile instead of pre-installing.
 
 With no ledger path configured the counters still accumulate in memory
 (tests read ``snapshot()`` directly) and nothing is written.
@@ -135,6 +138,12 @@ def _main(argv=None) -> int:
     ap.add_argument("path")
     ap.add_argument("--require", action="store_true",
                     help="fail (exit 1) on an empty half of the ledger")
+    ap.add_argument("--require-recovery", action="append", default=[],
+                    metavar="NAME",
+                    help="with --require: additionally fail unless this "
+                         "named recovery point fired at least once "
+                         "(repeatable) — pins a chaos entry to the exact "
+                         "degraded path it exists to exercise")
     args = ap.parse_args(argv)
     try:
         with open(args.path) as f:
@@ -157,6 +166,18 @@ def _main(argv=None) -> int:
             "vacuous green", file=sys.stderr,
         )
         return 1
+    if args.require:
+        missing = [
+            name for name in args.require_recovery
+            if not merged["recoveries"].get(name)
+        ]
+        if missing:
+            print(
+                f"ledger: required recovery point(s) never fired: "
+                f"{', '.join(missing)} — the degraded path this entry "
+                "exists to exercise did not run", file=sys.stderr,
+            )
+            return 1
     return 0
 
 

@@ -400,10 +400,13 @@ class FaultPlan:
         """Byzantine payload corruption: decode the first float tensor in
         the frame, perturb it with a seeded pick of NaN-poison / ×64 scale
         / exponent bit-flip, and re-serialize. The frame stays well-formed
-        (valid header, codec, sizes) — only the numbers lie. Non-float or
-        tensor-less frames are left untouched (corrupting int token ids
-        would be undetectable by activation checks and is a different
-        failure class)."""
+        (valid header, codec, sizes) — only the numbers lie. Non-float
+        frames are left untouched (corrupting int token ids would be
+        undetectable by activation checks and is a different failure
+        class) — EXCEPT compile-artifact transfers, whose raw uint8
+        blobs get a single bit flipped: the blake2b digest check on
+        install must convict it, exactly like a corrupt span output must
+        be convicted by the integrity layer."""
         tms = header.get("tm") or []
         if not tms or not blobs:
             return
@@ -417,21 +420,28 @@ class FaultPlan:
         is_float = np.issubdtype(np.dtype(arr.dtype), np.floating) or (
             np.dtype(arr.dtype) == np.dtype(ml_dtypes.bfloat16)
         )
-        if arr.size == 0 or not is_float:
+        if arr.size == 0:
             return
-        mode = ("nan", "scale", "bitflip")[self.rng.randrange(3)]
         flat = arr.reshape(-1)
         idx = self.rng.randrange(flat.size)
-        if mode == "nan":
-            flat[idx] = float("nan")
-        elif mode == "scale":
-            np.multiply(arr, arr.dtype.type(64), out=arr)
+        if not is_float:
+            if not _is_artifact_transfer(header):
+                return
+            flat.view(np.uint8)[
+                idx * arr.dtype.itemsize
+            ] ^= 0x40
         else:
-            # flip the top exponent bit of one element via its raw bytes —
-            # the classic single-bit memory fault
-            view = flat.view(np.uint8)
-            byte = idx * arr.dtype.itemsize + (arr.dtype.itemsize - 1)
-            view[byte] ^= 0x40
+            mode = ("nan", "scale", "bitflip")[self.rng.randrange(3)]
+            if mode == "nan":
+                flat[idx] = float("nan")
+            elif mode == "scale":
+                np.multiply(arr, arr.dtype.type(64), out=arr)
+            else:
+                # flip the top exponent bit of one element via its raw
+                # bytes — the classic single-bit memory fault
+                view = flat.view(np.uint8)
+                byte = idx * arr.dtype.itemsize + (arr.dtype.itemsize - 1)
+                view[byte] ^= 0x40
         m, b = tensor_codec.serialize_tensor(arr, compression=True)
         tms[0] = m.to_wire()
         blobs[0] = b
@@ -507,6 +517,16 @@ def _is_span_output_reply(header: dict) -> bool:
     stamp t_compute_ms into their meta; acks and client-side frames don't)."""
     meta = header.get("meta") or {}
     return bool(header.get("tm")) and "t_compute_ms" in meta
+
+
+def _is_artifact_transfer(header: dict) -> bool:
+    """True for compile-artifact frames (artifact_get/put requests and
+    their blob-carrying replies — both stamp "artifact" into their meta,
+    since unary "res" frames carry no method name to match on). Chaos
+    rules use this to corrupt/stall/kill the artifact stream without
+    touching the inference path."""
+    meta = header.get("meta") or {}
+    return bool(meta.get("artifact"))
 
 
 _active_plan: FaultPlan | None = None

@@ -38,6 +38,14 @@
 #                steady-state recompiles (a decode bucket that escaped
 #                BlockServer.warmup is a first-token compile stall some
 #                session actually paid)
+#   ARTIFACT     1 = compile-artifact cache entry: strengthens both gates.
+#                The ledger gate additionally requires the
+#                server.artifact_fallback_compile recovery point (the
+#                corrupt/declined-artifact fallback path must actually
+#                run), and the jitwatch gate runs in --preinstalled mode
+#                (a pre-installed standby must warm up entirely from
+#                persistent-cache hits — any real warmup compile for a
+#                pre-installed bucket is a red)
 #   TESTS        comma-separated test-file list for this entry (default:
 #                the whole chaos-marked suite). Feature entries target the
 #                files that actually exercise their flags — the per-entry
@@ -77,14 +85,15 @@ MATRIX=(
     "SEED=83 DELAY_P=0.05 ADMIT=1 REBALANCE=1 TESTS=tests/test_chaos.py,tests/test_promotion.py,tests/test_kv_replication.py,tests/test_prefix_cache.py"
     "SEED=97 DELAY_P=0.02 CORRUPT=0.05 TESTS=tests/test_chaos.py,tests/test_session_lease.py,tests/test_kv_replication.py"
     "SEED=31 DELAY_P=0.02 JITWATCH=1 TESTS=tests/test_jitwatch.py,tests/test_chaos.py"
+    "SEED=71 DELAY_P=0.02 ARTIFACT=1 JITWATCH=1 TESTS=tests/test_artifact_cache.py"
 )
 for entry in "${MATRIX[@]}"; do
     # per-entry defaults; each entry overrides only what it varies
     SEED=0 DELAY_P=0 ADMIT=0 PARTITION_P=0 MIXED=0 SPEC=0 REBALANCE=0
-    CORRUPT=0 LOCKWATCH=0 JITWATCH=0 TESTS=tests/
+    CORRUPT=0 LOCKWATCH=0 JITWATCH=0 ARTIFACT=0 TESTS=tests/
     for tok in ${entry}; do
         case "${tok%%=*}" in
-            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|LOCKWATCH|JITWATCH|TESTS)
+            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|LOCKWATCH|JITWATCH|ARTIFACT|TESTS)
                 declare "${tok}" ;;
             *)
                 echo "chaos: unknown matrix token '${tok}'" >&2
@@ -154,9 +163,19 @@ BBTPU_JITWATCH=${JITWATCH}"
         JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0.5 \
         python -m pytest ${test_targets} -q -m chaos \
         -p no:cacheprovider -p no:xdist -p no:randomly "$@" || rc=$?
+    # the ARTIFACT entry pins both gates to the artifact paths it exists
+    # to exercise: the corrupt/declined fallback must have LEDGERED, and
+    # the pre-installed standby must have warmed up from cache hits alone
+    artifact_ledger_args=""
+    artifact_jitwatch_args=""
+    if [ "${ARTIFACT}" != "0" ]; then
+        artifact_ledger_args="--require-recovery \
+server.artifact_fallback_compile"
+        artifact_jitwatch_args="--preinstalled"
+    fi
     if [ "${rc}" -eq 0 ]; then
         python -m bloombee_tpu.utils.ledger "${ledger_file}" --require \
-            >&2 || rc=$?
+            ${artifact_ledger_args} >&2 || rc=$?
     fi
     if [ "${rc}" -eq 0 ] && [ "${LOCKWATCH}" != "0" ]; then
         python -m bloombee_tpu.utils.lockwatch "${lockwatch_file}" \
@@ -164,7 +183,7 @@ BBTPU_JITWATCH=${JITWATCH}"
     fi
     if [ "${rc}" -eq 0 ] && [ "${JITWATCH}" != "0" ]; then
         python -m bloombee_tpu.utils.jitwatch "${jitwatch_file}" \
-            --require >&2 || rc=$?
+            --require ${artifact_jitwatch_args} >&2 || rc=$?
     fi
     elapsed=$(( SECONDS - entry_start ))
     if [ "${rc}" -ne 0 ]; then

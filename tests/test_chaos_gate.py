@@ -26,7 +26,8 @@ def test_matrix_entries_are_keyval_tokens():
     assert len(entries) >= 5, f"matrix lost entries: {entries}"
     known = {
         "SEED", "DELAY_P", "ADMIT", "PARTITION_P", "MIXED", "SPEC",
-        "REBALANCE", "CORRUPT", "LOCKWATCH", "JITWATCH", "TESTS",
+        "REBALANCE", "CORRUPT", "LOCKWATCH", "JITWATCH", "ARTIFACT",
+        "TESTS",
     }
     for entry in entries:
         for tok in entry.split():
@@ -111,6 +112,35 @@ def test_gate_requires_nonvacuous_jitwatch():
     ) or re.search(
         r"python -m bloombee_tpu\.utils\.jitwatch .*--require", src
     ), "gate never checks the compile-witness report with --require"
+
+
+def test_gate_pins_artifact_entry():
+    """The compile-artifact entry must exist and be held to BOTH
+    strengthened gates: the merged ledger must show the
+    server.artifact_fallback_compile recovery point (the corrupt/declined
+    fallback path actually ran, not just clean pre-install), and the
+    compile witness must pass --preinstalled mode (the pre-installed
+    standby warmed up from persistent-cache hits alone — any real warmup
+    compile for a pre-installed bucket is a red)."""
+    src = (REPO / "scripts" / "chaos.sh").read_text()
+    entries = re.findall(r'^\s+"([^"]+)"$', src, flags=re.M)
+    artifact = [e for e in entries if "ARTIFACT=1" in e]
+    assert artifact, "no compile-artifact entry in the chaos matrix"
+    # the jitwatch --preinstalled gate needs the witness on in the same
+    # entry, or there is no report to strengthen
+    assert all("JITWATCH=1" in e for e in artifact), (
+        "ARTIFACT entry runs without the compile witness"
+    )
+    assert "--require-recovery" in src and (
+        "server.artifact_fallback_compile" in src
+    ), "ARTIFACT entry is not pinned to the fallback-compile recovery"
+    assert "--preinstalled" in src, (
+        "ARTIFACT entry never strengthens the jitwatch gate to "
+        "--preinstalled mode"
+    )
+    assert 'artifact_jitwatch_args="--preinstalled"' in src, (
+        "--preinstalled is not derived from the ARTIFACT key"
+    )
 
 
 def test_red_entry_prints_full_reproduction_line():
