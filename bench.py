@@ -233,6 +233,28 @@ def _emit_json_locked():
             2,
         )
         out["tbt_p95_mixed_ms"] = round(mx.get("tbt_p95_ms", 0.0), 1)
+        # universal ragged dispatch: the same contention plus a
+        # speculative stream — decode + tree-verify + chunk rows in ONE
+        # device step vs the mixed-only baseline where tree rounds
+        # dispatch solo
+        uni = itf.get("universal") or {}
+        ub = itf.get("universal_baseline") or {}
+        if uni:
+            out["dispatches_per_token_universal"] = round(
+                uni.get("dispatches_per_token", 0.0), 4
+            )
+            out["dispatches_per_token_universal_baseline"] = round(
+                ub.get("dispatches_per_token", 0.0), 4
+            )
+            out["universal_dispatches_per_token_reduction"] = round(
+                itf.get("universal_dispatches_per_token_reduction", 0.0), 2
+            )
+            out["tbt_p95_universal_ms"] = round(
+                uni.get("tbt_p95_ms", 0.0), 1
+            )
+            out["ragged_cross_kind_dispatches"] = int(
+                uni.get("ragged_cross_kind_dispatches", 0)
+            )
     msb = RESULTS.get("multisession_batched")
     if msb:
         # continuous batching: aggregate throughput + how wide the merged
@@ -1172,7 +1194,19 @@ def run_interference(spec, params, smoke: bool) -> None:
     PROMPT = 2 * PAGE  # the decoders' own short prompts
     VOCAB_EFF = min(1024, spec.vocab_size)
 
-    async def one_mode(chunk: int, mixed: bool = False) -> dict:
+    async def one_mode(
+        chunk: int, mixed: bool = False, spec_batch: bool = False,
+        spec_traffic=None, window_ms=None,
+    ) -> dict:
+        # spec_traffic: a bind(rc) -> async-generate callable for the
+        # universal modes' concurrent speculative stream; window_ms
+        # pins the gather window so the universal/baseline pair differ
+        # ONLY in fusion scope
+        old_window = os.environ.get(  # bbtpu: noqa[BB005]
+            "BBTPU_BATCH_WINDOW_MS"
+        )
+        if window_ms is not None:
+            os.environ["BBTPU_BATCH_WINDOW_MS"] = window_ms
         reg = RegistryServer(host="127.0.0.1")
         await reg.start()
 
@@ -1183,9 +1217,11 @@ def run_interference(spec, params, smoke: bool) -> None:
             model_uid="bench_itf", start=0, end=span_layers, params=params,
             spec=spec, registry=rc(),
             num_pages=max(256, 2 * (LONG // PAGE) + 64), page_size=PAGE,
-            max_batch=N_DEC, prefill_chunk=chunk, mixed_batch=mixed,
+            max_batch=N_DEC + 1, prefill_chunk=chunk, mixed_batch=mixed,
+            spec_batch=spec_batch,
         )
         await server.start()
+        gen_spec = spec_traffic(rc) if spec_traffic else None
         manager = RemoteSequenceManager(rc(), "bench_itf", span_layers)
         rng = np.random.default_rng(13)
         embed_table = (
@@ -1225,8 +1261,14 @@ def run_interference(spec, params, smoke: bool) -> None:
                 # the TBT percentiles
                 await asyncio.gather(*(one_token(s) for s in decs))
 
+            if gen_spec is not None:
+                # compile the drafter + tree-verify buckets off the
+                # measured path, exactly like the decode warm rounds
+                await gen_spec()
+
             gaps: list[float] = []
             prefill_done = asyncio.Event()
+            spec_rounds = 0
 
             async def decode_loop(s):
                 # keep decoding while the long prefill is in flight; a
@@ -1236,6 +1278,17 @@ def run_interference(spec, params, smoke: bool) -> None:
                     await one_token(s)
                     gaps.append((time.perf_counter() - t0) * 1000.0)
 
+            async def spec_loop():
+                # concurrent speculative stream: at least one full
+                # generation (smoke prefills can finish before a round
+                # does), then keep speculating until the prefill lands
+                nonlocal spec_rounds
+                while True:
+                    await gen_spec()
+                    spec_rounds += 1
+                    if prefill_done.is_set():
+                        break
+
             async def measured_prefill():
                 try:
                     return await long_prefill_once()
@@ -1243,7 +1296,8 @@ def run_interference(spec, params, smoke: bool) -> None:
                     prefill_done.set()
 
             results = await asyncio.gather(
-                measured_prefill(), *(decode_loop(s) for s in decs)
+                measured_prefill(), *(decode_loop(s) for s in decs),
+                *([spec_loop()] if gen_spec is not None else []),
             )
             ttft_ms = results[0]
             waits = server.compute.wait_stats_ms()
@@ -1265,8 +1319,23 @@ def run_interference(spec, params, smoke: bool) -> None:
                 ),
                 "mixed_dispatches": server.mixed_dispatches,
                 "mixed_tokens": server.mixed_tokens,
+                "tree_group_dispatches": server.tree_group_dispatches,
+                "ragged_group_dispatches": server.ragged_group_dispatches,
+                "ragged_cross_kind_dispatches": (
+                    server.ragged_cross_kind_dispatches
+                ),
+                "spec_rounds": spec_rounds,
             }
         finally:
+            if window_ms is not None:
+                if old_window is None:
+                    os.environ.pop(  # bbtpu: noqa[BB005]
+                        "BBTPU_BATCH_WINDOW_MS", None
+                    )
+                else:
+                    os.environ[  # bbtpu: noqa[BB005]
+                        "BBTPU_BATCH_WINDOW_MS"
+                    ] = old_window
             for s in decs:
                 try:
                     await s.__aexit__(None, None, None)
@@ -1278,16 +1347,81 @@ def run_interference(spec, params, smoke: bool) -> None:
                 except Exception:  # noqa: BLE001
                     pass
 
+    def make_spec_binder():
+        # client head + self-drafter for the universal modes' concurrent
+        # speculative stream (run_spec_decode idiom, sized to VOCAB_EFF)
+        import jax.numpy as jnp
+
+        from bloombee_tpu.client.model import DistributedModelForCausalLM
+        from bloombee_tpu.client.speculative import generate_speculative
+        from bloombee_tpu.spec.drafter import (
+            GreedyTreeDrafter,
+            LocalJaxDraftModel,
+        )
+        from bloombee_tpu.utils.tree import unstack_params
+
+        srng = np.random.default_rng(41)
+        client_params = {
+            "embed": jnp.asarray(
+                srng.standard_normal((VOCAB_EFF, spec.hidden_size)) * 0.02,
+                jnp.float32,
+            ),
+            "norm": jnp.ones((spec.hidden_size,), jnp.float32),
+            "lm_head": jnp.asarray(
+                srng.standard_normal((spec.hidden_size, VOCAB_EFF)) * 0.02,
+                jnp.float32,
+            ),
+        }
+        draft_model = LocalJaxDraftModel(
+            spec, unstack_params(params, span_layers), client_params
+        )
+        prompt = srng.integers(0, VOCAB_EFF, size=(1, 8))
+        n_new = 4 if smoke else 8
+
+        def bind(rc):
+            model = DistributedModelForCausalLM(
+                spec, client_params,
+                RemoteSequenceManager(rc(), "bench_itf", span_layers),
+            )
+
+            async def gen():
+                await generate_speculative(
+                    model,
+                    GreedyTreeDrafter(draft_model, branching=(2, 1)),
+                    prompt, max_new_tokens=n_new,
+                )
+
+            return gen
+
+        return bind
+
     chunked = asyncio.run(one_mode(CHUNK))
     mono = asyncio.run(one_mode(0))
     # third mode: chunked prefill + mixed-batch dispatch (ISSUE 8) — the
     # waiting decode steps ride inside the prefill chunk's dispatch, so
     # dispatches_per_token drops below the interleaved-but-separate value
     mixed = asyncio.run(one_mode(CHUNK, mixed=True))
+    # universal mode (ISSUE 17): the SAME contended scenario plus a
+    # concurrent speculative-decode stream — first mixed-only (the PR-8
+    # baseline: tree-verify rounds dispatch solo next to the fused
+    # decode+chunk steps), then with the universal ragged path (decode +
+    # tree + chunk rows share ONE device step). Identical traffic and
+    # gather window; only the fusion scope differs, so the
+    # dispatches_per_token delta isolates the unified dispatch
+    spec_binder = make_spec_binder()
+    uni_base = asyncio.run(one_mode(
+        CHUNK, mixed=True, spec_traffic=spec_binder, window_ms="8",
+    ))
+    universal = asyncio.run(one_mode(
+        CHUNK, mixed=True, spec_batch=True, spec_traffic=spec_binder,
+        window_ms="8",
+    ))
     RESULTS["interference"] = {
         "chunked": chunked,
         "monolithic": mono,
         "mixed": mixed,
+        "universal_baseline": uni_base,
+        "universal": universal,
         "chunk": CHUNK,
         "long_tokens": LONG,
         "tbt_p95_speedup": (
@@ -1296,6 +1430,10 @@ def run_interference(spec, params, smoke: bool) -> None:
         "dispatches_per_token_reduction": (
             chunked["dispatches_per_token"]
             / max(mixed["dispatches_per_token"], 1e-9)
+        ),
+        "universal_dispatches_per_token_reduction": (
+            uni_base["dispatches_per_token"]
+            / max(universal["dispatches_per_token"], 1e-9)
         ),
     }
     phase("interference", "ok")
@@ -1316,6 +1454,16 @@ def run_interference(spec, params, smoke: bool) -> None:
         f"{chunked['dispatches_per_token']:.4f} — "
         f"{RESULTS['interference']['dispatches_per_token_reduction']:.2f}x "
         f"fewer; mixed TBT p95 {mixed['tbt_p95_ms']:.1f} ms"
+    )
+    log(
+        f"universal ragged dispatch (+spec stream, {universal['spec_rounds']}"
+        f" rounds): {universal['dispatches_per_token']:.4f} dispatches/token"
+        f" ({universal['ragged_cross_kind_dispatches']} cross-kind of "
+        f"{universal['ragged_group_dispatches']} ragged dispatches) vs "
+        f"mixed-only {uni_base['dispatches_per_token']:.4f} — "
+        f"{RESULTS['interference']['universal_dispatches_per_token_reduction']:.2f}x "
+        f"fewer; universal TBT p95 {universal['tbt_p95_ms']:.1f} ms vs "
+        f"{uni_base['tbt_p95_ms']:.1f} ms"
     )
 
 

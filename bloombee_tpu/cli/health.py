@@ -185,6 +185,24 @@ def main(argv=None):
                         width = probe.get("mean_tree_batch_width")
                         if width:
                             line += f"  mean_tree_batch_width={width:.2f}"
+                    # universal ragged dispatch: fused dispatches, how
+                    # many crossed row kinds, and any per-reason declines
+                    # (an operator asked for fusing on a span that can't)
+                    ragged = {
+                        k: probe[k]
+                        for k in (
+                            "ragged_group_dispatches",
+                            "ragged_cross_kind_dispatches",
+                        )
+                        if probe.get(k)
+                    }
+                    if ragged:
+                        line += "  " + " ".join(
+                            f"{k}={v}" for k, v in sorted(ragged.items())
+                        )
+                    declines = probe.get("ragged_declines") or {}
+                    for reason, n in sorted(declines.items()):
+                        line += f"  ragged_decline[{reason}]={n}"
                     # elastic self-healing counters: standby promotions /
                     # drain-backs and measured-load rebalance outcomes —
                     # the control loop's every decision, probeable without

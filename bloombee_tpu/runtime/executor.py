@@ -480,32 +480,47 @@ class SpanExecutor:
 
         Returns (out, combined_handle): `out` is the lazy [sum(b_i), 1, D]
         device result (slice rows per member, fetch off-queue), and the
-        combined handle is what the caller commits or rolls back."""
-        combined = self.manager.combine_handles(handles)
-        hidden = np.concatenate(hiddens, axis=0)
-        # recovery owner: the caller (block_server._dispatch_batched)
-        # commits/rolls back the combined handle around this dispatch
-        out = self._step(  # bbtpu: noqa[BB001]
-            combined, hidden, commit=False, layers=layers, fetch=False,
-            adapter=adapter,
-        )
-        return out, combined
+        combined handle is what the caller commits or rolls back.
 
-    def mixed_unsupported(self) -> str | None:
-        """Why this executor can't run ragged mixed-batch dispatches; None
+        Thin delegation onto `ragged_group`, whose pure-decode fast path
+        runs exactly this packed dispatch; the [R, D] -> [R, 1, D] reshape
+        back to the historical contract is a lazy view."""
+        out, combined = self.ragged_group(
+            handles, hiddens, layers=layers, adapter=adapter
+        )
+        return out[:, None, :], combined
+
+    def ragged_unsupported(self, has_tree: bool = False) -> str | None:
+        """Why this executor can't run the universal ragged dispatch; None
         when it can. These configs have their own step machinery (offload
-        layer chain, hetero span, sharded span, decode-only top-k) that the
-        ragged path doesn't replicate — the server falls back to separate
-        dispatches, byte-for-byte the mixed-off behavior."""
-        if self.mesh is not None:
-            return "tensor-parallel mesh"
+        layer chain, hetero span, decode-only top-k) that the ragged path
+        doesn't replicate — the server falls back to separate dispatches,
+        byte-for-byte the flags-off behavior. TP-mesh spans are SUPPORTED:
+        the payload replicates over the mesh and GSPMD shards the dense
+        attend_ragged over heads, exactly like the packed step (the Pallas
+        ragged kernel stays single-chip-only via the use_kernel gate).
+        Tree rows additionally exclude sliding-window layers: the ragged
+        tree mask replaces causality outright, and window clipping against
+        depth-positioned tree tokens only exists on the solo dense path."""
         if self.host_layers:
             return "weight offload"
         if self.spec.heterogeneous:
             return "heterogeneous span"
         if self.attn_sparsity < 1.0:
             return "sparse (top-k) attention"
+        if has_tree and any(w > 0 for w in self.windows):
+            return "sliding-window layers"
         return None
+
+    def mixed_unsupported(self) -> str | None:
+        """PR-8 surface: why causal (decode + chunk) ragged dispatch is
+        unavailable. Thin delegation onto the unified gate."""
+        return self.ragged_unsupported(has_tree=False)
+
+    def tree_group_unsupported(self) -> str | None:
+        """PR-10 surface: why tree-verify rows can't join a ragged
+        dispatch. Thin delegation onto the unified gate."""
+        return self.ragged_unsupported(has_tree=True)
 
     def mixed_group(
         self,
@@ -514,164 +529,16 @@ class SpanExecutor:
         layers: tuple[int, int] | None = None,
         adapter: str | None = None,
     ):
-        """Ragged generalization of decode_group: members contribute
-        DIFFERENT token counts (N single-token decodes plus one multi-token
-        prefill chunk) and all of them run as ONE span dispatch — the
-        Sarathi-Serve fused iteration. Tokens pack row-major into one pow2
-        bucket [1, R, D]; per-token (q_seq, q_pos) carry the member
-        structure into the ragged kernel (dense attend_ragged for
-        kernel-ineligible configs: ALiBi, soft-caps, quantized arenas).
-
-        KV writes are SPECULATIVE for every member: the caller commits
-        decode handles (and the chunk's on its last chunk) only after the
-        dispatch succeeds, and on failure rolls decodes back /
-        truncate_speculative's the chunk to its pre-dispatch length before
-        replaying members solo.
-
-        Returns (out, combined_handle): `out` is the lazy [R, D] device
-        result in member-major token order (slice rows per member, fetch
-        off-queue)."""
+        """Causal ragged dispatch (N single-token decodes plus one
+        multi-token prefill chunk — the Sarathi-Serve fused iteration).
+        Thin delegation onto `ragged_group`; kept as the PR-8 call
+        surface."""
         reason = self.mixed_unsupported()
         if reason is not None:
             raise ValueError(f"mixed_group unsupported: {reason}")
-        spec = self.spec
-        from bloombee_tpu.models.checkpoint import resolve_adapter
-
-        lora = resolve_adapter(self.adapters, adapter)
-        combined = self.manager.combine_handles(handles)
-        self.manager.ensure_resident(combined)
-
-        d = spec.hidden_size
-        counts: list[int] = []
-        row_blocks = []
-        for hid in hiddens:
-            b_i, t_i, d_i = hid.shape
-            assert d_i == d
-            counts.extend([t_i] * b_i)
-            row_blocks.append(hid.reshape(b_i * t_i, d))
-        n_seqs = len(counts)
-        r = sum(counts)
-
-        starts = self.manager.context_lens(combined)  # [B] before write
-        # recovery owner: block_server._dispatch_mixed rolls decodes back
-        # and truncate_speculative's the chunk if this dispatch fails
-        slots = self.manager.write_slots_ragged(  # bbtpu: noqa[BB001]
-            combined, counts, commit=False
-        )  # [R]
-        total_lens = self.manager.context_lens(combined)  # [B] after write
-
-        rb = next_pow2(r)
-        sb = next_pow2(n_seqs)
-        arena_tokens = self.manager.capacity_tokens
-        pages_needed = int(
-            max(-(-int(l) // self.page_size) for l in total_lens)
+        return self.ragged_group(
+            handles, hiddens, layers=layers, adapter=adapter
         )
-        pb = min(
-            next_pow2(max(pages_needed, 1), floor=4),
-            arena_tokens // self.page_size,
-        )
-        oob = arena_tokens  # out-of-bounds slot => dropped write
-
-        h_pad = np.zeros((1, rb, d), dtype=self.transfer_dtype)
-        h_pad[0, :r] = np.concatenate(row_blocks, axis=0).astype(
-            self.transfer_dtype
-        )
-        slots_pad = np.full((rb,), oob, dtype=np.int32)
-        slots_pad[:r] = slots
-        positions = np.zeros((1, rb), dtype=np.int32)
-        # padding rows own no sequence (q_seq >= B): fully masked in the
-        # kernel, sliced away with the pad rows
-        q_seq = np.full((rb,), sb, dtype=np.int32)
-        off = 0
-        for s_i, n in enumerate(counts):
-            positions[0, off : off + n] = starts[s_i] + np.arange(
-                n, dtype=np.int32
-            )
-            q_seq[off : off + n] = s_i
-            off += n
-        pt_pad = np.zeros((sb, pb), dtype=np.int32)
-        pt_pad[:n_seqs] = self.manager.page_table(combined, pb)
-        lens_pad = np.zeros((sb,), dtype=np.int32)
-        lens_pad[:n_seqs] = total_lens
-        num_layers = self.manager.num_layers
-        layer_active = np.ones((num_layers,), dtype=np.int32)
-        if layers is not None:
-            layer_active[:] = 0
-            layer_active[layers[0] : layers[1]] = 1
-        plan = pack_ragged_plan(
-            slots_pad, pt_pad, positions, lens_pad, q_seq, layer_active
-        )
-
-        # ragged-kernel eligibility mirrors _step's chunk gate: dense
-        # arena, [R*H, hd] VMEM budget, contexts past the paged crossover.
-        # Ineligible configs run attend_ragged — still ONE dispatch.
-        use_kernel = bool(
-            not getattr(self, "_paged_broken", False)
-            and self.manager.quant is None
-            and rb * spec.num_attention_heads <= 2048
-            and pb * self.page_size >= env.get("BBTPU_PAGED_MIN_CONTEXT")
-            and not spec.alibi
-            and not spec.attn_logit_softcap
-            and env.get("BBTPU_PAGED_ATTENTION")
-            and (
-                jax.default_backend() == "tpu"
-                or env.get("BBTPU_PAGED_INTERPRET")
-            )
-        )
-
-        payload_dev = jnp.asarray(pack_step_payload(h_pad, plan))
-        arena = self.manager.arena
-
-        def _run(use_kernel_now: bool):
-            with jitwatch.region("span_step_ragged", f"r{rb},s{sb},p{pb}"):
-                return span_step_ragged(
-                    self.params,
-                    arena["k"],
-                    arena["v"],
-                    payload_dev,
-                    lora,
-                    spec=spec,
-                    r=rb,
-                    n_seqs=sb,
-                    page_size=self.page_size,
-                    max_pages=pb,
-                    windows=self.windows,
-                    use_kernel=use_kernel_now,
-                )
-
-        try:
-            out, new_k, new_v = _run(use_kernel)
-        except Exception:
-            # same self-heal contract as _step: retry on the dense ragged
-            # path only if the donated arena buffers are still alive
-            if self._arena_consumed(arena):
-                self._rebuild_after_failure("mixed ragged step")
-                raise
-            if not use_kernel:
-                raise
-            import logging
-
-            logging.getLogger(__name__).exception(
-                "paged ragged kernel failed; retrying on the dense "
-                "ragged path"
-            )
-            out, new_k, new_v = _run(False)
-            self._paged_broken = True
-        self.manager.arena = {"k": new_k, "v": new_v}
-        return out[0, :r], combined
-
-    def tree_group_unsupported(self) -> str | None:
-        """Why this executor can't batch tree-verify steps into one ragged
-        dispatch; None when it can. Everything mixed dispatch can't do the
-        tree group can't either, plus sliding-window layers: the ragged
-        tree mask replaces causality outright, and window clipping against
-        depth-positioned tree tokens only exists on the solo dense path."""
-        reason = self.mixed_unsupported()
-        if reason is not None:
-            return reason
-        if any(w > 0 for w in self.windows):
-            return "sliding-window layers"
-        return None
 
     def tree_group(
         self,
@@ -682,27 +549,78 @@ class SpanExecutor:
         layers: tuple[int, int] | None = None,
         adapter: str | None = None,
     ):
-        """Ragged generalization of decode_group for TREE-verify steps: N
-        sessions' linearized speculative trees (differing sizes) pack
-        row-major into one pow2 bucket [1, R, D] and verify as ONE span
-        dispatch. Each row's rotary position is its committed length plus
-        its node depth, and per-row tree visibility rides the plan into the
-        ragged kernel (dense attend_ragged for kernel-ineligible configs),
-        so the merged step is numerically identical to the members run
-        alone through `_step`'s solo tree path.
-
-        KV writes are SPECULATIVE for every member (tree steps never
-        commit): the caller rolls every member back to its pre-dispatch
-        length if the dispatch fails and replays solo; on success the
-        speculative region stays parked until the session's next accept
-        settles the surviving slots via accept_speculative.
-
-        Returns (out, combined_handle): `out` is the lazy [R, D] device
-        result in member-major token order (slice b_i * t_i row blocks per
-        member, fetch off-queue)."""
+        """Tree-verify ragged dispatch (N sessions' linearized speculative
+        trees verified as ONE span step). Thin delegation onto
+        `ragged_group`; kept as the PR-10 call surface."""
         reason = self.tree_group_unsupported()
         if reason is not None:
             raise ValueError(f"tree_group unsupported: {reason}")
+        return self.ragged_group(
+            handles, hiddens, tree_masks=tree_masks,
+            depths_list=depths_list, layers=layers, adapter=adapter,
+        )
+
+    def ragged_group(
+        self,
+        handles: list[CacheHandle],
+        hiddens: list[np.ndarray],  # per-member [b_i, t_i, D], same dtype
+        tree_masks: list | None = None,  # per-member [b_i, t_i, t_i] bool
+        # or None for causal members (decode rows / the prefill chunk)
+        depths_list: list | None = None,  # per-member [b_i, t_i] i32, None
+        # for causal members (positions run sequentially from the start)
+        layers: tuple[int, int] | None = None,
+        adapter: str | None = None,
+    ):
+        """THE universal ragged dispatch: N sessions' rows — single-token
+        decodes, linearized tree-verify rows, at most one multi-token
+        prefill chunk — pack row-major into ONE pow2 bucket [1, R, D] and
+        run as ONE jitted span dispatch over an ephemeral combined handle.
+        Per-token (q_seq, q_pos) carry the member structure into the
+        ragged kernel (dense attend_ragged for kernel-ineligible configs
+        and TP-mesh spans, where GSPMD shards the rows' heads over the
+        mesh). Members are CAUSAL by default; a member whose entry in
+        `tree_masks`/`depths_list` is non-None contributes TREE rows.
+        When any tree member is present the whole dispatch takes the
+        tree-mask variant, and causal members ride along as
+        lower-triangular rows at sequential depths — exactly causality, so
+        the fused step stays token-identical to the members run alone.
+
+        KV writes are SPECULATIVE for every member; commit/rollback stays
+        per-member with the CALLER as recovery owner (decodes
+        commit/rollback, the chunk commits on its last chunk /
+        truncate_speculative's on failure, tree members truncate and
+        replay solo — block_server._dispatch_ragged).
+
+        Returns (out, combined_handle): `out` is the lazy [R, D] device
+        result in member-major token order (slice b_i * t_i row blocks
+        per member, fetch off-queue)."""
+        n_members = len(handles)
+        if tree_masks is None:
+            tree_masks = [None] * n_members
+        if depths_list is None:
+            depths_list = [None] * n_members
+        has_tree = any(tm is not None for tm in tree_masks)
+        if (
+            not has_tree
+            and all(int(hid.shape[1]) == 1 for hid in hiddens)
+        ):
+            # pure single-token decodes: the legacy packed path IS this
+            # dispatch (same [B, 1, D] bucket family as big single-session
+            # batches, byte-for-byte PR-2 continuous batching — including
+            # on offloaded/hetero/sparse spans the ragged packing gates
+            # off). [B, 1, D] -> [R, D] is a lazy view, not a copy.
+            combined = self.manager.combine_handles(handles)
+            hidden = np.concatenate(hiddens, axis=0)
+            # recovery owner: the caller commits/rolls back the combined
+            # handle around this dispatch
+            out = self._step(  # bbtpu: noqa[BB001]
+                combined, hidden, commit=False, layers=layers, fetch=False,
+                adapter=adapter,
+            )
+            return out.reshape(out.shape[0], out.shape[2]), combined
+        reason = self.ragged_unsupported(has_tree=has_tree)
+        if reason is not None:
+            raise ValueError(f"ragged_group unsupported: {reason}")
         spec = self.spec
         from bloombee_tpu.models.checkpoint import resolve_adapter
 
@@ -720,11 +638,15 @@ class SpanExecutor:
             row_blocks.append(hid.reshape(b_i * t_i, d))
         n_seqs = len(counts)
         r = sum(counts)
-        t_max = next_pow2(max(counts))
+        # the tree-mask variant keeps every row's in-step width static:
+        # causal members' rows become lower-triangular tree rows, so one
+        # t_max bucket covers the whole mix
+        t_max = next_pow2(max(counts)) if has_tree else 0
 
         starts = self.manager.context_lens(combined)  # [B] before write
-        # recovery owner: block_server._dispatch_tree_group rolls every
-        # member back to its pre-dispatch length if this dispatch fails
+        # recovery owner: block_server._dispatch_ragged rolls decodes
+        # back, truncates the chunk and every tree member to their
+        # pre-dispatch lengths if this dispatch fails
         slots = self.manager.write_slots_ragged(  # bbtpu: noqa[BB001]
             combined, counts, commit=False
         )  # [R]
@@ -752,19 +674,37 @@ class SpanExecutor:
         # padding rows own no sequence (q_seq >= B): fully masked in the
         # kernel, sliced away with the pad rows
         q_seq = np.full((rb,), sb, dtype=np.int32)
-        nt = np.zeros((sb,), dtype=np.int32)
-        tree_rows = np.zeros((rb, t_max), dtype=np.int32)
+        if has_tree:
+            nt = np.zeros((sb,), dtype=np.int32)
+            tree_rows = np.zeros((rb, t_max), dtype=np.int32)
         off = 0
         s_i = 0
         for m_i, hid in enumerate(hiddens):
             b_i, t_i, _ = hid.shape
-            tm = np.asarray(tree_masks[m_i], dtype=bool)
-            dep = np.asarray(depths_list[m_i], dtype=np.int32)
+            tm = tree_masks[m_i]
+            dep = depths_list[m_i]
+            if tm is not None:
+                tm = np.asarray(tm, dtype=bool)
+                dep = np.asarray(dep, dtype=np.int32)
             for row in range(b_i):
-                positions[0, off : off + t_i] = starts[s_i] + dep[row]
+                if tm is not None:
+                    positions[0, off : off + t_i] = starts[s_i] + dep[row]
+                else:
+                    positions[0, off : off + t_i] = starts[s_i] + np.arange(
+                        t_i, dtype=np.int32
+                    )
                 q_seq[off : off + t_i] = s_i
-                nt[s_i] = t_i
-                tree_rows[off : off + t_i, :t_i] = tm[row]
+                if has_tree:
+                    nt[s_i] = t_i
+                    if tm is not None:
+                        tree_rows[off : off + t_i, :t_i] = tm[row]
+                    else:
+                        # causal rows under the tree mask: token j sees
+                        # in-step tokens 0..j at sequential depths — the
+                        # lower triangle is exactly causal attention
+                        tree_rows[off : off + t_i, :t_i] = np.tril(
+                            np.ones((t_i, t_i), dtype=np.int32)
+                        )
                 off += t_i
                 s_i += 1
         pt_pad = np.zeros((sb, pb), dtype=np.int32)
@@ -776,15 +716,26 @@ class SpanExecutor:
         if layers is not None:
             layer_active[:] = 0
             layer_active[layers[0] : layers[1]] = 1
-        plan = pack_ragged_plan(
-            slots_pad, pt_pad, positions, lens_pad, q_seq, layer_active,
-            nt=nt, tree_rows=tree_rows,
-        )
+        if has_tree:
+            plan = pack_ragged_plan(
+                slots_pad, pt_pad, positions, lens_pad, q_seq, layer_active,
+                nt=nt, tree_rows=tree_rows,
+            )
+            tag = f"r{rb},s{sb},p{pb},t{t_max}"
+        else:
+            plan = pack_ragged_plan(
+                slots_pad, pt_pad, positions, lens_pad, q_seq, layer_active
+            )
+            tag = f"r{rb},s{sb},p{pb}"
 
-        # ragged-kernel eligibility mirrors mixed_group's gate; ineligible
-        # configs run attend_ragged's tree branch — still ONE dispatch
+        # ragged-kernel eligibility mirrors _step's chunk gate: dense
+        # arena, [R*H, hd] VMEM budget, contexts past the paged crossover,
+        # single-chip (Pallas kernels don't GSPMD-partition — TP-mesh
+        # spans run the dense attend_ragged path). Ineligible configs run
+        # attend_ragged — still ONE dispatch.
         use_kernel = bool(
             not getattr(self, "_paged_broken", False)
+            and self.mesh is None
             and self.manager.quant is None
             and rb * spec.num_attention_heads <= 2048
             and pb * self.page_size >= env.get("BBTPU_PAGED_MIN_CONTEXT")
@@ -797,13 +748,20 @@ class SpanExecutor:
             )
         )
 
-        payload_dev = jnp.asarray(pack_step_payload(h_pad, plan))
+        payload = pack_step_payload(h_pad, plan)
+        if self.mesh is not None:
+            # commit the h2d payload replicated over the tp mesh; the
+            # sharded params/arena make GSPMD split the per-head work
+            from bloombee_tpu.parallel import serving as tp_serving
+
+            payload_dev = tp_serving.replicated(payload, self.mesh)
+        else:
+            payload_dev = jnp.asarray(payload)
         arena = self.manager.arena
+        step_kwargs = {"t_max": t_max} if has_tree else {}
 
         def _run(use_kernel_now: bool):
-            with jitwatch.region(
-                "span_step_ragged", f"r{rb},s{sb},p{pb},t{t_max}"
-            ):
+            with jitwatch.region("span_step_ragged", tag):
                 return span_step_ragged(
                     self.params,
                     arena["k"],
@@ -817,7 +775,7 @@ class SpanExecutor:
                     max_pages=pb,
                     windows=self.windows,
                     use_kernel=use_kernel_now,
-                    t_max=t_max,
+                    **step_kwargs,
                 )
 
         try:
@@ -826,14 +784,14 @@ class SpanExecutor:
             # same self-heal contract as _step: retry on the dense ragged
             # path only if the donated arena buffers are still alive
             if self._arena_consumed(arena):
-                self._rebuild_after_failure("tree ragged step")
+                self._rebuild_after_failure("ragged group step")
                 raise
             if not use_kernel:
                 raise
             import logging
 
             logging.getLogger(__name__).exception(
-                "paged ragged tree kernel failed; retrying on the dense "
+                "paged ragged kernel failed; retrying on the dense "
                 "ragged path"
             )
             out, new_k, new_v = _run(False)

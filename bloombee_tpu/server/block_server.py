@@ -124,9 +124,15 @@ env.declare(
     "BBTPU_MIXED_BATCH", bool, False,
     "mixed-batch dispatch (Sarathi-Serve fused iterations): let a popped "
     "prefill chunk absorb compatible queued single-token decode steps "
-    "(and vice versa) into ONE ragged span dispatch, so a mid-stream "
-    "prefill no longer costs decodes a whole dispatch each. Off = the "
-    "decode-only batcher and per-chunk prefill tasks, byte-for-byte",
+    "(and vice versa) into ONE ragged span dispatch "
+    "(executor.ragged_group; with --spec-batch also on, tree-verify rows "
+    "join the same dispatch), so a mid-stream prefill no longer costs "
+    "decodes a whole dispatch each. Falls back to separate dispatches on "
+    "configs the ragged step doesn't cover (weight offload, hetero "
+    "spans, top-k attention; TP meshes run the fused path via the dense "
+    "sharded attend), surfacing each declined reason in rpc_info "
+    "ragged_declines. Off = the decode-only batcher and per-chunk "
+    "prefill tasks, byte-for-byte",
 )
 env.declare(
     "BBTPU_PROMOTE_HIGH_MS", float, 1500.0,
@@ -159,13 +165,15 @@ env.declare(
     "BBTPU_SPEC_BATCH", bool, False,
     "batched tree-speculative verification: let concurrent sessions' "
     "tree-verify steps that share (layers, adapter, dtype) pad/stack into "
-    "ONE ragged span dispatch (executor.tree_group) instead of a solo "
-    "dispatch per speculating session; per-session speculative KV still "
-    "commits/rolls back row-by-row and the accept-rides-next-step "
-    "protocol is unchanged. Falls back to solo tree steps on configs the "
-    "ragged tree step doesn't cover (TP mesh, weight offload, hetero "
-    "spans, top-k attention, sliding-window layers). Off = every "
-    "tree-verify step dispatches solo, byte-for-byte",
+    "ONE ragged span dispatch (executor.ragged_group; with --mixed-batch "
+    "also on, tree rows fuse with decode rows and a prefill chunk in the "
+    "same dispatch) instead of a solo dispatch per speculating session; "
+    "per-session speculative KV still commits/rolls back row-by-row and "
+    "the accept-rides-next-step protocol is unchanged. Falls back to solo "
+    "tree steps on configs the ragged tree step doesn't cover (weight "
+    "offload, hetero spans, top-k attention, sliding-window layers; TP "
+    "meshes run the fused path via the dense sharded attend). Off = "
+    "every tree-verify step dispatches solo, byte-for-byte",
 )
 env.declare(
     "BBTPU_LIAR_P", float, 0.0,
@@ -312,6 +320,16 @@ class _Session:
         # it batches like any other session instead of being carved out
         # of merged dispatches for the rest of its life
         self.adoption_settled = False
+        # speculation-mode gauge for the kind-aware group_hint: True
+        # while the session could contribute a tree-verify row. A gather
+        # that can only admit tree rows is bounded by the sessions
+        # currently speculating — without this, tree groups sleep the
+        # full window whenever any non-speculating session is open.
+        # OPTIMISTIC start (True): until a session reveals its kind with
+        # a plain decode step it might speculate, and the first tree
+        # gathers must wait for it or concurrent spec sessions that start
+        # milliseconds apart never pair up
+        self.speculating = True
 
 
 class _PeerPool:
@@ -442,16 +460,17 @@ class BlockServer:
         # compatible queued decode steps into ONE ragged span dispatch
         # (Sarathi-Serve fused iterations) instead of a dispatch each;
         # falls back to separate dispatches on configs the ragged step
-        # doesn't cover (TP mesh, weight offload, hetero spans, top-k
-        # attention). None -> BBTPU_MIXED_BATCH env; off = current
-        # decode-only batching, byte-for-byte
+        # doesn't cover (weight offload, hetero spans, top-k attention —
+        # TP meshes run the fused path). None -> BBTPU_MIXED_BATCH env;
+        # off = current decode-only batching, byte-for-byte
         spec_batch: bool | None = None,  # batched tree-speculative
         # verification: pad/stack concurrent sessions' compatible
         # tree-verify steps into ONE ragged span dispatch
-        # (executor.tree_group) instead of one solo dispatch per
-        # speculating session; falls back to solo tree steps on configs
-        # the ragged tree step doesn't cover. None -> BBTPU_SPEC_BATCH
-        # env; off = solo tree dispatches, byte-for-byte
+        # (executor.ragged_group — with mixed_batch also on, tree rows
+        # fuse with decode rows and a chunk) instead of one solo dispatch
+        # per speculating session; falls back to solo tree steps on
+        # configs the ragged tree step doesn't cover. None ->
+        # BBTPU_SPEC_BATCH env; off = solo tree dispatches, byte-for-byte
         standby: bool = False,  # start as a WARM STANDBY for this span:
         # announce JOINING (holds weights + accepts kv_put replication but
         # takes no routed traffic), watch the span's serving replicas, and
@@ -656,6 +675,10 @@ class BlockServer:
         # XLA compile on a middle/tail span)
         self.chain_step_timeout = 120.0
         self.max_batch = max(1, int(max_batch))
+        # ragged-path declines, per reason (BB006: rpc_info + health
+        # --probe): every requested-but-unsupported fallback to monolithic
+        # dispatch is operator-visible instead of a silent logger.info
+        self.ragged_declines: dict[str, int] = {}
         if mixed_batch is None:
             mixed_batch = bool(env.get("BBTPU_MIXED_BATCH"))
         if mixed_batch:
@@ -663,6 +686,9 @@ class BlockServer:
             if reason is not None:
                 logger.info(
                     "mixed-batch dispatch disabled: %s", reason
+                )
+                self.ragged_declines[reason] = (
+                    self.ragged_declines.get(reason, 0) + 1
                 )
                 mixed_batch = False
         self.mixed_batch = bool(mixed_batch)
@@ -674,16 +700,19 @@ class BlockServer:
                 logger.info(
                     "batched tree verification disabled: %s", reason
                 )
+                self.ragged_declines[reason] = (
+                    self.ragged_declines.get(reason, 0) + 1
+                )
                 spec_batch = False
-        # tree-verify keys coalesce via the queue's exact-key fallback
-        # (trees of differing size share one ("tree", ...) key), so no
-        # extra compat predicate is needed here
         self.spec_batch = bool(spec_batch)
-        if self.mixed_batch:
-            # one extra group slot for the prefill chunk, so fusing never
-            # costs the decode batcher any of its max_batch decode seats
+        if self.mixed_batch or self.spec_batch:
+            # ONE kind-aware gather predicate covers every batchable row
+            # kind (decode rows, the prefill chunk, tree-verify rows);
+            # with --mixed-batch the chunk rides one extra group slot so
+            # fusing never costs the batcher any of its max_batch seats
             self.compute = ComputeQueue(
-                max_group=self.max_batch + 1, compat=self._mixed_compat,
+                max_group=self.max_batch + (1 if self.mixed_batch else 0),
+                compat=self._ragged_compat,
                 group_hint=self._batch_group_hint,
             )
         else:
@@ -788,6 +817,12 @@ class BlockServer:
         self.mixed_tokens = 0
         self.step_dispatches = 0
         self.step_tokens = 0
+        # universal ragged dispatch observability: fused groups run
+        # through the unified runner, and how many of them mixed row
+        # KINDS (decode/chunk/tree) in one device step — the capability
+        # the three legacy paths could never express
+        self.ragged_group_dispatches = 0
+        self.ragged_cross_kind_dispatches = 0
         # speculative-decode observability (previously client-side only):
         # tree-verify steps served (solo or grouped), the session rows
         # they carried, drafted vs accepted speculative tokens (from the
@@ -1187,25 +1222,35 @@ class BlockServer:
         await self._warmup_ragged(prefill_tokens)
 
     async def _warmup_ragged(self, prefill_tokens: int) -> None:
-        """Pre-compile the RAGGED-row buckets the fused group paths hit:
-        mixed_group's grouped decode (r=2, s=2 rows over the prefill-depth
-        page bucket) and tree_group's default-drafter tree verify. Without
-        this the first grouped step after warmup eats the compile stall —
-        exactly the steady-state recompile the jitwatch gate forbids."""
-        mixed_on = bool(env.get("BBTPU_MIXED_BATCH"))
-        spec_on = bool(env.get("BBTPU_SPEC_BATCH"))
+        """Pre-compile the UNIFIED ragged-row buckets the fused group
+        paths hit: the grouped-decode packed pair, the decode+chunk
+        causal ragged bucket, the default-drafter tree-verify pair, and
+        (with BOTH flags on) the cross-kind decode+tree[+chunk] fusions.
+        Without this the first fused step after warmup eats the compile
+        stall — exactly the steady-state recompile the jitwatch gate
+        forbids."""
+        mixed_on = self.mixed_batch
+        spec_on = self.spec_batch
         if not (mixed_on or spec_on):
             return
-        if self.executor.mixed_unsupported() is not None:
+        if self.executor.ragged_unsupported(has_tree=spec_on) is not None:
             return
         d = self.spec.hidden_size
+        budget = self._chunk_budget() if self.executor.sp_mesh is None else 0
+        # default GreedyTreeDrafter branching (2, 2, 1): 11 linearized
+        # nodes per tree — the t_max/rb bucket real spec-decode rounds
+        # dispatch
+        t_i = 11
+        cap = prefill_tokens + max(budget, 0) + 24
         try:
             async with self.manager.allocate(
-                1, prefill_tokens + 20, timeout=5.0
+                1, cap, timeout=5.0
             ) as h_a, self.manager.allocate(
-                1, prefill_tokens + 20, timeout=5.0
-            ) as h_b:
-                handles = [h_a, h_b]
+                1, cap, timeout=5.0
+            ) as h_b, self.manager.allocate(
+                1, cap, timeout=5.0
+            ) as h_c:
+                handles = [h_a, h_b, h_c]
                 hidden = np.zeros((1, prefill_tokens, d), np.float32)
                 for h in handles:
                     # buckets already warm from the solo pass; this seeds
@@ -1214,47 +1259,71 @@ class BlockServer:
                         PRIORITY_TRAINING, self.executor.prefill,
                         h, hidden, True, None, False,
                     )
+
+                def tree_rows():
+                    return (
+                        np.zeros((1, t_i, d), np.float32),
+                        np.tril(np.ones((1, t_i, t_i), dtype=bool)),
+                        np.arange(t_i, dtype=np.int32)[None, :],
+                    )
+
+                async def warm(pairs, label):
+                    # pairs: list of (handle, hidden, mask, depths); every
+                    # warm dispatch writes KV speculatively, so truncate
+                    # each member back afterwards
+                    snaps = [
+                        [int(x) for x in self.manager.context_lens(h)]
+                        for h, _, _, _ in pairs
+                    ]
+                    await self.compute.submit(
+                        PRIORITY_TRAINING, self.executor.ragged_group,
+                        [h for h, _, _, _ in pairs],
+                        [x for _, x, _, _ in pairs],
+                        [m for _, _, m, _ in pairs],
+                        [q for _, _, _, q in pairs],
+                    )
+                    for (h, _, _, _), snap in zip(pairs, snaps):
+                        self.manager.truncate_speculative(h, snap)
+                    logger.info("warmed ragged buckets: %s", label)
+
+                step = np.zeros((1, 1, d), np.float32)
+                chunk = (
+                    np.zeros((1, budget, d), np.float32)
+                    if budget > 0 else None
+                )
                 if mixed_on:
-                    snaps = [
-                        [int(x) for x in self.manager.context_lens(h)]
-                        for h in handles
-                    ]
-                    step = [np.zeros((1, 1, d), np.float32)] * 2
-                    await self.compute.submit(
-                        PRIORITY_TRAINING, self.executor.mixed_group,
-                        handles, step,
+                    # pure-decode pair: the packed fast path (grouped
+                    # decode), same program _dispatch_batched runs
+                    await warm(
+                        [(h_a, step, None, None), (h_b, step, None, None)],
+                        "decode pair (packed)",
                     )
-                    for h, snap in zip(handles, snaps):
-                        self.manager.truncate_speculative(h, snap)
-                    logger.info("warmed mixed ragged buckets (2 rows)")
-                if (
-                    spec_on
-                    and self.executor.tree_group_unsupported() is None
-                ):
-                    snaps = [
-                        [int(x) for x in self.manager.context_lens(h)]
-                        for h in handles
-                    ]
-                    # default GreedyTreeDrafter branching (2, 2, 1):
-                    # 11 linearized nodes per tree — the t_max/rb bucket
-                    # real spec-decode rounds dispatch
-                    t_i = 11
-                    tree = [np.zeros((1, t_i, d), np.float32)] * 2
-                    mask = [
-                        np.tril(np.ones((1, t_i, t_i), dtype=bool))
-                    ] * 2
-                    depths = [
-                        np.arange(t_i, dtype=np.int32)[None, :]
-                    ] * 2
-                    await self.compute.submit(
-                        PRIORITY_TRAINING, self.executor.tree_group,
-                        handles, tree, mask, depths,
+                    if chunk is not None:
+                        await warm(
+                            [(h_a, step, None, None),
+                             (h_b, chunk, None, None)],
+                            "decode + chunk",
+                        )
+                if spec_on:
+                    ta, tb = tree_rows(), tree_rows()
+                    await warm(
+                        [(h_a,) + ta, (h_b,) + tb],
+                        "tree pair",
                     )
-                    for h, snap in zip(handles, snaps):
-                        self.manager.truncate_speculative(h, snap)
-                    logger.info(
-                        "warmed tree ragged buckets (2 trees of %d)", t_i
+                if mixed_on and spec_on:
+                    # cross-kind fusions only the universal path runs
+                    tb = tree_rows()
+                    await warm(
+                        [(h_a, step, None, None), (h_b,) + tb],
+                        "decode + tree",
                     )
+                    if chunk is not None:
+                        tc = tree_rows()
+                        await warm(
+                            [(h_a, step, None, None), (h_b,) + tc,
+                             (h_c, chunk, None, None)],
+                            "decode + tree + chunk",
+                        )
         except Exception as e:
             self._note_warmup_failure()
             logger.warning("ragged warmup failed: %s", e)
@@ -1932,6 +2001,15 @@ class BlockServer:
             "dispatches_per_token": (
                 self.step_dispatches / max(self.step_tokens, 1)
             ),
+            # universal ragged dispatch observability: every fused ragged
+            # dispatch, the subset that actually crossed row kinds
+            # (decode/tree/chunk in one device step), and every
+            # requested-but-declined ragged path keyed by the executor's
+            # unsupported reason (non-empty means an operator asked for
+            # fusing on a span that can't run it)
+            "ragged_group_dispatches": self.ragged_group_dispatches,
+            "ragged_cross_kind_dispatches": self.ragged_cross_kind_dispatches,
+            "ragged_declines": dict(self.ragged_declines),
             # spec-decode observability (batched tree verification):
             # tree-verify steps served, the session rows they carried,
             # drafted vs accepted speculative tokens (from the accept
@@ -3127,6 +3205,16 @@ class BlockServer:
         hidden = np.asarray(tensors[0])
         tree_mask = None
         depths = None
+        # kind-aware group_hint gauge: tree steps mark the session
+        # speculating (spec-decode rounds are all tree steps, so the flag
+        # is stable between rounds); a plain single-token decode step
+        # reveals a NON-speculating session. Prefill / chunk steps are
+        # kind-neutral — the session might start speculating right after
+        # its prompt, so they leave the optimistic default alone.
+        if meta.get("tree"):
+            session.speculating = True
+        elif hidden.shape[1] == 1:
+            session.speculating = False
         if meta.get("tree"):
             tree_mask = np.asarray(tensors[1], dtype=bool)
             if meta.get("depths") is not None:
@@ -3184,10 +3272,12 @@ class BlockServer:
                     ("decode1", session.layers, session.adapter,
                      str(hidden.dtype)),
                     _BatchMember(session, handle, hidden),
-                    # with --mixed-batch the group may also hold a prefill
-                    # chunk; the mixed runner degrades to the classic
-                    # decode-group path for chunk-free groups
-                    self._compute_mixed_group if self.mixed_batch
+                    # with --mixed-batch / --spec-batch the group may also
+                    # hold a prefill chunk or tree-verify rows; the ragged
+                    # runner degrades to the classic decode-group path for
+                    # chunk-free, tree-free groups
+                    self._compute_ragged_group
+                    if (self.mixed_batch or self.spec_batch)
                     else self._compute_step_group,
                     deadline=deadline,
                     task_class="decode",
@@ -3198,13 +3288,15 @@ class BlockServer:
                 # of OTHER speculating sessions that are queued right now
                 # (or arrive within BBTPU_BATCH_WINDOW_MS) pad/stack into
                 # one ragged span dispatch; trees of differing size share
-                # the key (size is not part of it)
+                # the key (size is not part of it), and with --mixed-batch
+                # also on, the compat predicate fuses tree rows with
+                # decode rows and a prefill chunk in the SAME dispatch
                 out_dev, t_dispatch_ms = await self.compute.submit_group(
                     PRIORITY_INFERENCE,
                     ("tree", session.layers, session.adapter,
                      str(hidden.dtype)),
                     _TreeMember(session, handle, hidden, tree_mask, depths),
-                    self._compute_tree_group,
+                    self._compute_ragged_group,
                     deadline=deadline,
                     task_class="decode",
                 )
@@ -4040,8 +4132,9 @@ class BlockServer:
                     )
                 if self.mixed_batch:
                     # batchable chunk: the worker may fuse this chunk with
-                    # queued decode steps into one ragged dispatch (and a
-                    # popped decode may likewise absorb this chunk)
+                    # queued decode steps — and, with --spec-batch also
+                    # on, tree-verify rows — into one ragged dispatch (and
+                    # a popped decode may likewise absorb this chunk)
                     out, dt_ms = await self.compute.submit_group(
                         aged_chunk_priority(stream_t0),
                         ("chunkm", session.layers, session.adapter,
@@ -4050,7 +4143,7 @@ class BlockServer:
                             session, handle, hidden[:, s:e],
                             idx == 0, idx == last, prefix_skip,
                         ),
-                        self._compute_mixed_group,
+                        self._compute_ragged_group,
                         deadline=deadline,
                         task_class="prefill",
                     )
@@ -4359,45 +4452,10 @@ class BlockServer:
         )
 
     def _compute_tree_group(self, members: list[_TreeMember]) -> list:
-        """Runs on the compute thread: execute a group of compatible
-        tree-verify steps as ONE ragged span dispatch. Returns one outcome
-        per member — (lazy [b, t, D] out, dispatch_ms) or an Exception
-        instance, which the queue raises only at that member's caller.
-
-        Same member hygiene as _compute_step_group: stale-epoch members
-        fail typed, parked / adoption-unsettled members fall out to the
-        solo tree path, and a failed group dispatch truncates every
-        member's speculation back to its pre-dispatch length and replays
-        solo, so one session's fault never sinks its co-batched peers."""
-        results: list = [None] * len(members)
-        ready: list[int] = []
-        for i, m in enumerate(members):
-            if not self.manager.epoch_valid(m.handle):
-                results[i] = SessionKVLost(
-                    "server KV arena was rebuilt; session cache lost — "
-                    "replay"
-                )
-            elif (self.manager.has_parked(m.handle)
-                  or (not m.session.adoption_settled
-                      and self.manager.has_adopted(m.handle))):
-                results[i] = self._solo_tree_step(m)
-            else:
-                ready.append(i)
-        if len(ready) == 1:
-            results[ready[0]] = self._solo_tree_step(members[ready[0]])
-        elif ready:
-            group = [members[i] for i in ready]
-            try:
-                outs = self._dispatch_tree_group(group)
-            except Exception as e:
-                logger.warning(
-                    "batched tree verification of %d sessions failed "
-                    "(%r); replaying solo", len(group), e,
-                )
-                outs = [self._solo_tree_step(m) for m in group]
-            for i, out in zip(ready, outs):
-                results[i] = out
-        return results
+        """PR-10 surface: thin delegation onto the unified ragged runner
+        (a tree-only group packs and rolls back exactly as the dedicated
+        tree stack used to)."""
+        return self._compute_ragged_group(members)
 
     def _solo_tree_step(self, m: _TreeMember):
         self.batch_solo_steps += 1
@@ -4409,83 +4467,50 @@ class BlockServer:
         except Exception as e:
             return e
 
-    def _dispatch_tree_group(self, group: list[_TreeMember]) -> list:
-        """One ragged span dispatch for >= 2 sessions' tree-verify steps.
-        Every member's tree rows write in SPECULATIVELY; a tree step
-        enters with an EMPTY speculative region (the previous round's
-        accept settled before this step was queued), so a failed dispatch
-        truncates each member back to its pre-dispatch committed length —
-        row-by-row, exactly as decode_group members roll back — and the
-        solo replay re-verifies from a clean table. On success nothing
-        commits here: the surviving slots settle when each session's next
-        accept rides in (accept_speculative, unchanged)."""
-
-        t0 = clock.perf_counter()
-        now = clock.monotonic()
-        for m in group:
-            m.session.last_step_at = now
-        handles = [m.handle for m in group]
-        snaps = [
-            [int(x) for x in self.manager.context_lens(m.handle)]
-            for m in group
-        ]
-        try:
-            out, _combined = self.executor.tree_group(
-                handles,
-                [m.hidden for m in group],
-                [m.tree_mask for m in group],
-                [m.depths for m in group],
-                layers=group[0].session.layers,
-                adapter=group[0].session.adapter,
-            )
-        except Exception:
-            for m, snap in zip(group, snaps):
-                if self.manager.epoch_valid(m.handle):
-                    self.manager.truncate_speculative(m.handle, snap)
-            raise
-        dt_ms = (clock.perf_counter() - t0) * 1000.0
-        self.tree_group_dispatches += 1
-        self.tree_group_members += len(group)
-        self.step_dispatches += 1
-        self.step_tokens += sum(
-            int(m.hidden.shape[0]) * int(m.hidden.shape[1]) for m in group
-        )
-        if self._chunking_sessions:
-            self.decode_steps_interleaved += len(group)
-        if env.log_channel_enabled("timing"):
-            logger.info(
-                "[timing] batched tree verify: %d sessions, %d rows, "
-                "dispatch_ms=%.2f",
-                len(group),
-                sum(int(m.hidden.shape[0]) for m in group), dt_ms,
-            )
-        outs = []
-        row = 0
-        for m in group:
-            b, t = int(m.hidden.shape[0]), int(m.hidden.shape[1])
-            outs.append((out[row:row + b * t].reshape(b, t, -1), dt_ms))
-            row += b * t
-        return outs
-
-    # --------------------------------------------------- mixed-batch dispatch
-    def _batch_group_hint(self) -> int:
+    # ----------------------------------------- universal ragged dispatch
+    def _batch_group_hint(self, members: list | None = None) -> int:
         """Upper bound on how many members a ComputeQueue gather window
         could still collect: a session submits at most one step (or
         prefill chunk) at a time, so once every open session is in the
         group the window is pure dead time — a solo session never waits
-        it out at all."""
-        return len(self._sessions)
+        it out at all.
 
-    def _mixed_compat(self, members: list, cand) -> bool:
-        """ComputeQueue group-membership predicate with --mixed-batch on:
-        decode steps ("decode1") and prefill chunks ("chunkm") may share
-        one ragged dispatch when their layers/adapter/dtype agree, with at
-        most ONE chunk per group (the ragged step models N decode rows +
-        one chunk row-group) and at most max_batch decode members (the
-        chunk rides the +1 group slot, never a decode seat). Any other
-        key kind falls back to exact-key coalescing."""
+        KIND-AWARE when only one of the batching flags is on: a tree-only
+        gather can admit nothing but tree rows, so it is bounded by the
+        sessions currently speculating (without this, tree groups slept
+        the full window whenever any non-speculating session was open —
+        the phase-lock caveat PR 10 root-caused); symmetrically, a causal
+        gather can't admit a speculating session's tree row. With BOTH
+        flags on every kind fuses, so every open session counts."""
+        total = len(self._sessions)
+        if not members or (self.mixed_batch and self.spec_batch):
+            return total
+        speculating = sum(
+            1 for s in self._sessions.values() if s.speculating
+        )
+        if all(m.key[0] == "tree" for m in members):
+            return speculating
+        if self.spec_batch:
+            return total - speculating
+        return total
+
+    def _ragged_compat(self, members: list, cand) -> bool:
+        """ONE kind-aware ComputeQueue group-membership predicate for the
+        universal ragged dispatch. Mixable kinds follow the flags: decode
+        steps ("decode1") and prefill chunks ("chunkm") with
+        --mixed-batch (PR 8), tree-verify rows ("tree") with --spec-batch
+        (PR 10), and all three fuse cross-kind when both are on. Members
+        must agree on layers/adapter/dtype, a group holds at most ONE
+        chunk (the ragged step models N row-groups + one chunk row-group)
+        and at most max_batch non-chunk members (the chunk rides the +1
+        group slot, never a batch seat). Any non-mixable kind falls back
+        to exact-key coalescing."""
+        mixable = set()
+        if self.mixed_batch:
+            mixable |= {"decode1", "chunkm"}
+        if self.spec_batch:
+            mixable.add("tree")
         keys = [m.key for m in members]
-        mixable = ("decode1", "chunkm")
         if cand.key[0] not in mixable or keys[0][0] not in mixable:
             return cand.key == keys[0]
         if any(k[1:4] != cand.key[1:4] for k in keys):
@@ -4493,17 +4518,32 @@ class BlockServer:
         kinds = [k[0] for k in keys]
         if cand.key[0] == "chunkm":
             return "chunkm" not in kinds
-        return kinds.count("decode1") < self.max_batch
+        return sum(1 for k in kinds if k != "chunkm") < self.max_batch
 
     def _compute_mixed_group(self, members: list) -> list:
-        """Runs on the compute thread: a group that may hold decode steps
-        AND one prefill chunk. Chunk-free groups take the classic merged-
-        decode path (identical outcomes to _compute_step_group); a lone
-        chunk runs the plain chunk step; a chunk plus decode members runs
-        as ONE ragged span dispatch, with row-by-row solo replay if the
-        fused dispatch fails so one member's fault never sinks its peers."""
+        """PR-8 surface: thin delegation onto the unified ragged runner
+        (decode+chunk groups pack, commit and roll back exactly as the
+        dedicated mixed stack used to)."""
+        return self._compute_ragged_group(members)
+
+    def _compute_ragged_group(self, members: list) -> list:
+        """Runs on the compute thread: ONE group that may hold decode
+        steps, tree-verify steps AND one prefill chunk, in any mix the
+        compat predicate admitted. Returns one outcome per member — (lazy
+        out, dispatch_ms) or an Exception instance, which the queue
+        raises only at that member's caller.
+
+        Same member hygiene as _compute_step_group: stale-epoch members
+        fail typed; parked / adoption-unsettled members fall out to their
+        kind's solo path (their table side effects stay their own).
+        Chunk-free all-decode groups take the classic merged-decode path
+        (identical outcomes to _compute_step_group); everything else runs
+        as ONE ragged span dispatch via executor.ragged_group, with
+        per-kind solo replay if the fused dispatch fails so one member's
+        fault never sinks its peers."""
         results: list = [None] * len(members)
         decode_idx: list[int] = []
+        tree_idx: list[int] = []
         chunk_idx: list[int] = []
         for i, m in enumerate(members):
             if not self.manager.epoch_valid(m.handle):
@@ -4523,11 +4563,16 @@ class BlockServer:
                   or (not m.session.adoption_settled
                       and self.manager.has_adopted(m.handle))):
                 # same solo carve-outs as _compute_step_group
-                results[i] = self._solo_member_step(m)
+                results[i] = (
+                    self._solo_tree_step(m) if isinstance(m, _TreeMember)
+                    else self._solo_member_step(m)
+                )
+            elif isinstance(m, _TreeMember):
+                tree_idx.append(i)
             else:
                 decode_idx.append(i)
-        if not chunk_idx:
-            # no chunk in the group: exact _compute_step_group semantics
+        if not chunk_idx and not tree_idx:
+            # no chunk, no trees: exact _compute_step_group semantics
             if len(decode_idx) == 1:
                 results[decode_idx[0]] = self._solo_member_step(
                     members[decode_idx[0]]
@@ -4545,23 +4590,32 @@ class BlockServer:
                 for i, out in zip(decode_idx, outs):
                     results[i] = out
             return results
-        if not decode_idx:
+        if not decode_idx and not tree_idx:
             results[chunk_idx[0]] = self._solo_chunk_step(members[chunk_idx[0]])
             return results
-        order = decode_idx + chunk_idx  # chunk member LAST
+        if len(tree_idx) == 1 and not decode_idx and not chunk_idx:
+            results[tree_idx[0]] = self._solo_tree_step(members[tree_idx[0]])
+            return results
+        # member-major row order: decodes, then trees, then the chunk
+        # LAST (its multi-token row-group caps the ragged packing)
+        order = decode_idx + tree_idx + chunk_idx
         group = [members[i] for i in order]
         try:
-            outs = self._dispatch_mixed(group)
+            outs = self._dispatch_ragged(group)
         except Exception as e:
             logger.warning(
-                "mixed dispatch of %d decodes + 1 chunk failed (%r); "
-                "replaying solo", len(group) - 1, e,
+                "ragged dispatch of %d decodes + %d trees + %d chunks "
+                "failed (%r); replaying solo",
+                len(decode_idx), len(tree_idx), len(chunk_idx), e,
             )
-            outs = [
-                self._solo_chunk_step(m) if isinstance(m, _ChunkMember)
-                else self._solo_member_step(m)
-                for m in group
-            ]
+            outs = []
+            for m in group:
+                if isinstance(m, _ChunkMember):
+                    outs.append(self._solo_chunk_step(m))
+                elif isinstance(m, _TreeMember):
+                    outs.append(self._solo_tree_step(m))
+                else:
+                    outs.append(self._solo_member_step(m))
         for i, out in zip(order, outs):
             results[i] = out
         return results
@@ -4575,61 +4629,108 @@ class BlockServer:
         except Exception as e:
             return e
 
-    def _dispatch_mixed(self, group: list) -> list:
-        """ONE ragged span dispatch for >= 1 decode steps plus one prefill
-        chunk (the chunk is group[-1]). Every member's KV writes go in
-        speculatively; decode handles commit after the dispatch succeeds
-        and the chunk commits only on its stream's LAST chunk. On failure
-        the decodes roll back to their committed state while the chunk
-        handle is TRUNCATED to its pre-dispatch length — a plain rollback
-        would also discard the stream's earlier (still wanted) speculative
-        chunks — so the solo replays append no ghost tokens."""
+    def _dispatch_ragged(self, group: list) -> list:
+        """ONE universal ragged span dispatch for any admitted mix of
+        decode steps, tree-verify steps and at most one prefill chunk
+        (the chunk, if present, is group[-1]). Every member's KV writes
+        go in speculatively and commit/rollback stays PER KIND, exactly
+        as the three dedicated stacks did:
+
+        - decode members commit after the dispatch succeeds and roll
+          back to their committed state on failure;
+        - the chunk commits only on its stream's LAST chunk and is
+          TRUNCATED to its pre-dispatch length on failure (a plain
+          rollback would also discard the stream's earlier, still-wanted
+          speculative chunks);
+        - tree members never commit here — on failure each truncates
+          back to its pre-dispatch committed length and replays solo; on
+          success the surviving slots settle when the session's next
+          accept rides in (accept_speculative, unchanged)."""
 
         t0 = clock.perf_counter()
         now = clock.monotonic()
         for m in group:
             m.session.last_step_at = now
-        chunk = group[-1]
-        decodes = group[:-1]
-        # pre-dispatch speculative lengths, the truncate target on failure
-        snaps = [int(x) for x in self.manager.context_lens(chunk.handle)]
+        chunk = group[-1] if isinstance(group[-1], _ChunkMember) else None
+        decodes = [m for m in group if isinstance(m, _BatchMember)]
+        trees = [m for m in group if isinstance(m, _TreeMember)]
+        # pre-dispatch speculative lengths: the truncate targets on
+        # failure for the chunk and for every tree member
+        chunk_snap = (
+            [int(x) for x in self.manager.context_lens(chunk.handle)]
+            if chunk is not None else None
+        )
+        tree_snaps = [
+            [int(x) for x in self.manager.context_lens(m.handle)]
+            for m in trees
+        ]
         try:
-            out, combined = self.executor.mixed_group(
+            out, _combined = self.executor.ragged_group(
                 [m.handle for m in group],
                 [m.hidden for m in group],
+                tree_masks=[
+                    m.tree_mask if isinstance(m, _TreeMember) else None
+                    for m in group
+                ],
+                depths_list=[
+                    m.depths if isinstance(m, _TreeMember) else None
+                    for m in group
+                ],
                 layers=group[0].session.layers,
                 adapter=group[0].session.adapter,
             )
         except Exception:
-            if self.manager.epoch_valid(chunk.handle):
-                self.manager.truncate_speculative(chunk.handle, snaps)
+            if chunk is not None and self.manager.epoch_valid(chunk.handle):
+                self.manager.truncate_speculative(chunk.handle, chunk_snap)
+            for m, snap in zip(trees, tree_snaps):
+                if self.manager.epoch_valid(m.handle):
+                    self.manager.truncate_speculative(m.handle, snap)
             for m in decodes:
                 if self.manager.epoch_valid(m.handle):
                     self.manager.rollback(m.handle)
             raise
         for m in decodes:
             self.manager.commit(m.handle)
-        if chunk.last:
+        if chunk is not None and chunk.last:
             self.manager.commit(chunk.handle)
         dt_ms = (clock.perf_counter() - t0) * 1000.0
         ntok = sum(
             m.handle.batch_size * int(m.hidden.shape[1]) for m in group
         )
-        self.mixed_dispatches += 1
-        self.mixed_tokens += ntok
+        self.ragged_group_dispatches += 1
+        kinds = (
+            (1 if decodes else 0) + (1 if trees else 0)
+            + (1 if chunk is not None else 0)
+        )
+        if kinds > 1:
+            self.ragged_cross_kind_dispatches += 1
+        if chunk is not None:
+            self.mixed_dispatches += 1
+            self.mixed_tokens += ntok
+        if trees:
+            self.tree_group_dispatches += 1
+            self.tree_group_members += len(trees)
         self.step_dispatches += 1
         self.step_tokens += ntok
-        # the decodes literally ran inside a mid-stream prefill's dispatch
-        self.decode_steps_interleaved += len(decodes)
+        if chunk is not None:
+            # the decodes/trees literally ran inside a mid-stream
+            # prefill's dispatch
+            self.decode_steps_interleaved += len(group) - 1
+        elif self._chunking_sessions:
+            self.decode_steps_interleaved += len(group)
         if env.log_channel_enabled("timing"):
             logger.info(
-                "[timing] mixed dispatch: %d decodes + %d-token chunk, "
-                "%d rows, dispatch_ms=%.2f",
-                len(decodes), int(chunk.hidden.shape[1]),
-                sum(m.handle.batch_size for m in group), dt_ms,
+                "[timing] ragged dispatch: %d decodes + %d trees + "
+                "%d-token chunk, %d rows, dispatch_ms=%.2f",
+                len(decodes), len(trees),
+                int(chunk.hidden.shape[1]) if chunk is not None else 0,
+                sum(
+                    m.handle.batch_size * int(m.hidden.shape[1])
+                    for m in group
+                ), dt_ms,
             )
         # slice the member-major token-packed [R, D] result back out:
-        # decode members get [b, 1, D], the chunk gets [b, t, D]
+        # decode members get [b, 1, D], trees and the chunk [b, t, D]
         outs = []
         off = 0
         for m in group:

@@ -108,7 +108,7 @@ class ComputeQueue:
         self,
         max_group: int = 8,
         compat: Callable[[list, "_GroupTask"], bool] | None = None,
-        group_hint: Callable[[], int] | None = None,
+        group_hint: Callable[[list], int] | None = None,
     ) -> None:
         self._queue: asyncio.PriorityQueue = asyncio.PriorityQueue()
         self._seq = itertools.count()
@@ -123,11 +123,14 @@ class ComputeQueue:
         # heterogeneous members into one dispatch (mixed decode+prefill
         # batching) while still refusing cross-adapter/dtype mixes.
         self.compat = compat
-        # upper bound on how many members a gather could EVER collect
-        # (the server passes its open-session count: a session has at
-        # most one step in flight). When the group reaches it, the gather
-        # window is pure dead time and the dispatch goes out immediately.
-        # None = no bound known; the window always runs to its deadline.
+        # upper bound on how many members a gather could EVER collect,
+        # given the members gathered so far (the server derives it from
+        # its open-session count, kind-aware: a gather that can only
+        # admit tree rows is bounded by the sessions currently
+        # speculating, not every open session). When the group reaches
+        # it, the gather window is pure dead time and the dispatch goes
+        # out immediately. None = no bound known; the window always runs
+        # to its deadline.
         self.group_hint = group_hint
         # samples are (picked_up_at_monotonic, wait_s) so windowed readers
         # (admission control, load adverts) can discard old load regimes
@@ -311,14 +314,15 @@ class ComputeQueue:
             # in the same decode round are typically in flight right now.
             # Sliced, so a member landing mid-window joins at the next
             # slice and the hold ends the moment the group provably cannot
-            # grow — group_hint() bounds the possible member count, so a
-            # full house dispatches at once instead of sleeping out the
-            # window (a solo session skips the hold entirely).
+            # grow — group_hint(members) bounds the possible member
+            # count for THIS gather's kinds, so a full house dispatches
+            # at once instead of sleeping out the window (a solo session
+            # skips the hold entirely).
             deadline = clock.monotonic() + window_s
             while len(members) < self.max_group:
                 if (
                     self.group_hint is not None
-                    and len(members) >= self.group_hint()
+                    and len(members) >= self.group_hint(members)
                 ):
                     break
                 remaining = deadline - clock.monotonic()

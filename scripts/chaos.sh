@@ -46,6 +46,13 @@
 #                (a pre-installed standby must warm up entirely from
 #                persistent-cache hits — any real warmup compile for a
 #                pre-installed bucket is a red)
+#   UNIRAGGED    1 = universal ragged dispatch forced end to end: derives
+#                MIXED=1 SPEC=1 so decode rows, tree-verify rows, and
+#                prefill chunks all funnel through the ONE kind-aware
+#                gather + ragged_group device step while the entry's
+#                jitwatch gate proves the unified buckets pre-compiled
+#                (zero steady-state recompiles) and the ledger gate
+#                proves per-kind rollback machinery actually ran
 #   TESTS        comma-separated test-file list for this entry (default:
 #                the whole chaos-marked suite). Feature entries target the
 #                files that actually exercise their flags — the per-entry
@@ -86,14 +93,15 @@ MATRIX=(
     "SEED=97 DELAY_P=0.02 CORRUPT=0.05 TESTS=tests/test_chaos.py,tests/test_session_lease.py,tests/test_kv_replication.py"
     "SEED=31 DELAY_P=0.02 JITWATCH=1 TESTS=tests/test_jitwatch.py,tests/test_chaos.py"
     "SEED=71 DELAY_P=0.02 ARTIFACT=1 JITWATCH=1 TESTS=tests/test_artifact_cache.py"
+    "SEED=67 DELAY_P=0.02 UNIRAGGED=1 JITWATCH=1 TESTS=tests/test_universal_ragged.py,tests/test_mixed_batch.py,tests/test_spec_decode.py,tests/test_chunked_prefill.py"
 )
 for entry in "${MATRIX[@]}"; do
     # per-entry defaults; each entry overrides only what it varies
     SEED=0 DELAY_P=0 ADMIT=0 PARTITION_P=0 MIXED=0 SPEC=0 REBALANCE=0
-    CORRUPT=0 LOCKWATCH=0 JITWATCH=0 ARTIFACT=0 TESTS=tests/
+    CORRUPT=0 LOCKWATCH=0 JITWATCH=0 ARTIFACT=0 UNIRAGGED=0 TESTS=tests/
     for tok in ${entry}; do
         case "${tok%%=*}" in
-            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|LOCKWATCH|JITWATCH|ARTIFACT|TESTS)
+            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|LOCKWATCH|JITWATCH|ARTIFACT|UNIRAGGED|TESTS)
                 declare "${tok}" ;;
             *)
                 echo "chaos: unknown matrix token '${tok}'" >&2
@@ -114,6 +122,13 @@ for entry in "${MATRIX[@]}"; do
     if [ "${REBALANCE}" != "0" ]; then
         promote_high_ms=500
         promote_sustain_s=0.3
+    fi
+    # the universal-ragged entry forces BOTH fusion flags: UNIRAGGED is
+    # the one-dispatch path and only exists when decode + tree + chunk
+    # rows may share a gather
+    if [ "${UNIRAGGED}" != "0" ]; then
+        MIXED=1
+        SPEC=1
     fi
     # in-flight corruption is invisible to the transport; the integrity
     # layer (server digest stamps + client gate) must be on to catch it

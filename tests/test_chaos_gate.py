@@ -27,7 +27,7 @@ def test_matrix_entries_are_keyval_tokens():
     known = {
         "SEED", "DELAY_P", "ADMIT", "PARTITION_P", "MIXED", "SPEC",
         "REBALANCE", "CORRUPT", "LOCKWATCH", "JITWATCH", "ARTIFACT",
-        "TESTS",
+        "UNIRAGGED", "TESTS",
     }
     for entry in entries:
         for tok in entry.split():
@@ -141,6 +141,33 @@ def test_gate_pins_artifact_entry():
     assert 'artifact_jitwatch_args="--preinstalled"' in src, (
         "--preinstalled is not derived from the ARTIFACT key"
     )
+
+
+def test_gate_pins_universal_ragged_entry():
+    """The universal-ragged entry must exist and force the whole fused
+    path: UNIRAGGED=1 derives BOTH fusion flags inside the script (decode
+    + tree + chunk rows share one gather only when mixed AND spec
+    batching are on), replays the files whose traffic exercises every row
+    kind, and carries the compile witness in the same entry so the
+    'unified buckets pre-compiled, zero steady recompiles' claim is gated
+    — not just asserted in a unit test."""
+    src = (REPO / "scripts" / "chaos.sh").read_text()
+    entries = re.findall(r'^\s+"([^"]+)"$', src, flags=re.M)
+    uni = [e for e in entries if "UNIRAGGED=1" in e]
+    assert uni, "no universal-ragged entry in the chaos matrix"
+    assert all("JITWATCH=1" in e for e in uni), (
+        "UNIRAGGED entry runs without the compile witness"
+    )
+    assert any("tests/test_universal_ragged.py" in e for e in uni), (
+        "UNIRAGGED entry does not replay the universal-ragged tests"
+    )
+    # the derivation lives in the script, not the matrix line: setting
+    # only one fusion flag would silently degrade the entry to PR-8/PR-10
+    # behavior and the 'one dispatch' claim would go untested
+    assert re.search(
+        r'if \[ "\$\{UNIRAGGED\}" != "0" \]; then\s*\n\s*MIXED=1\s*\n'
+        r"\s*SPEC=1", src,
+    ), "UNIRAGGED does not derive MIXED=1 SPEC=1"
 
 
 def test_red_entry_prints_full_reproduction_line():

@@ -401,7 +401,7 @@ def test_gather_window_dispatches_early_on_full_house(monkeypatch):
     monkeypatch.setenv("BBTPU_BATCH_WINDOW_MS", "30000")
 
     async def run():
-        q = ComputeQueue(max_group=8, group_hint=lambda: 2)
+        q = ComputeQueue(max_group=8, group_hint=lambda members: 2)
         q.start()
         calls = []
 
@@ -432,7 +432,7 @@ def test_solo_session_skips_gather_window(monkeypatch):
     monkeypatch.setenv("BBTPU_BATCH_WINDOW_MS", "30000")
 
     async def run():
-        q = ComputeQueue(max_group=8, group_hint=lambda: 1)
+        q = ComputeQueue(max_group=8, group_hint=lambda members: 1)
         q.start()
 
         def run_group(payloads):

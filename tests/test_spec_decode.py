@@ -876,8 +876,10 @@ def test_e2e_spec_batch_fault_mid_verify_replays_solo(
         await s.start()
 
         # fail the FIRST group dispatch after its speculative KV writes
-        # landed: recovery must truncate every member before the solo replay
-        orig = s.executor.tree_group
+        # landed: recovery must truncate every member before the solo
+        # replay. Group dispatches all flow through the universal
+        # ragged_group entry point, so that's the interposition surface.
+        orig = s.executor.ragged_group
         calls = {"n": 0}
 
         def flaky(*a, **kw):
@@ -887,7 +889,7 @@ def test_e2e_spec_batch_fault_mid_verify_replays_solo(
                 raise RuntimeError("injected fault after device dispatch")
             return out
 
-        s.executor.tree_group = flaky
+        s.executor.ragged_group = flaky
 
         # ambient chaos (CORRUPT entry) can corrupt a span-output reply of
         # this test too: the digest reject takes the standard short fault
