@@ -853,6 +853,18 @@ def main():
         RESULTS.setdefault("degraded", f"integrity phase failed: {e!r}")
         log(f"integrity phase FAILED: {e!r}")
 
+    # ---- wire phase: bytes/token, codec ms/step, and decode-step p50/p95
+    # under the chaos DELAY matrix — off-loop codec pipeline on vs off vs
+    # a legacy (pre-negotiation, sync-codec) peer, token-identical across
+    # all legs
+    try:
+        phase("wire", "started")
+        run_wire(spec, params, smoke)
+    except Exception as e:  # noqa: BLE001
+        phase("wire", f"failed: {e!r}"[:200])
+        RESULTS.setdefault("degraded", f"wire phase failed: {e!r}")
+        log(f"wire phase FAILED: {e!r}")
+
     # value: SERVED full-model-equivalent PER-SEQUENCE decode tok/s (batch 8
     # session through registry + BlockServer + wire); baseline 35 tok/s =
     # single-A100 single-stream HF decode on Llama-3-8B (BASELINE.md).
@@ -2436,6 +2448,225 @@ def run_reconnect(spec, params) -> None:
         f"resumed, {res['steps_deduped']} deduped) vs "
         f"{full['stall_ms']:.1f} ms replaying {full['replayed']} tokens "
         f"(full replay)"
+    )
+
+
+def run_wire(spec, params, smoke: bool) -> None:
+    """Wire-path phase: decode through a real server under the chaos DELAY
+    matrix's seeded wire jitter, three legs over the identical fault
+    schedule — off-loop codec pipeline ON (default), pipeline OFF (the
+    seed's synchronous scheduling), and a LEGACY peer (pre-negotiation
+    server: sync codec, no advert, ignores ours). Reports bytes/token,
+    codec ms/step, and decode-step p50/p95 per leg; all legs must be
+    token-identical (the pipeline and the negotiation are scheduling and
+    codec-choice changes, never numerics)."""
+    import asyncio
+
+    from bloombee_tpu.client.session import InferenceSession
+    from bloombee_tpu.client.sequence_manager import RemoteSequenceManager
+    from bloombee_tpu.server.block_server import BlockServer
+    from bloombee_tpu.swarm.registry import RegistryClient, RegistryServer
+    from bloombee_tpu.wire import faults
+    from bloombee_tpu.wire.faults import FaultPlan, FaultRule
+    from bloombee_tpu.wire.tensor_codec import (
+        reset_transport_stats,
+        transport_stats,
+    )
+
+    span_layers = spec.num_hidden_layers
+    PAGE = 16
+    PROMPT = 2 * PAGE
+    DECODE = 32 if smoke else 48
+    VOCAB_EFF = min(1024, spec.vocab_size)
+    # the chaos DELAY matrix's wire jitter, seeded so every leg replays
+    # the SAME fault schedule: latency deltas are the pipeline's doing,
+    # not the rng's
+    DELAY_P, DELAY_S = 0.25, 0.004
+
+    LEGS = (
+        # key, pipeline_on, legacy_peer
+        ("off", False, False),
+        ("on", True, False),
+        ("legacy", True, True),
+    )
+
+    async def run_legs() -> dict:
+        """All three legs live in ONE event loop and decode in lockstep
+        (one off/on/legacy step per round): scheduler, allocator, and GC
+        noise land on every leg's samples alike instead of biasing
+        whichever leg ran in the warmest stretch of the process. Each leg
+        owns a FaultPlan seeded identically — and rng draws happen only
+        on matching frames — so all legs replay the SAME delay schedule."""
+        import gc
+
+        # save/restore needs the raw possibly-absent value, not the
+        # typed default env.get would substitute
+        old_env = os.environ.get("BBTPU_WIRE_PIPELINE")  # bbtpu: noqa[BB005]
+        rng = np.random.default_rng(31)
+        embed_table = (
+            rng.standard_normal((VOCAB_EFF, spec.hidden_size)) * 0.02
+        ).astype(np.float32)
+        ids0 = rng.integers(0, VOCAB_EFF, size=(1, PROMPT))
+        legs: dict[str, dict] = {}
+        try:
+            for key, pipeline_on, legacy_peer in LEGS:
+                # pipeline enablement is read at Connection construction:
+                # flip the switch while this leg's swarm comes up so its
+                # client AND accepted server conns get this leg's mode
+                os.environ["BBTPU_WIRE_PIPELINE"] = (
+                    "1" if pipeline_on else "0"
+                )
+                reg = RegistryServer(host="127.0.0.1")
+                await reg.start()
+
+                def rc(reg=reg):
+                    return RegistryClient("127.0.0.1", reg.port)
+
+                srv = BlockServer(
+                    model_uid="bench_wire", start=0, end=span_layers,
+                    params=params, spec=spec, registry=rc(), num_pages=256,
+                    page_size=PAGE, max_batch=1,
+                )
+                await srv.start()
+                if legacy_peer:
+                    # accepted connections emulate a pre-negotiation
+                    # build: codec work synchronous on the loop, no "cd"
+                    # advert, ours ignored
+                    srv.rpc.legacy_wire = True
+                plan = FaultPlan(seed=29)
+                plan.add(FaultRule(site="send", action="delay",
+                                   method="sitem", prob=DELAY_P,
+                                   delay_s=DELAY_S))
+                manager = RemoteSequenceManager(
+                    rc(), "bench_wire", span_layers
+                )
+                s = InferenceSession(
+                    manager, max_length=PROMPT + DECODE + 8, batch_size=1,
+                )
+                await s.__aenter__()
+                faults.set_plan(plan)
+                out = await s.step(embed_table[ids0], ids=ids0)
+                # one untimed decode step: the first decode-shaped call
+                # pays the JAX trace/compile once per process, which
+                # would otherwise swamp a short leg's p95
+                logits = embed_table @ np.asarray(out, np.float32)[0, -1]
+                nid = np.array([[int(np.argmax(logits))]])
+                out = await s.step(embed_table[nid], ids=nid)
+                faults.set_plan(None)
+                legs[key] = {
+                    "reg": reg, "srv": srv, "s": s, "plan": plan,
+                    "out": out, "tokens": [int(nid[0, 0])],
+                    "step_ms": [], "wire_bytes": 0.0, "raw_bytes": 0.0,
+                    "codec_s": 0.0,
+                }
+            reset_transport_stats()
+            prev = transport_stats()
+            gc.collect()
+            gc_was_enabled = gc.isenabled()
+            gc.disable()
+            try:
+                for _ in range(DECODE):
+                    for key, _, _ in LEGS:
+                        leg = legs[key]
+                        # pseudo-head: deterministic greedy selection so
+                        # token-identity across legs is meaningful
+                        logits = embed_table @ np.asarray(
+                            leg["out"], dtype=np.float32
+                        )[0, -1]
+                        nid = np.array([[int(np.argmax(logits))]])
+                        leg["tokens"].append(int(nid[0, 0]))
+                        faults.set_plan(leg["plan"])
+                        t0 = time.time()
+                        leg["out"] = await leg["s"].step(
+                            embed_table[nid], ids=nid
+                        )
+                        leg["step_ms"].append((time.time() - t0) * 1000.0)
+                        faults.set_plan(None)
+                        # transport counters are process-global; steps run
+                        # strictly sequentially, so the per-step delta is
+                        # this leg's traffic (both directions: every
+                        # payload byte records once at serialize)
+                        st = transport_stats()
+                        leg["wire_bytes"] += (
+                            st["tx"]["wire_bytes"] - prev["tx"]["wire_bytes"]
+                        )
+                        leg["raw_bytes"] += (
+                            st["tx"]["raw_bytes"] - prev["tx"]["raw_bytes"]
+                        )
+                        leg["codec_s"] += (
+                            st["tx"]["s"] + st["rx"]["s"]
+                            - prev["tx"]["s"] - prev["rx"]["s"]
+                        )
+                        prev = st
+            finally:
+                if gc_was_enabled:
+                    gc.enable()
+            for key, _, _ in LEGS:
+                legs[key]["pipe"] = legs[key]["srv"].rpc.pipeline_stats()
+        finally:
+            faults.set_plan(None)
+            if old_env is None:
+                os.environ.pop("BBTPU_WIRE_PIPELINE", None)
+            else:
+                os.environ["BBTPU_WIRE_PIPELINE"] = old_env
+            for leg in legs.values():
+                try:
+                    await leg["s"].__aexit__(None, None, None)
+                except Exception:  # noqa: BLE001
+                    pass
+                for thing in (leg["srv"], leg["reg"]):
+                    try:
+                        await asyncio.wait_for(thing.stop(), timeout=30.0)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        out = {}
+        for key, _, _ in LEGS:
+            leg = legs[key]
+            arr = np.asarray(leg["step_ms"])
+            out[key] = {
+                "tokens": leg["tokens"],
+                "p50_ms": float(np.percentile(arr, 50)),
+                "p95_ms": float(np.percentile(arr, 95)),
+                "bytes_per_token": leg["wire_bytes"] / DECODE,
+                "raw_bytes_per_token": leg["raw_bytes"] / DECODE,
+                "codec_ms_per_step": leg["codec_s"] * 1000.0 / DECODE,
+                "server_pipeline": leg["pipe"],
+            }
+        return out
+
+    all_legs = asyncio.run(run_legs())
+    on, off, legacy = all_legs["on"], all_legs["off"], all_legs["legacy"]
+    token_identical = on["tokens"] == off["tokens"]
+    token_identical_legacy = on["tokens"] == legacy["tokens"]
+    RESULTS["wire"] = {
+        "delay_matrix": {"prob": DELAY_P, "delay_s": DELAY_S},
+        "decode_steps": DECODE,
+        "bytes_per_token": on["bytes_per_token"],
+        "raw_bytes_per_token": on["raw_bytes_per_token"],
+        "codec_ms_per_step": on["codec_ms_per_step"],
+        "pipeline_on": {k: v for k, v in on.items() if k != "tokens"},
+        "pipeline_off": {k: v for k, v in off.items() if k != "tokens"},
+        "legacy_peer": {k: v for k, v in legacy.items() if k != "tokens"},
+        "p95_on_le_off": bool(on["p95_ms"] <= off["p95_ms"]),
+        "token_identical": token_identical,
+        "token_identical_legacy": token_identical_legacy,
+    }
+    assert token_identical, (
+        f"pipeline on/off diverged: {on['tokens']} vs {off['tokens']}"
+    )
+    assert token_identical_legacy, (
+        f"legacy-peer leg diverged: {legacy['tokens']} vs {on['tokens']}"
+    )
+    phase("wire", "ok")
+    log(
+        f"wire: {on['bytes_per_token']:.0f} B/token "
+        f"(raw {on['raw_bytes_per_token']:.0f}), codec "
+        f"{on['codec_ms_per_step']:.3f} ms/step; decode p95 "
+        f"{on['p95_ms']:.1f} ms (pipeline on) vs {off['p95_ms']:.1f} ms "
+        f"(off) vs {legacy['p95_ms']:.1f} ms (legacy peer) under "
+        f"DELAY(p={DELAY_P}, {DELAY_S * 1000:.0f} ms); token-identical "
+        f"across all legs"
     )
 
 

@@ -304,6 +304,35 @@ def main(argv=None):
                         line += "  " + " ".join(
                             f"{k}={v}" for k, v in sorted(art.items())
                         )
+                    # wire transport: bytes actually shipped vs raw tensor
+                    # bytes (compression working or not), codec seconds,
+                    # and the off-loop pipeline's depth/backpressure — the
+                    # bytes/token floor under every multi-span latency
+                    # number, probeable without log access (BB006)
+                    tr = probe.get("transport") or {}
+                    for dr in ("tx", "rx"):
+                        d = tr.get(dr) or {}
+                        if d.get("n"):
+                            line += (
+                                f"  {dr}_wire_bytes={d['wire_bytes']}"
+                                f"  {dr}_ratio={d['ratio']:.3f}"
+                                f"  {dr}_codec_s={d['s']:.3f}"
+                            )
+                    pipe = probe.get("wire_pipeline") or {}
+                    if pipe.get("tx_jobs") or pipe.get("rx_jobs"):
+                        line += (
+                            "  pipeline="
+                            + ("on" if pipe.get("enabled") else "off")
+                        )
+                        for k in (
+                            "tx_jobs",
+                            "rx_jobs",
+                            "rx_depth_max",
+                            "rx_backpressure_waits",
+                            "tx_limit",
+                        ):
+                            if pipe.get(k):
+                                line += f"  {k}={pipe[k]}"
                     # session lease counters: are leases reaping abandoned
                     # sessions, are clients resuming instead of replaying,
                     # and is keepalive traffic flowing on idle conns

@@ -1224,6 +1224,13 @@ class InferenceSession:
             # process-wide codec counters (the reference transport
             # profiling channels' client half)
             "transport": transport_stats(),
+            # per-span off-loop pipeline counters (wire/pipeline.py): the
+            # client half of the codec scheduling the servers report via
+            # rpc_info["wire_pipeline"]
+            "wire_pipeline": [
+                s.conn.pipeline.stats() for s in self._spans
+                if s.conn is not None
+            ],
         }
 
     async def decode_n(

@@ -1941,6 +1941,11 @@ class BlockServer:
             "server_id": self.server_id,
             "server_time": clock.now(),  # NTP-style clock sync anchor
             "transport": transport_stats(),
+            # off-loop codec pipeline counters (wire/pipeline.py): job
+            # counts, max observed decode-queue depth, backpressure waits,
+            # and the adaptive send-concurrency ceiling across accepted
+            # connections
+            "wire_pipeline": self.rpc.pipeline_stats(),
             # chaos/ops observability: expired-deadline work drops and the
             # drain flag (also visible as state=DRAINING in server_info)
             "deadlines_expired": self.deadlines_expired,

@@ -86,6 +86,7 @@ def import_declaring_modules() -> None:
     import bloombee_tpu.utils.ledger  # noqa: F401
     import bloombee_tpu.utils.lockwatch  # noqa: F401
     import bloombee_tpu.wire.faults  # noqa: F401
+    import bloombee_tpu.wire.pipeline  # noqa: F401
     import bloombee_tpu.wire.tensor_codec  # noqa: F401
 
 

@@ -14,7 +14,11 @@ from bloombee_tpu.wire.tensor_codec import (
     deserialize_tensor,
     serialize_tensors,
     deserialize_tensors,
+    register_codec,
+    supported_codecs,
+    LEGACY_WIRE_CODECS,
 )
+from bloombee_tpu.wire.pipeline import CodecPipeline
 from bloombee_tpu.wire.rpc import Connection, RpcServer, RpcError, connect
 
 __all__ = [
@@ -22,6 +26,10 @@ __all__ = [
     "deserialize_tensor",
     "serialize_tensors",
     "deserialize_tensors",
+    "register_codec",
+    "supported_codecs",
+    "LEGACY_WIRE_CODECS",
+    "CodecPipeline",
     "Connection",
     "RpcServer",
     "RpcError",

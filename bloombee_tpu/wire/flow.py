@@ -86,6 +86,16 @@ class FlowLimiter:
                 self._decide()
             self._cond.notify_all()
 
+    def stats(self) -> dict:
+        """Control-law observables (surfaced by the wire pipeline through
+        rpc_info → health --probe)."""
+        return {
+            "limit": self.limit,
+            "in_flight": self.in_flight,
+            "ewma_wait_ms": round(self.ewma_wait_ms, 3),
+            "ewma_send_ms": round(self.ewma_send_ms, 3),
+        }
+
     def _decide(self) -> None:
         old = self.limit
         if self._consecutive_failures >= 2:

@@ -10,15 +10,21 @@ Frame layout: [u32 frame_len][u32 header_len][msgpack header][tensor blobs].
 The header carries method, metadata (msgpack dict — the reference's MSGPack
 sidecar), and per-tensor codec metas (see tensor_codec).
 
-Sync codec on the loop (the BB009 noqas below, owner: wire layer): every
-serialize/deserialize_tensors call in this module runs synchronously in a
-coroutine by design. This module IS the event loop's serialization
-boundary — payloads are bounded by the page/chunk budgets the callers
-enforce, codec time is profiled via tensor_codec's transport stats, and a
-per-frame asyncio.to_thread hop costs more in latency and ordering
-complexity than the sub-ms codec work it would offload. Callers holding an
-asyncio lock across these calls do NOT inherit this justification — the
-transitive BB009 pass flags them at their own site.
+Codec scheduling (wire/pipeline.py): tensor (de)serialization runs OFF
+the event loop in a shared codec pool, bounded and ordered per
+connection. Sends hold a FlowLimiter slot around encode+write so a slow
+peer backpressures its own connection, not the loop; receives are
+decoded concurrently but dispatched by a single drain task in arrival
+order, so frames for one stream never reorder, and the bounded drain
+queue turns a slow consumer into TCP backpressure. BBTPU_WIRE_PIPELINE=0
+restores the seed's synchronous scheduling (byte-identical frames).
+
+Codec negotiation: each side piggybacks its supported codec names
+("cd" header key) on the first frames it sends. Older peers ignore
+unknown header keys and never advertise, so until (unless) an advert
+arrives the send path assumes tensor_codec.LEGACY_WIRE_CODECS — mixed
+swarms degrade byte-for-byte to the legacy codec choice, and a future
+codec ships without a flag day.
 """
 
 from __future__ import annotations
@@ -33,11 +39,8 @@ import msgpack
 import numpy as np
 
 from bloombee_tpu.utils import clock, env, lockwatch
-from bloombee_tpu.wire import faults
-from bloombee_tpu.wire.tensor_codec import (
-    deserialize_tensors,
-    serialize_tensors,
-)
+from bloombee_tpu.wire import faults, tensor_codec
+from bloombee_tpu.wire.pipeline import CodecPipeline, decode_now
 
 logger = logging.getLogger(__name__)
 
@@ -97,17 +100,31 @@ def error_from_meta(meta: dict) -> RpcError:
     return RpcError(msg)
 
 
-def _encode_frame(header: dict, blobs: list[bytes]) -> bytes:
+# frame types whose payload is decoded by the ordered receive path; unary
+# reqs and pushes decode inside their own handler task instead (unordered
+# by design, and a bad unary payload answers with an err frame rather
+# than killing the connection)
+_ORDERED_FRAMES = frozenset({"sopen", "sitem", "res"})
+
+
+def _frame_buffers(header: dict, blobs: list) -> list:
+    """Vectored frame encoding: [u32 frame_len][u32 header_len][header]
+    followed by the tensor payloads AS-IS (bytes or memoryview), ready for
+    writer.writelines — the payloads are never copied into an
+    intermediate frame buffer."""
     header = dict(header)
     header["bl"] = [len(b) for b in blobs]
     h = msgpack.packb(header, use_bin_type=True)
     total = 4 + len(h) + sum(len(b) for b in blobs)
-    out = bytearray()
-    out += struct.pack("<II", total, len(h))
-    out += h
-    for b in blobs:
-        out += b
-    return bytes(out)
+    bufs = [struct.pack("<II", total, len(h)) + h]
+    bufs.extend(blobs)
+    return bufs
+
+
+def _encode_frame(header: dict, blobs: list) -> bytes:
+    """Contiguous frame bytes (tests and tooling; the hot path writes the
+    _frame_buffers sequence without this join)."""
+    return b"".join(bytes(b) for b in _frame_buffers(header, blobs))
 
 
 class Stream:
@@ -128,9 +145,8 @@ class Stream:
                    compression: bool = True) -> None:
         if self._closed_local:
             raise RpcError("stream closed")
-        tm, blobs = serialize_tensors(tensors or [], compression)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
-        await self.conn._send(
-            {"t": "sitem", "id": self.id, "meta": meta, "tm": tm}, blobs
+        await self.conn._send_payload(
+            {"t": "sitem", "id": self.id, "meta": meta}, tensors, compression
         )
 
     async def recv(self) -> tuple[dict, list[np.ndarray]] | None:
@@ -175,6 +191,8 @@ class Connection:
         push_handlers: dict[str, PushHandler] | None = None,
         peer: tuple[str, int] | None = None,
         keepalive_s: float | None = None,
+        legacy_wire: bool = False,
+        codecs: frozenset | None = None,
     ):
         self.reader = reader
         self.writer = writer
@@ -192,6 +210,28 @@ class Connection:
         self._send_lock = lockwatch.async_lock("rpc.send")
         self._reader_task: asyncio.Task | None = None
         self._closed = asyncio.Event()
+        # --- codec negotiation + off-loop pipeline -----------------------
+        # legacy_wire emulates a pre-negotiation peer (compat shim for
+        # mixed-swarm tests and the bench's legacy leg): never advertise,
+        # ignore adverts, codec work stays synchronous on the loop
+        self.legacy_wire = bool(legacy_wire)
+        self.codecs_local = (
+            frozenset(codecs) | {"raw"} if codecs is not None
+            else tensor_codec.supported_codecs()
+        )
+        # until the peer advertises, assume the pre-negotiation contract
+        self.peer_codecs = tensor_codec.LEGACY_WIRE_CODECS
+        self._advertised = self.legacy_wire
+        self.pipeline = CodecPipeline(
+            name="%s:%s" % self.peer if self.peer else ""
+        )
+        if self.legacy_wire:
+            self.pipeline.enabled = False
+        self._rx_queue: asyncio.Queue | None = (
+            asyncio.Queue(maxsize=self.pipeline.depth)
+            if self.pipeline.enabled else None
+        )
+        self._drain_task: asyncio.Task | None = None
         self.on_close: Callable[["Connection"], None] | None = None
         # keepalive state: last_recv only advances on frames that survive
         # fault injection, so an injected partition looks exactly as silent
@@ -215,6 +255,8 @@ class Connection:
     # ------------------------------------------------------------------ setup
     def start(self) -> None:
         self._reader_task = asyncio.create_task(self._read_loop())
+        if self._rx_queue is not None:
+            self._drain_task = asyncio.create_task(self._rx_drain_loop())
         if self.keepalive_s and self.keepalive_s > 0:
             self._keepalive_task = asyncio.create_task(self._keepalive_loop())
 
@@ -225,6 +267,8 @@ class Connection:
         self._closed.set()
         if self._reader_task is not None:
             self._reader_task.cancel()
+        if self._drain_task is not None:
+            self._drain_task.cancel()
         if self._keepalive_task is not None:
             self._keepalive_task.cancel()
         for t in list(self._tasks):
@@ -252,6 +296,8 @@ class Connection:
         whenever TCP notices."""
         self._fail_all(ConnectionClosed(reason))
         self._closed.set()
+        if self._drain_task is not None:
+            self._drain_task.cancel()
         try:
             transport = self.writer.transport
             if transport is not None:
@@ -272,10 +318,9 @@ class Connection:
         rid = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
-        tm, blobs = serialize_tensors(tensors or [], compression)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
-        await self._send(
-            {"t": "req", "id": rid, "m": method, "meta": meta or {}, "tm": tm},
-            blobs,
+        await self._send_payload(
+            {"t": "req", "id": rid, "m": method, "meta": meta or {}},
+            tensors, compression,
         )
         try:
             return await asyncio.wait_for(fut, timeout)
@@ -299,10 +344,9 @@ class Connection:
         compression: bool = True,
     ) -> None:
         """Fire-and-forget (the reference's rpc_push plane)."""
-        tm, blobs = serialize_tensors(tensors or [], compression)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
-        await self._send(
-            {"t": "push", "id": 0, "m": method, "meta": meta or {}, "tm": tm},
-            blobs,
+        await self._send_payload(
+            {"t": "push", "id": 0, "m": method, "meta": meta or {}},
+            tensors, compression,
         )
 
     async def open_stream(
@@ -315,15 +359,44 @@ class Connection:
         rid = next(self._ids)
         stream = Stream(self, rid, meta or {}, tensors or [])
         self._streams[rid] = stream
-        tm, blobs = serialize_tensors(tensors or [], compression)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
-        await self._send(
-            {"t": "sopen", "id": rid, "m": method, "meta": meta or {}, "tm": tm},
-            blobs,
+        await self._send_payload(
+            {"t": "sopen", "id": rid, "m": method, "meta": meta or {}},
+            tensors, compression,
         )
         return stream
 
     # --------------------------------------------------------------- internals
-    async def _send(self, header: dict, blobs: list[bytes]) -> None:
+    def _allowed_codecs(self) -> frozenset:
+        """Send-codec set for this peer: the negotiated intersection (the
+        from_wire compat-filtering spirit, applied to codecs)."""
+        return (self.peer_codecs & self.codecs_local) | {"raw"}
+
+    async def _send_payload(
+        self,
+        header: dict,
+        tensors: list[np.ndarray] | None,
+        compression: bool = True,
+    ) -> None:
+        """Encode + send one tensor-carrying frame. Serialization runs in
+        the codec pool under a FlowLimiter slot: a peer that drains slowly
+        inflates this connection's send times, the AIMD law shrinks its
+        concurrency, and waiters park on the limiter instead of stacking
+        encoded frames in memory or convoying the event loop."""
+        async with self.pipeline.tx_slot():
+            tm, blobs = await self.pipeline.encode(
+                tensors or [], compression, self._allowed_codecs()
+            )
+            header["tm"] = tm
+            await self._send(header, blobs)
+
+    async def _send(self, header: dict, blobs: list) -> None:
+        if not self._advertised:
+            # negotiation advert rides the first outgoing frame(s): older
+            # peers ignore unknown header keys, newer peers switch their
+            # send codecs to the intersection. Repeated until one frame is
+            # known written, so an injected drop can't eat the advert.
+            header = dict(header)
+            header["cd"] = sorted(self.codecs_local)
         if self.fault_plan is not None:
             # may sleep (delayed frame), raise after killing the transport
             # (injected reset / mid-stream close / stalled write), mutate
@@ -332,10 +405,11 @@ class Connection:
             # silent discard (injected partition blackhole)
             if await self.fault_plan.on_send(self, header, blobs) == "drop":
                 return
-        frame = _encode_frame(header, blobs)
+        bufs = _frame_buffers(header, blobs)
         async with self._send_lock:
-            self.writer.write(frame)
+            self.writer.writelines(bufs)
             await self.writer.drain()
+        self._advertised = True
 
     async def _keepalive_loop(self) -> None:
         """Ping on idle, declare the peer dead when silent too long.
@@ -375,17 +449,23 @@ class Connection:
                     raise RpcError(f"frame too large: {total}")
                 body = await self.reader.readexactly(total - 4)
                 header = msgpack.unpackb(body[:hlen], raw=False)
+                # zero-copy receive: slice the frame body into memoryviews
+                # so raw-codec payloads reach np.frombuffer uncopied
+                mv = memoryview(body)
                 blobs = []
                 off = hlen
                 for blen in header.get("bl", []):
-                    blobs.append(body[off : off + blen])
+                    blobs.append(mv[off : off + blen])
                     off += blen
                 if self.fault_plan is not None:
                     act = await self.fault_plan.on_read(self, header)
                     if act == "drop":
                         continue  # injected stall/loss: frame never arrives
                 self.last_recv = clock.monotonic()
-                self._dispatch(header, blobs)
+                cd = header.get("cd")
+                if cd and not self.legacy_wire:
+                    self.peer_codecs = frozenset(str(c) for c in cd)
+                await self._ingest(header, blobs)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         except asyncio.CancelledError:
@@ -396,6 +476,7 @@ class Connection:
             self._closed.set()
             if self._keepalive_task is not None:
                 self._keepalive_task.cancel()
+            await self._flush_drain()
             self._fail_all(ConnectionClosed("peer disconnected"))
             # close our side of the transport too: asyncio.Server.wait_closed
             # blocks until every accepted connection's transport is closed
@@ -406,11 +487,104 @@ class Connection:
             if self.on_close is not None:
                 self.on_close(self)
 
-    def _dispatch(self, header: dict, blobs: list[bytes]) -> None:
+    async def _ingest(self, header: dict, blobs: list) -> None:
+        """Route one inbound frame toward _dispatch.
+
+        Pipelined: ordered frames get their decode submitted to the codec
+        pool NOW (overlapping the next socket read) and everything goes
+        through the bounded FIFO the drain task empties — a full queue
+        stalls this coroutine, which stalls the socket: TCP backpressure.
+        Legacy sync mode decodes in-line and dispatches immediately (the
+        seed's exact scheduling)."""
+        t = header["t"]
+        if self._rx_queue is None:
+            if t in _ORDERED_FRAMES:
+                self._dispatch(
+                    header, decode_now(header.get("tm") or [], blobs)
+                )
+            else:
+                self._dispatch(header, blobs)
+            return
+        aw = None
+        if t in _ORDERED_FRAMES:
+            aw = self.pipeline.decode_submit(header.get("tm") or [], blobs)
+        if self._rx_queue.full():
+            self.pipeline.rx_backpressure_waits += 1
+        self.pipeline.note_rx_depth(self._rx_queue.qsize() + 1)
+        await self._rx_queue.put((header, blobs, aw))
+
+    async def _rx_drain_loop(self) -> None:
+        """Single consumer of the receive queue: awaits each frame's decode
+        in ARRIVAL order before dispatching, so off-loop concurrency can
+        never reorder the frames of one stream."""
+        try:
+            while True:
+                item = await self._rx_queue.get()
+                if item is None:
+                    return
+                header, blobs, aw = item
+                if aw is not None:
+                    try:
+                        payload = await aw
+                    except Exception as e:
+                        self._decode_failed(header, e)
+                        continue
+                else:
+                    payload = blobs
+                try:
+                    self._dispatch(header, payload)
+                except Exception:
+                    logger.exception("rpc dispatch error")
+                    self.abort("dispatch error")
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    async def _flush_drain(self) -> None:
+        """Read-loop teardown: frames already queued (a res some caller is
+        awaiting) still dispatch before everyone gets failed."""
+        if self._drain_task is None or self._drain_task.done():
+            return
+        try:
+            self._rx_queue.put_nowait(None)
+        except asyncio.QueueFull:
+            self._drain_task.cancel()
+        try:
+            await self._drain_task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+    def _decode_failed(self, header: dict, exc: Exception) -> None:
+        """A frame that parsed but whose payload fails the codec is a peer
+        bug (or injected corruption): fail the one call/stream it belongs
+        to and keep the connection — the other multiplexed users are
+        unaffected."""
+        t, rid = header.get("t"), header.get("id")
+        err = RpcError(f"codec error on {t} frame: {exc}")
+        logger.warning("%s from %s", err, self.peer)
+        if t == "res":
+            fut = self._pending.get(rid)
+            if fut is not None and not fut.done():
+                fut.set_exception(err)
+        elif t == "sitem":
+            stream = self._streams.get(rid)
+            if stream is not None:
+                stream._push_inbound(err)
+        elif t == "sopen":
+            # no Stream exists yet on this side; tell the opener
+            self._spawn(self._send(
+                {"t": "err", "id": rid, "meta": {"error": str(err)}}, []
+            ))
+
+    def _dispatch(self, header: dict, payload: list) -> None:
+        """payload: decoded tensors for ordered frames (sopen/sitem/res),
+        raw blob buffers for req/push — their handler tasks decode
+        off-loop themselves so a bad unary payload answers with an err
+        frame instead of killing the connection."""
         t = header["t"]
         rid = header["id"]
         if t == "req":
-            task = asyncio.create_task(self._handle_unary(header, blobs))
+            task = asyncio.create_task(self._handle_unary(header, payload))
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
             # indexed by request id so a later "cancel" frame can stop it
@@ -425,17 +599,15 @@ class Connection:
             if task is not None and not task.done():
                 task.cancel()
         elif t == "push":
-            self._spawn(self._handle_push(header, blobs))
+            self._spawn(self._handle_push(header, payload))
         elif t == "sopen":
-            tensors = deserialize_tensors(header.get("tm", []), blobs)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
-            stream = Stream(self, rid, header.get("meta", {}), tensors)
+            stream = Stream(self, rid, header.get("meta", {}), payload)
             self._streams[rid] = stream
             self._spawn(self._handle_stream(header["m"], stream))
         elif t == "sitem":
             stream = self._streams.get(rid)
             if stream is not None:
-                tensors = deserialize_tensors(header.get("tm", []), blobs)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
-                stream._push_inbound((header.get("meta", {}), tensors))
+                stream._push_inbound((header.get("meta", {}), payload))
         elif t == "send":
             stream = self._streams.get(rid)
             if stream is not None:
@@ -443,8 +615,7 @@ class Connection:
         elif t == "res":
             fut = self._pending.get(rid)
             if fut is not None and not fut.done():
-                tensors = deserialize_tensors(header.get("tm", []), blobs)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
-                fut.set_result((header.get("meta", {}), tensors))
+                fut.set_result((header.get("meta", {}), payload))
         elif t == "err":
             fut = self._pending.get(rid)
             if fut is not None and not fut.done():
@@ -473,17 +644,18 @@ class Connection:
         except Exception:
             pass  # a dying transport surfaces through the read loop
 
-    async def _handle_unary(self, header: dict, blobs: list[bytes]) -> None:
+    async def _handle_unary(self, header: dict, blobs: list) -> None:
         rid = header["id"]
         method = header["m"]
         try:
             handler = self.unary_handlers.get(method)
             if handler is None:
                 raise RpcError(f"no such method: {method}")
-            tensors = deserialize_tensors(header.get("tm", []), blobs)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
+            tensors = await self.pipeline.decode_wait(
+                header.get("tm", []), blobs
+            )
             meta, out = await handler(header.get("meta", {}), tensors)
-            tm, oblobs = serialize_tensors(out)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
-            await self._send({"t": "res", "id": rid, "meta": meta, "tm": tm}, oblobs)
+            await self._send_payload({"t": "res", "id": rid, "meta": meta}, out)
         except asyncio.CancelledError:
             # cancelled by a peer "cancel" frame (abandoned call) or by
             # connection teardown: either way nobody is reading the reply
@@ -496,13 +668,13 @@ class Connection:
                     [],
                 )
 
-    async def _handle_push(self, header: dict, blobs: list[bytes]) -> None:
+    async def _handle_push(self, header: dict, blobs: list) -> None:
         method = header["m"]
         handler = self.push_handlers.get(method)
         if handler is None:
             logger.warning("no push handler for %s", method)
             return
-        tensors = deserialize_tensors(header.get("tm", []), blobs)  # bbtpu: noqa[BB009] (sync codec boundary — module docstring)
+        tensors = await self.pipeline.decode_wait(header.get("tm", []), blobs)
         try:
             await handler(header.get("meta", {}), tensors)
         except Exception as e:
@@ -549,6 +721,8 @@ class RpcServer:
         host: str = "0.0.0.0",
         port: int = 0,
         keepalive_s: float | None = None,
+        legacy_wire: bool = False,
+        codecs: frozenset | None = None,
     ):
         self.unary_handlers = unary_handlers or {}
         self.stream_handlers = stream_handlers or {}
@@ -556,17 +730,42 @@ class RpcServer:
         self.host = host
         self.port = port
         self.keepalive_s = keepalive_s
+        self.legacy_wire = legacy_wire
+        self.codecs = codecs
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[Connection] = set()
         # cumulative pings from already-closed connections; live ones are
         # summed on demand (keepalives_sent property)
         self._keepalives_closed = 0
+        # same pattern for the codec-pipeline counters
+        self._pipeline_closed = {
+            "tx_jobs": 0, "rx_jobs": 0,
+            "rx_depth_max": 0, "rx_backpressure_waits": 0,
+        }
 
     @property
     def keepalives_sent(self) -> int:
         return self._keepalives_closed + sum(
             c.keepalives_sent for c in self._conns
         )
+
+    def pipeline_stats(self) -> dict:
+        """Aggregated off-loop codec pipeline counters: live connections
+        plus the already-closed accumulator. Surfaced through rpc_info so
+        cli/health --probe can print them (BB006)."""
+        out = dict(self._pipeline_closed)
+        out["conns"] = len(self._conns)
+        out["enabled"] = False
+        out["tx_limit"] = 0
+        for c in self._conns:
+            s = c.pipeline.stats()
+            out["enabled"] = out["enabled"] or s["enabled"]
+            out["tx_jobs"] += s["tx_jobs"]
+            out["rx_jobs"] += s["rx_jobs"]
+            out["rx_backpressure_waits"] += s["rx_backpressure_waits"]
+            out["rx_depth_max"] = max(out["rx_depth_max"], s["rx_depth_max"])
+            out["tx_limit"] = max(out["tx_limit"], s["tx_limit"])
+        return out
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -581,6 +780,7 @@ class RpcServer:
             reader, writer,
             self.unary_handlers, self.stream_handlers, self.push_handlers,
             keepalive_s=self.keepalive_s,
+            legacy_wire=self.legacy_wire, codecs=self.codecs,
         )
         conn.on_close = self._on_conn_close
         self._conns.add(conn)
@@ -589,6 +789,12 @@ class RpcServer:
     def _on_conn_close(self, conn: Connection) -> None:
         if conn in self._conns:
             self._keepalives_closed += conn.keepalives_sent
+            s = conn.pipeline.stats()
+            acc = self._pipeline_closed
+            acc["tx_jobs"] += s["tx_jobs"]
+            acc["rx_jobs"] += s["rx_jobs"]
+            acc["rx_backpressure_waits"] += s["rx_backpressure_waits"]
+            acc["rx_depth_max"] = max(acc["rx_depth_max"], s["rx_depth_max"])
         self._conns.discard(conn)
 
     async def stop(self) -> None:
@@ -617,11 +823,14 @@ async def connect(
     stream_handlers: dict[str, StreamHandler] | None = None,
     push_handlers: dict[str, PushHandler] | None = None,
     keepalive_s: float | None = None,
+    legacy_wire: bool = False,
+    codecs: frozenset | None = None,
 ) -> Connection:
     reader, writer = await asyncio.open_connection(host, port)
     conn = Connection(
         reader, writer, unary_handlers, stream_handlers, push_handlers,
         peer=(host, port), keepalive_s=keepalive_s,
+        legacy_wire=legacy_wire, codecs=codecs,
     )
     conn.start()
     return conn

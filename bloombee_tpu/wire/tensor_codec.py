@@ -105,6 +105,62 @@ _env.declare(
     "BBTPU_WIRE_COMPRESSION", bool, True,
     "losslessly compress large wire tensors (zstd byte-split)",
 )
+_env.declare(
+    "BBTPU_WIRE_CODECS", str, "",
+    "comma-separated allowlist restricting which codecs this process "
+    "advertises and uses on the wire (negotiation, wire/rpc.py); empty "
+    "means every built-in codec, 'raw' disables compression entirely",
+)
+
+
+# --- codec registry + negotiation support -----------------------------------
+# name -> (compress, decompress). "raw" is implicit and always supported.
+_CODECS: dict[str, tuple] = {"zlib": (lambda b: zlib.compress(b, 6),
+                                      zlib.decompress)}
+if _zstd is not None:
+    _CODECS["zstd"] = (_ZSTD_C.compress, _ZSTD_D.decompress)
+
+# preference order when several codecs are permitted for a payload
+_PREFERENCE: list[str] = ["zstd", "zlib"]
+
+# The pre-negotiation wire contract: every historical peer decodes exactly
+# these. A peer that never advertises (older build) is assumed to speak
+# them and nothing more, so mixed swarms degrade byte-for-byte to the
+# legacy codec choice instead of flag-daying.
+LEGACY_WIRE_CODECS = frozenset({"raw", "zstd", "zlib"})
+
+
+def register_codec(name: str, compress, decompress, *,
+                   prefer: bool = False) -> None:
+    """Plug in a codec (e.g. a dict-trained zstd for activation planes).
+    Registered codecs are only chosen toward peers that advertise them in
+    the connection handshake (wire/rpc.py negotiation) — an un-upgraded
+    swarm never sees the new name on the wire."""
+    _CODECS[name] = (compress, decompress)
+    if name not in _PREFERENCE:
+        if prefer:
+            _PREFERENCE.insert(0, name)
+        else:
+            _PREFERENCE.append(name)
+
+
+def unregister_codec(name: str) -> None:
+    """Test hook: remove a codec registered by register_codec."""
+    _CODECS.pop(name, None)
+    if name in _PREFERENCE:
+        _PREFERENCE.remove(name)
+
+
+def supported_codecs() -> frozenset:
+    """Codecs this process can encode/decode right now — what a connection
+    advertises to its peer. BBTPU_WIRE_CODECS restricts the set ("raw" is
+    always kept: it is the identity codec, not an option)."""
+    names = {"raw", *_CODECS}
+    allow = str(_env.get("BBTPU_WIRE_CODECS")).strip()
+    if allow:
+        keep = {c.strip() for c in allow.split(",") if c.strip()}
+        names &= keep | {"raw"}
+    return frozenset(names)
 
 _DTYPES = {
     "f32": np.float32,
@@ -147,29 +203,36 @@ class TensorMeta:
 
     @classmethod
     def from_wire(cls, d: dict) -> "TensorMeta":
-        return cls(d["d"], tuple(d["s"]), d["c"], d["b"])
+        # .get defaults so an older peer's lean meta (dtype+shape only)
+        # never KeyErrors a newer server: absent codec means raw bytes
+        return cls(d["d"], tuple(d["s"]), d.get("c", "raw"),
+                   d.get("b", False))
 
 
-def _compress(buf: bytes, codec: str) -> bytes:
-    if codec == "zstd":
-        return _ZSTD_C.compress(buf)
-    if codec == "zlib":
-        return zlib.compress(buf, 6)
-    raise ValueError(f"unknown codec {codec}")
+def _compress(buf, codec: str) -> bytes:
+    try:
+        return _CODECS[codec][0](buf)
+    except KeyError:
+        raise ValueError(f"unknown codec {codec}") from None
 
 
-def _decompress(buf: bytes, codec: str) -> bytes:
-    if codec == "zstd":
-        return _ZSTD_D.decompress(buf)
-    if codec == "zlib":
-        return zlib.decompress(buf)
-    raise ValueError(f"unknown codec {codec}")
+def _decompress(buf, codec: str) -> bytes:
+    try:
+        return _CODECS[codec][1](buf)
+    except KeyError:
+        raise ValueError(f"unknown codec {codec}") from None
 
 
 def serialize_tensor(
-    arr: np.ndarray, compression: bool = True
+    arr: np.ndarray, compression: bool = True,
+    allowed: frozenset | None = None,
 ) -> tuple[TensorMeta, bytes]:
-    """Serialize one array; returns (meta, payload bytes)."""
+    """Serialize one array; returns (meta, payload bytes).
+
+    `allowed` is the negotiated codec set for the destination peer (see
+    wire/rpc.py). None means the pre-negotiation contract
+    (LEGACY_WIRE_CODECS), so un-negotiated callers keep the seed's exact
+    codec choice byte-for-byte."""
     t0 = _time.perf_counter()
     arr = np.ascontiguousarray(arr)
     dtype = np.dtype(arr.dtype)
@@ -183,13 +246,16 @@ def serialize_tensor(
     min_gain = _env.get("BBTPU_MIN_COMPRESS_GAIN")
     if not _env.get("BBTPU_WIRE_COMPRESSION"):
         compression = False
-    if compression and len(raw) >= min_bytes:
+    if allowed is None:
+        allowed = LEGACY_WIRE_CODECS
+    usable = [c for c in _PREFERENCE if c in _CODECS and c in allowed]
+    if compression and usable and len(raw) >= min_bytes:
         candidate = raw
         if dtype.itemsize == 2:
             # byte-plane split: [b0 b1 b0 b1 ...] -> [b0 b0 ...][b1 b1 ...]
             candidate = _split_planes(raw)
             byte_split = True
-        chosen = "zstd" if _zstd is not None else "zlib"
+        chosen = usable[0]
         compressed = _compress(candidate, chosen)
         if len(compressed) + min_gain <= len(raw):
             payload = compressed
@@ -203,7 +269,14 @@ def serialize_tensor(
     return TensorMeta(_DTYPE_NAMES[dtype], arr.shape, codec, byte_split), payload
 
 
-def deserialize_tensor(meta: TensorMeta, payload: bytes) -> np.ndarray:
+def deserialize_tensor(meta: TensorMeta, payload, *,
+                       writable: bool = False) -> np.ndarray:
+    """Decode one payload (bytes or memoryview) into an ndarray.
+
+    Raw-codec payloads come back as a READ-ONLY view over the receive
+    buffer — no copy on the wire hot path. Pass writable=True only when
+    the caller mutates the array in place; that is the one path that
+    still pays the copy."""
     t0 = _time.perf_counter()
     dtype = np.dtype(_DTYPES[meta.dtype])
     if meta.codec == "raw":
@@ -212,11 +285,14 @@ def deserialize_tensor(meta: TensorMeta, payload: bytes) -> np.ndarray:
         raw = _decompress(payload, meta.codec)
         if meta.byte_split:
             raw = _merge_planes(raw)
+    out = np.frombuffer(raw, dtype=dtype).reshape(meta.shape)
+    if writable and not out.flags.writeable:
+        out = out.copy()
     _STATS.record(
         "rx", len(raw), len(payload), _time.perf_counter() - t0,
         meta.codec != "raw",
     )
-    return np.frombuffer(bytearray(raw), dtype=dtype).reshape(meta.shape)
+    return out
 
 
 def _split_planes(raw: bytes) -> bytes:
@@ -250,18 +326,20 @@ def _native_lib():
 
 
 def serialize_tensors(
-    arrays: list[np.ndarray], compression: bool = True
+    arrays: list[np.ndarray], compression: bool = True,
+    allowed: frozenset | None = None,
 ) -> tuple[list[dict], list[bytes]]:
     metas, blobs = [], []
     for a in arrays:
-        m, b = serialize_tensor(a, compression)
+        m, b = serialize_tensor(a, compression, allowed=allowed)
         metas.append(m.to_wire())
         blobs.append(b)
     return metas, blobs
 
 
-def deserialize_tensors(metas: list[dict], blobs: list[bytes]) -> list[np.ndarray]:
+def deserialize_tensors(metas: list[dict], blobs: list,
+                        writable: bool = False) -> list[np.ndarray]:
     return [
-        deserialize_tensor(TensorMeta.from_wire(m), b)
+        deserialize_tensor(TensorMeta.from_wire(m), b, writable=writable)
         for m, b in zip(metas, blobs)
     ]

@@ -53,6 +53,13 @@
 #                jitwatch gate proves the unified buckets pre-compiled
 #                (zero steady-state recompiles) and the ledger gate
 #                proves per-kind rollback machinery actually ran
+#   CODEC        1 = streaming wire-path entry: forces every frame through
+#                the off-loop codec pipeline (BBTPU_WIRE_PIPELINE=1 with
+#                inline threshold 0, so no frame takes the small-payload
+#                fast path) while DELAY + CORRUPT land on pipelined
+#                frames; the test's own plan adds seeded reset + in-flight
+#                corruption with the integrity layer on, so the ledger
+#                gate proves decode survived the codec pool under faults
 #   TESTS        comma-separated test-file list for this entry (default:
 #                the whole chaos-marked suite). Feature entries target the
 #                files that actually exercise their flags — the per-entry
@@ -85,23 +92,31 @@ fi
 compile_cache="$(mktemp -d "${TMPDIR:-/tmp}/bbtpu-chaos-xla.XXXXXX")"
 trap 'rm -rf "${compile_cache}"' EXIT
 
+# Entries that replayed the SAME files under compatible flags are merged
+# (each pytest process costs ~10s of interpreter+jax startup on top of
+# its tests, and the tier-1 wall cap is the scarce resource):
+#   - the old standalone CORRUPT entry's test list was identical to the
+#     lock-witness entry's, so corruption+integrity now ride there
+#   - the old MIXED=1 SPEC=1 and JITWATCH smoke entries were subsets of
+#     the universal-ragged entry's files+flags (UNIRAGGED derives both
+#     fusion flags and already carries the compile witness), so their
+#     files fold in and replay under the fused path
 MATRIX=(
     "SEED=23 DELAY_P=0.1"
-    "SEED=43 DELAY_P=0.02 PARTITION_P=0.02 LOCKWATCH=1 TESTS=tests/test_session_lease.py,tests/test_chaos.py,tests/test_kv_replication.py"
-    "SEED=57 DELAY_P=0.05 MIXED=1 SPEC=1 TESTS=tests/test_mixed_batch.py,tests/test_spec_decode.py,tests/test_batched_decode.py,tests/test_chunked_prefill.py"
+    "SEED=43 DELAY_P=0.02 PARTITION_P=0.02 CORRUPT=0.05 LOCKWATCH=1 TESTS=tests/test_session_lease.py,tests/test_chaos.py,tests/test_kv_replication.py"
     "SEED=83 DELAY_P=0.05 ADMIT=1 REBALANCE=1 TESTS=tests/test_chaos.py,tests/test_promotion.py,tests/test_kv_replication.py,tests/test_prefix_cache.py"
-    "SEED=97 DELAY_P=0.02 CORRUPT=0.05 TESTS=tests/test_chaos.py,tests/test_session_lease.py,tests/test_kv_replication.py"
-    "SEED=31 DELAY_P=0.02 JITWATCH=1 TESTS=tests/test_jitwatch.py,tests/test_chaos.py"
     "SEED=71 DELAY_P=0.02 ARTIFACT=1 JITWATCH=1 TESTS=tests/test_artifact_cache.py"
-    "SEED=67 DELAY_P=0.02 UNIRAGGED=1 JITWATCH=1 TESTS=tests/test_universal_ragged.py,tests/test_mixed_batch.py,tests/test_spec_decode.py,tests/test_chunked_prefill.py"
+    "SEED=67 DELAY_P=0.02 UNIRAGGED=1 JITWATCH=1 TESTS=tests/test_universal_ragged.py,tests/test_mixed_batch.py,tests/test_spec_decode.py,tests/test_batched_decode.py,tests/test_chunked_prefill.py,tests/test_jitwatch.py,tests/test_chaos.py"
+    "SEED=41 DELAY_P=0.05 CORRUPT=0.05 CODEC=1 TESTS=tests/test_wire_pipeline.py"
 )
 for entry in "${MATRIX[@]}"; do
     # per-entry defaults; each entry overrides only what it varies
     SEED=0 DELAY_P=0 ADMIT=0 PARTITION_P=0 MIXED=0 SPEC=0 REBALANCE=0
-    CORRUPT=0 LOCKWATCH=0 JITWATCH=0 ARTIFACT=0 UNIRAGGED=0 TESTS=tests/
+    CORRUPT=0 LOCKWATCH=0 JITWATCH=0 ARTIFACT=0 UNIRAGGED=0 CODEC=0
+    TESTS=tests/
     for tok in ${entry}; do
         case "${tok%%=*}" in
-            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|LOCKWATCH|JITWATCH|ARTIFACT|UNIRAGGED|TESTS)
+            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|LOCKWATCH|JITWATCH|ARTIFACT|UNIRAGGED|CODEC|TESTS)
                 declare "${tok}" ;;
             *)
                 echo "chaos: unknown matrix token '${tok}'" >&2
@@ -136,6 +151,13 @@ for entry in "${MATRIX[@]}"; do
     if [ "${CORRUPT}" != "0" ]; then
         integrity=1
     fi
+    # the codec entry drops the inline threshold to 0 so even tiny decode
+    # frames take the off-loop pool — the ordered-drain/backpressure path
+    # under test, not the inline fast path
+    wire_inline=4096
+    if [ "${CODEC}" != "0" ]; then
+        wire_inline=0
+    fi
     # the full derived environment in one line: the run below uses it, and
     # a red entry reprints it verbatim so "reproduce this failure" is a
     # single copy-paste (matrix tokens alone hide the derived knobs)
@@ -156,7 +178,9 @@ BBTPU_MEASURED_REBALANCE=${REBALANCE} \
 BBTPU_PROMOTE_HIGH_MS=${promote_high_ms} \
 BBTPU_PROMOTE_SUSTAIN_S=${promote_sustain_s} \
 BBTPU_LOCKWATCH=${LOCKWATCH} \
-BBTPU_JITWATCH=${JITWATCH}"
+BBTPU_JITWATCH=${JITWATCH} \
+BBTPU_WIRE_PIPELINE=1 \
+BBTPU_WIRE_PIPELINE_INLINE=${wire_inline}"
     # recovery-coverage ledger: every in-process fault/recovery point
     # appends here at interpreter exit; an entry that tested nothing
     # (zero faults or zero recoveries) fails the gate even if pytest

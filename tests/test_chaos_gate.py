@@ -27,7 +27,7 @@ def test_matrix_entries_are_keyval_tokens():
     known = {
         "SEED", "DELAY_P", "ADMIT", "PARTITION_P", "MIXED", "SPEC",
         "REBALANCE", "CORRUPT", "LOCKWATCH", "JITWATCH", "ARTIFACT",
-        "UNIRAGGED", "TESTS",
+        "UNIRAGGED", "CODEC", "TESTS",
     }
     for entry in entries:
         for tok in entry.split():
@@ -168,6 +168,38 @@ def test_gate_pins_universal_ragged_entry():
         r'if \[ "\$\{UNIRAGGED\}" != "0" \]; then\s*\n\s*MIXED=1\s*\n'
         r"\s*SPEC=1", src,
     ), "UNIRAGGED does not derive MIXED=1 SPEC=1"
+
+
+def test_gate_pins_codec_entry():
+    """The streaming wire-path entry must exist and force every frame
+    through the off-loop codec pool: CODEC=1 derives an inline threshold
+    of 0 inside the script (otherwise tiny chaos-sized frames take the
+    inline fast path and the ordered-drain/backpressure machinery under
+    test never runs), replays the wire-pipeline tests, and pairs with
+    CORRUPT so in-flight corruption of pooled decodes is caught by the
+    integrity layer and ledgered as a recovery."""
+    src = (REPO / "scripts" / "chaos.sh").read_text()
+    entries = re.findall(r'^\s+"([^"]+)"$', src, flags=re.M)
+    codec = [e for e in entries if "CODEC=1" in e]
+    assert codec, "no streaming wire-path entry in the chaos matrix"
+    assert any("tests/test_wire_pipeline.py" in e for e in codec), (
+        "CODEC entry does not replay the wire-pipeline tests"
+    )
+    assert all("CORRUPT=" in e for e in codec), (
+        "CODEC entry runs without Byzantine corruption; pooled-decode "
+        "integrity goes untested"
+    )
+    # the derivation lives in the script: without inline=0 the pipeline
+    # silently short-circuits for small frames and the entry is vacuous
+    assert re.search(
+        r'if \[ "\$\{CODEC\}" != "0" \]; then\s*\n\s*wire_inline=0', src,
+    ), "CODEC does not derive BBTPU_WIRE_PIPELINE_INLINE=0"
+    assert "BBTPU_WIRE_PIPELINE_INLINE=${wire_inline}" in src, (
+        "derived inline threshold never reaches the test environment"
+    )
+    assert "BBTPU_WIRE_PIPELINE=1" in src, (
+        "chaos entries run without the wire pipeline pinned on"
+    )
 
 
 def test_red_entry_prints_full_reproduction_line():

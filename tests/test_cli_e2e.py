@@ -117,18 +117,6 @@ def test_cli_registry_server_client_health(tmp_path):
 
         asyncio.run(wait_complete())
 
-        # health probe sees a complete swarm
-        health = subprocess.run(
-            [sys.executable, "-c",
-             _BOOT.format(
-                 mod="health",
-                 args=["tiny", "--num-blocks", "2", "--registry",
-                       f"127.0.0.1:{reg_port}"],
-             )],
-            capture_output=True, text=True, timeout=60,
-        )
-        assert "COMPLETE" in health.stdout, health.stdout + health.stderr
-
         # client generate through the CLI-launched swarm == HF greedy
         async def client_generate():
             from bloombee_tpu.client.model import DistributedModelForCausalLM
@@ -153,6 +141,25 @@ def test_cli_registry_server_client_health(tmp_path):
         # HF may stop early at its eos token; the generated prefix must match
         assert ref.shape[1] > prompt.shape[1]
         np.testing.assert_array_equal(ids[:, : ref.shape[1]], ref)
+
+        # ONE health invocation, in probe mode, after real traffic: sees
+        # the complete swarm AND must surface the wire-path counters —
+        # bytes shipped vs raw (the bytes/token floor) and the off-loop
+        # codec pipeline state, the BB006 no-log-access operator surface
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             _BOOT.format(
+                 mod="health",
+                 args=["tiny", "--num-blocks", "2", "--registry",
+                       f"127.0.0.1:{reg_port}", "--probe"],
+             )],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert "COMPLETE" in probe.stdout, probe.stdout + probe.stderr
+        assert "[reachable]" in probe.stdout, probe.stdout
+        assert "tx_wire_bytes=" in probe.stdout, probe.stdout
+        assert "pipeline=on" in probe.stdout, probe.stdout
+        assert "rx_jobs=" in probe.stdout, probe.stdout
     finally:
         for p in procs:
             p.terminate()
