@@ -349,6 +349,25 @@ def _emit_json_locked():
         out["autoscale_preinstall_token_identical"] = bool(
             pre.get("token_identical", False)
         )
+    sim = RESULTS.get("swarm_sim")
+    if sim:
+        # swarm-scale simulation (virtual clock, real control plane over
+        # the calibrated cost model — no device work): post-perturbation
+        # convergence and client-measured retry amplification, so
+        # control-plane regressions surface in the same JSON the device
+        # phases do. The blocking gate is `python -m bloombee_tpu.sim
+        # --require` in chaos.sh; here the numbers just ride along.
+        for scen, sm in sim.items():
+            out[f"sim_{scen}_completed"] = int(sm.get("completed", 0))
+            out[f"sim_{scen}_retry_amp"] = round(
+                sm.get("retry_amplification", 0.0), 2
+            )
+            out[f"sim_{scen}_converged_at_s"] = round(
+                sm.get("shed_rate_converged_at_s", 0.0), 1
+            )
+            out[f"sim_{scen}_gate_failures"] = len(
+                sm.get("gate_failures") or []
+            )
     if RESULTS.get("phases"):
         out["phases"] = RESULTS["phases"]
     if RESULTS.get("compile_stats"):
@@ -864,6 +883,19 @@ def main():
         phase("wire", f"failed: {e!r}"[:200])
         RESULTS.setdefault("degraded", f"wire phase failed: {e!r}")
         log(f"wire phase FAILED: {e!r}")
+
+    # ---- swarm_sim phase: the traffic simulator's scenario sweep at
+    # smoke size (virtual clock, real control plane, zero device work) —
+    # flash crowd, correlated span loss, diurnal ramp — so the
+    # metastability metrics land in the bench JSON next to the device
+    # numbers they ultimately protect
+    try:
+        phase("swarm_sim", "started")
+        run_swarm_sim()
+    except Exception as e:  # noqa: BLE001
+        phase("swarm_sim", f"failed: {e!r}"[:200])
+        RESULTS.setdefault("degraded", f"swarm_sim phase failed: {e!r}")
+        log(f"swarm_sim phase FAILED: {e!r}")
 
     # value: SERVED full-model-equivalent PER-SEQUENCE decode tok/s (batch 8
     # session through registry + BlockServer + wire); baseline 35 tok/s =
@@ -2668,6 +2700,39 @@ def run_wire(spec, params, smoke: bool) -> None:
         f"DELAY(p={DELAY_P}, {DELAY_S * 1000:.0f} ms); token-identical "
         f"across all legs"
     )
+
+
+def run_swarm_sim() -> None:
+    """Swarm-scale traffic simulation on the virtual clock: the REAL
+    control plane (admission, promotion loop, measured rebalancing,
+    Dijkstra routing with penalty classes) over the calibrated cost
+    model, no device work at all. Always smoke-sized here — the bench
+    wants the trend line, while `python -m bloombee_tpu.sim --require`
+    owns the CI-scale blocking gate."""
+    from bloombee_tpu.sim import SCENARIOS, run_scenario
+
+    simr = RESULTS.setdefault("swarm_sim", {})
+    for name in SCENARIOS:
+        rep = run_scenario(name, sessions=200)
+        m = rep["metrics"]
+        simr[name] = {
+            "sessions": m["sessions"],
+            "completed": m["completed"],
+            "shed_total": m["shed_total"],
+            "retry_amplification": m["retry_amplification"],
+            "shed_retry_amplification": m["shed_retry_amplification"],
+            "shed_rate_converged_at_s": m["shed_rate_converged_at_s"],
+            "promotions": m["promotions"],
+            "rebalances_moved": m["rebalances_moved"],
+            "gate_failures": rep["failures"],
+            "wall_s": rep["wall_s"],
+        }
+        log(
+            f"swarm_sim {name}: {m['completed']}/{m['sessions']} done, "
+            f"amp {m['retry_amplification']:.2f}, "
+            f"{len(rep['failures'])} gate failure(s), {rep['wall_s']}s"
+        )
+    phase("swarm_sim", "ok")
 
 
 def run_integrity(spec, params, smoke: bool) -> None:

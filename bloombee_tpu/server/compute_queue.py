@@ -109,10 +109,14 @@ class ComputeQueue:
         max_group: int = 8,
         compat: Callable[[list, "_GroupTask"], bool] | None = None,
         group_hint: Callable[[list], int] | None = None,
+        executor: ThreadPoolExecutor | None = None,
     ) -> None:
         self._queue: asyncio.PriorityQueue = asyncio.PriorityQueue()
         self._seq = itertools.count()
-        self._thread = ThreadPoolExecutor(
+        # injectable for simulation (a counting executor lets a
+        # discrete-event driver see exactly when compute is in flight);
+        # default is the same single worker thread as always
+        self._thread = executor or ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="compute"
         )
         self._worker_task: asyncio.Task | None = None

@@ -1,0 +1,257 @@
+"""Standby promotion/demotion loop, factored as a mixin.
+
+The elastic self-healing state machine — standby watches the span's
+serving replicas and promotes on sustained overload or span death,
+promoted replicas resolve storms and drain back once the span cools — is
+pure control-plane logic over a handful of host attributes (`registry`,
+`model_uid`, `server_id`, span bounds, the standby/promoted/draining
+flags, watermarks, and the promotion counters). Factoring it out of
+BlockServer lets the swarm simulator (`bloombee_tpu/sim/`) run the REAL
+promotion code against simulated servers: the sim host provides the same
+attribute surface and inherits this mixin, so every watermark, dwell
+window, jitter guard, and storm-resolution rule measured in simulation
+is byte-for-byte the one production runs.
+
+Host contract (attributes the mixin reads; see BlockServer.__init__):
+  registry, model_uid, server_id, start_block, end_block,
+  _standby, _promoted, _draining, _sessions,
+  promote_high_ms, promote_low_ms, promote_sustain_s, promote_jitter_s,
+  announce_period, drain_timeout, _promote_rng,
+  promotions, demotions, promotions_yielded, demotions_aborted,
+  manager.prefix_stats(), _announce(state) coroutine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from bloombee_tpu.swarm.data import ServerState
+from bloombee_tpu.utils import clock, ledger
+
+logger = logging.getLogger(__name__)
+
+
+class PromotionLoopMixin:
+    # --------------------------------------------- standby promotion loop
+    async def _promotion_loop(self) -> None:
+        """The standby side of elastic self-healing. While standby: watch
+        the span's serving replicas and promote on sustained overload
+        (best server past promote_high_ms for promote_sustain_s) or span
+        loss (a block with no live ONLINE server — advert silence past the
+        registry lease). While promoted: resolve promotion storms (all but
+        the lexicographically-smallest promoted replica yield) and drain
+        back to standby once the span's OTHER servers stay cool below
+        promote_low_ms for the sustain window — the high/low gap plus the
+        dwell time is the hysteresis that stops replica flapping."""
+
+        tick = max(
+            0.1,
+            min(self.announce_period, max(self.promote_sustain_s, 0.2) / 2),
+        )
+        hot_since: float | None = None
+        cool_since: float | None = None
+        while True:
+            await clock.async_sleep(tick)
+            if self._draining:
+                return
+            try:
+                if self._standby:
+                    cool_since = None
+                    reason = await self._span_needs_me()
+                    if reason is None:
+                        hot_since = None
+                        continue
+                    now = clock.monotonic()
+                    if reason == "hot":
+                        # sustained-overload dwell; a dead span promotes
+                        # without one (there is nobody left to flap with)
+                        if hot_since is None:
+                            hot_since = now
+                        if now - hot_since < self.promote_sustain_s:
+                            continue
+                    # storm guard: jittered delay, then RE-CHECK — a peer
+                    # standby that promoted during our sleep clears the
+                    # trigger (span covered again / best server cool)
+                    await clock.async_sleep(
+                        self._promote_rng.uniform(0, self.promote_jitter_s)
+                    )
+                    if await self._span_needs_me() is None:
+                        hot_since = None
+                        continue
+                    await self._promote(reason)
+                    hot_since = None
+                elif self._promoted:
+                    hot_since = None
+                    # post-declare re-check: concurrent promotions that
+                    # slipped past the jitter window resolve here
+                    if await self._resolve_promotion_storm():
+                        cool_since = None
+                        continue
+                    if await self._span_cooled():
+                        now = clock.monotonic()
+                        if cool_since is None:
+                            cool_since = now
+                        if now - cool_since >= self.promote_sustain_s:
+                            await self._demote()
+                            cool_since = None
+                    else:
+                        cool_since = None
+                else:
+                    return  # demoted back to plain standby duty is handled
+                    # by the _standby branch; a primary never runs this loop
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # registry flap: keep watching — a standby that gives up
+                # on a transient error is a standby that never fails over
+                logger.warning("promotion check failed: %s", e)
+
+    async def _span_pressure(self) -> float | None:
+        """Worst-case best-server queue delay (ms) across this span's
+        blocks, counting only OTHER ONLINE servers: for each block, the
+        minimum predicted queue delay over its live serving replicas (a
+        cool replica anywhere absorbs that block's traffic), maximized
+        over blocks (the hottest uncovered-by-cool-capacity block gates
+        the span). None = some block has no other live server at all.
+        Adverts are untrusted: the delay term is the shared bounded /
+        staleness-discounted swarm/load.py reading."""
+        from bloombee_tpu.swarm.load import predicted_queue_delay_s
+
+        infos = await self.registry.get_module_infos(
+            self.model_uid, range(self.start_block, self.end_block)
+        )
+        worst = 0.0
+        for info in infos:
+            servers = [
+                s for sid, s in (info.servers if info else {}).items()
+                if sid != self.server_id and s.state == ServerState.ONLINE
+            ]
+            if not servers:
+                return None
+            best = min(
+                predicted_queue_delay_s(s) * 1000.0 for s in servers
+            )
+            worst = max(worst, best)
+        return worst
+
+    async def _span_needs_me(self) -> str | None:
+        """Why this standby should promote right now: 'dead' (a span block
+        has no live server) / 'hot' (best coverage past the high
+        watermark) / None (span is fine)."""
+        pressure = await self._span_pressure()
+        if pressure is None:
+            return "dead"
+        if pressure >= self.promote_high_ms:
+            return "hot"
+        return None
+
+    async def _span_cooled(self) -> bool:
+        """Demotion trigger: every span block is covered by OTHER live
+        servers AND the worst best-server delay sits below the low
+        watermark — never drain back the span's sole coverage."""
+        pressure = await self._span_pressure()
+        return pressure is not None and pressure <= self.promote_low_ms
+
+    async def _promote(self, reason: str) -> None:
+        """Standby -> serving replica: flip the flags and declare the span
+        ONLINE. The replicated KV shipped to us via kv_put already sits in
+        the prefix pool as cached entries, so recovering sessions resume
+        off it (prefix probe) the moment routing can see us; nothing needs
+        re-installing."""
+        stats = self.manager.prefix_stats()
+        self._standby = False
+        self._promoted = True
+        self.promotions += 1
+        ledger.recovery("server.promotion")
+        logger.warning(
+            "standby %s PROMOTING to serve %s[%d:%d) (%s; %d replicated "
+            "pages warm)", self.server_id, self.model_uid,
+            self.start_block, self.end_block, reason,
+            stats.get("repl_pages_installed", 0),
+        )
+        # declare immediately — the periodic announce loop may be most of
+        # a period away, and a dead span bleeds sessions every second. A
+        # registry flap here is non-fatal: we stay promoted and the
+        # announce loop's next pass re-declares.
+        try:
+            await self._announce(ServerState.ONLINE)
+        except Exception as e:
+            logger.warning("promotion announce failed (will retry): %s", e)
+
+    async def _resolve_promotion_storm(self) -> bool:
+        """After declaring, check for sibling promoted replicas of this
+        exact span: if any has a lexicographically smaller server_id, WE
+        yield (demote back) so N racing standbys converge on exactly one
+        promoted replica. Returns True when this server yielded."""
+        infos = await self.registry.get_module_infos(
+            self.model_uid, range(self.start_block, self.end_block)
+        )
+        siblings: set[str] = set()
+        for info in infos:
+            for sid, s in (info.servers if info else {}).items():
+                if (
+                    sid != self.server_id
+                    and s.state == ServerState.ONLINE
+                    and s.promoted_standby
+                    and s.start_block == self.start_block
+                    and s.end_block == self.end_block
+                ):
+                    siblings.add(sid)
+        if not siblings or min(siblings) > self.server_id:
+            return False
+        logger.warning(
+            "promotion storm: %s yields %s[%d:%d) to promoted sibling %s",
+            self.server_id, self.model_uid, self.start_block,
+            self.end_block, min(siblings),
+        )
+        await self._demote(yielded=True)
+        return True
+
+    async def _demote(self, yielded: bool = False) -> bool:
+        """Serving replica -> standby, gracefully: refuse NEW sessions at
+        once (standby flag + DRAINING advert), wait out open sessions up
+        to drain_timeout, then declare JOINING. If sessions outlive the
+        window the demotion ABORTS (re-announce ONLINE, retry later) —
+        drain-back must never strand live streams on an unroutable
+        server."""
+
+        self._standby = True  # session opens now refuse; open streams live
+        try:
+            await self._announce(ServerState.DRAINING)
+        except Exception as e:
+            logger.warning("demotion announce failed: %s", e)
+        deadline = clock.monotonic() + self.drain_timeout
+        while self._sessions and clock.monotonic() < deadline:
+            await clock.async_sleep(0.1)
+        if self._sessions and not yielded:
+            # a yielded storm-duplicate demotes regardless: its sibling
+            # serves the span, and any session that raced onto us replays
+            # there via the ordinary session_lost path
+            self._standby = False
+            self.demotions_aborted += 1
+            logger.warning(
+                "demotion aborted: %d session(s) outlived the %.0fs "
+                "drain; staying promoted", len(self._sessions),
+                self.drain_timeout,
+            )
+            try:
+                await self._announce(ServerState.ONLINE)
+            except Exception as e:
+                logger.warning("demotion-abort announce failed: %s", e)
+            return False
+        self._promoted = False
+        if yielded:
+            self.promotions_yielded += 1
+        else:
+            self.demotions += 1
+        logger.warning(
+            "replica %s demoted back to standby for %s[%d:%d)",
+            self.server_id, self.model_uid, self.start_block,
+            self.end_block,
+        )
+        try:
+            await self._announce(ServerState.JOINING)
+        except Exception as e:
+            logger.warning("standby announce failed: %s", e)
+        return True

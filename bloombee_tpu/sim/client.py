@@ -1,0 +1,277 @@
+"""Virtual sessions: the client half of the simulated swarm.
+
+Each session routes with a REAL ``RemoteSequenceManager`` — Dijkstra over
+live spans with load-advert edge costs, fault bans, overload backoff with
+retry-after floors, and half-open probes are all the production code.
+The wire is a virtual RTT; the retry policy around it is the one
+``client/session.py`` implements: shed → note_peer_overloaded + sleep the
+server's retry-after hint; unreachable → ban_peer + immediate reroute;
+no route at all → short fixed backoff and re-resolve.
+
+Retry amplification — session-open attempts that actually REACHED a
+server, divided by sessions — is measured HERE, because this loop is
+where a mis-tuned retry hint turns one flash crowd into a permanent
+stampede (the metastable failure the ``--require`` gate exists to
+catch). Naive (gateway) sessions additionally model the classic
+metastable amplifier: a client that gives up waiting for its first
+token ABANDONS the attempt and retries, while the abandoned prefill
+keeps burning on the server's queue — zombie work the next attempt
+re-adds. The server's retry-after hint is the only thing pacing that
+population.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+
+from bloombee_tpu.client.sequence_manager import (
+    MissingBlocksError,
+    RemoteSequenceManager,
+)
+from bloombee_tpu.sim.node import SimOverloaded, SimUnreachable
+from bloombee_tpu.utils import clock
+
+logger = logging.getLogger(__name__)
+
+NO_ROUTE_BACKOFF_S = 0.5  # re-resolve cadence while the span is dark
+RETRY_HINT_CAP_S = 30.0  # mirror of the admission controller's cap
+NAIVE_RETRY_FLOOR_S = 0.25  # a naive client's minimum re-try cadence —
+# at or below the stock BBTPU_ADMIT_RETRY_MS, so it never masks a sane
+# hint, while a mis-tuned 1ms hint still means 4 hammer-attempts/second
+NAIVE_TTFT_TIMEOUT_S = 10.0  # gateway first-token timeout: past this a
+# naive client abandons the attempt (leaving its queued prefill burning
+# as zombie work) and retries
+
+
+@dataclasses.dataclass
+class SessionSpec:
+    """One generated session: arrival, shape, and patience."""
+
+    session_id: str
+    client_id: str
+    arrival_s: float  # virtual seconds from scenario start
+    prompt_tokens: int
+    decode_tokens: int
+    shared_prefix_tokens: int = 0  # agent-loop system prompt: prefill
+    # skips this many tokens (the prefix-cache hit the real client gets)
+    patience_s: float = 120.0  # gives up past this age
+    naive: bool = False  # True: a gateway/HTTP client with no SDK-side
+    # penalty machinery — it honors ONLY the server's Retry-After hint.
+    # This is the population whose retry storm a mis-tuned
+    # BBTPU_ADMIT_RETRY_MS turns metastable (the SDK's overload-backoff
+    # class floors the hint at seconds, so defended sessions cannot
+    # expose that mis-tuning)
+
+
+@dataclasses.dataclass
+class SessionResult:
+    spec: SessionSpec
+    ttft_s: float | None = None
+    tbts_s: list = dataclasses.field(default_factory=list)
+    attempts: int = 0  # open attempts that reached a server (retry amp)
+    no_route: int = 0  # route resolutions that found no live span
+    abandons: int = 0  # naive first-token timeouts (zombie work left)
+    sheds: int = 0
+    failures: int = 0  # unreachable / mid-stream errors
+    completed: bool = False
+    gave_up: bool = False
+    starved_with_capacity: bool = False
+    finished_at: float | None = None
+
+
+class SimSwarm:
+    """Scenario-scoped world: servers by id, the shared registry, and the
+    cost model (for wire RTTs)."""
+
+    def __init__(self, registry, model_uid: str, num_blocks: int, cost):
+        self.registry = registry
+        self.model_uid = model_uid
+        self.num_blocks = int(num_blocks)
+        self.cost = cost
+        self.servers: dict = {}
+        self.zombies: list = []  # abandoned prefill awaiters (BB010:
+        # handles kept; the queue work they observe burns on regardless)
+
+    def add(self, server) -> None:
+        self.servers[server.server_id] = server
+
+    def adopt_zombie(self, task) -> None:
+        task.add_done_callback(
+            lambda t: None if t.cancelled() else t.exception()
+        )
+        self.zombies.append(task)
+
+    def has_capacity_now(self) -> bool:
+        """Every block is coverable by a live (possibly standby) server —
+        the 'capacity existed' half of the starvation gate."""
+        covered = [False] * self.num_blocks
+        for s in self.servers.values():
+            if s.reachable() and not s._draining:
+                for b in range(s.start_block, s.end_block):
+                    covered[b] = True
+        return all(covered)
+
+    def make_manager(self, rng=None, **kw) -> RemoteSequenceManager:
+        """A real sequence manager wired for simulation: RTTs are
+        pre-recorded from the cost model and pinned fresh (a virtual
+        clock must never trigger the pinger's real-socket re-measure)."""
+        sm = RemoteSequenceManager(
+            self.registry, self.model_uid, self.num_blocks,
+            update_period=2.0, rng=rng, **kw,
+        )
+        sm.pinger.stale_after = 1e18
+        for sid in self.servers:
+            sm.pinger.record(sid, self.cost.hop_rtt_ms / 1000.0)
+        return sm
+
+
+async def run_session(
+    swarm: SimSwarm, sm: RemoteSequenceManager, spec: SessionSpec,
+) -> SessionResult:
+    """Drive one session to completion, giving up past its patience."""
+    res = SessionResult(spec=spec)
+    await clock.async_sleep(spec.arrival_s)
+    started = clock.monotonic()
+    deadline = started + spec.patience_s
+    tokens_out = 0
+    last_token_at: float | None = None
+
+    while tokens_out < spec.decode_tokens:
+        if clock.monotonic() >= deadline:
+            res.gave_up = True
+            res.starved_with_capacity = swarm.has_capacity_now()
+            break
+
+        # ---------------------------------------------- route + open
+        try:
+            await sm.update()
+            route = sm.make_sequence(0, swarm.num_blocks)
+        except MissingBlocksError:
+            res.no_route += 1
+            await clock.async_sleep(NO_ROUTE_BACKOFF_S)
+            continue
+        res.attempts += 1
+        opened = []
+        try:
+            for span in route:
+                server = swarm.servers[span.peer_id]
+                server.open_session(spec.session_id, spec.client_id)
+                opened.append(server)
+            # ------------------------------------------ prefill + decode
+            # replays skip nothing: a failed stream re-prefills its whole
+            # prompt on the new route (that replay IS the amplification)
+            prefill_tokens = max(
+                1, spec.prompt_tokens - spec.shared_prefix_tokens
+            )
+            if spec.naive:
+                if not await _prefill_or_abandon(
+                    swarm, opened, spec, prefill_tokens, started, res
+                ):
+                    continue  # gateway auto-retry; sheds pace the rest
+            else:
+                for server in opened:
+                    await server.prefill(
+                        spec.session_id, spec.client_id, prefill_tokens,
+                        started,
+                    )
+            while tokens_out < spec.decode_tokens:
+                if clock.monotonic() >= deadline:
+                    res.gave_up = True
+                    res.starved_with_capacity = swarm.has_capacity_now()
+                    break
+                await clock.async_sleep(
+                    swarm.cost.hop_rtt_ms / 1000.0 * len(route)
+                )
+                for server in opened:
+                    await server.decode_step(
+                        spec.session_id, spec.client_id
+                    )
+                tokens_out += 1
+                now = clock.monotonic()
+                if res.ttft_s is None:
+                    res.ttft_s = now - started
+                elif last_token_at is not None:
+                    res.tbts_s.append(now - last_token_at)
+                last_token_at = now
+            else:
+                res.completed = True
+                for server in opened:
+                    sm.note_peer_ok(server.server_id)
+            break
+        except SimOverloaded as e:
+            res.sheds += 1
+            retry_s = min(e.retry_after_ms / 1000.0, RETRY_HINT_CAP_S)
+            if spec.naive:
+                await clock.async_sleep(max(retry_s, NAIVE_RETRY_FLOOR_S))
+            else:
+                sm.note_peer_overloaded(_culprit(opened, route), retry_s)
+                await clock.async_sleep(retry_s)
+        except SimUnreachable:
+            res.failures += 1
+            if spec.naive:
+                await clock.async_sleep(NO_ROUTE_BACKOFF_S)
+            else:
+                dead = [s.server_id for s in opened if not s.reachable()]
+                sm.ban_peer(dead[0] if dead else _culprit(opened, route))
+                await sm.update(force=True)
+        except asyncio.CancelledError:
+            # compute died under us (server crash mid-dispatch) — for the
+            # session that is an unreachable peer, not a cancellation of
+            # the session itself (which the engine never issues mid-run)
+            dead = [s.server_id for s in opened if not s.reachable()]
+            if not dead:
+                raise
+            res.failures += 1
+            if not spec.naive:
+                sm.ban_peer(dead[0])
+                await sm.update(force=True)
+        finally:
+            for server in opened:
+                server.close_session(spec.session_id)
+
+    res.finished_at = clock.monotonic()
+    return res
+
+
+async def _prefill_or_abandon(
+    swarm: SimSwarm, opened: list, spec: SessionSpec,
+    prefill_tokens: int, started: float, res: SessionResult,
+) -> bool:
+    """Prefill with a naive client's first-token patience: past
+    ``NAIVE_TTFT_TIMEOUT_S`` the client walks away and retries, but the
+    prefill it queued is NOT cancelled — the server burns that compute
+    for nobody (zombie work). That wasted work re-feeding the very queue
+    that delays it is the textbook metastable-failure amplifier; the
+    admission retry-after hint is what keeps the walked-away population
+    from re-entering in sync."""
+
+    async def all_spans() -> None:
+        for server in opened:
+            await server.prefill(
+                spec.session_id, spec.client_id, prefill_tokens, started
+            )
+
+    pf = asyncio.ensure_future(all_spans())
+    timer = asyncio.ensure_future(
+        clock.async_sleep(NAIVE_TTFT_TIMEOUT_S)
+    )
+    done, _ = await asyncio.wait(
+        {pf, timer}, return_when=asyncio.FIRST_COMPLETED
+    )
+    if pf in done:
+        timer.cancel()
+        pf.result()  # propagate shed/unreachable to the retry handlers
+        return True
+    res.abandons += 1
+    swarm.adopt_zombie(pf)
+    return False
+
+
+def _culprit(opened: list, route: list) -> str:
+    """The peer a failure/shed is charged to: the first hop that had not
+    yet finished opening/serving, else the last opened one."""
+    if len(opened) < len(route):
+        return route[len(opened)].peer_id
+    return opened[-1].server_id if opened else route[-1].peer_id

@@ -1,0 +1,122 @@
+"""Calibrated compute-cost model: what a dispatch costs in device seconds.
+
+The simulator replaces ``SpanExecutor`` with ``clock.sleep(cost)`` on the
+compute thread; this module decides the cost. The shape mirrors the
+measured bench phases (bench.py): a fixed per-dispatch overhead (jit call
++ host sync) plus per-row work for fused ragged decode and per-token work
+for prefill chunks, both scaling with the span's block count.
+
+Defaults are CPU-smoke-bench magnitudes; ``from_bench_json`` refits them
+from a real BENCH JSON (``--cost-json`` / ``BBTPU_SIM_COST_JSON``) so a
+TPU-calibrated simulation costs one flag. The fitter is tolerant: it
+reads whichever of ``chain.steps_per_sec`` / ``decode.tbt_p50_ms`` /
+``prefill.ttft_ms``-style keys the bench emitted and keeps defaults for
+the rest (bench JSONs evolve; a sim that hard-fails on a missing key
+can't consume last month's artifact).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from bloombee_tpu.utils import env
+
+env.declare(
+    "BBTPU_SIM_COST_JSON", str, "",
+    "path to a bench results JSON (bench.py output) to calibrate the "
+    "simulator's compute-cost model from; empty = built-in CPU-smoke "
+    "magnitudes",
+)
+
+
+@dataclasses.dataclass
+class CostModel:
+    """Per-dispatch device-seconds model, all knobs in milliseconds."""
+
+    dispatch_ms: float = 2.0  # fixed jit-call + host-sync overhead
+    decode_row_ms_per_block: float = 0.25  # one decode row, one block
+    prefill_tok_ms_per_block: float = 0.05  # one prefill token, one block
+    hop_rtt_ms: float = 10.0  # client<->server wire round trip
+
+    def decode_group_s(self, rows: int, blocks: int) -> float:
+        """One fused decode dispatch of `rows` coalesced sessions."""
+        return (
+            self.dispatch_ms
+            + self.decode_row_ms_per_block * blocks * max(1, rows)
+        ) / 1000.0
+
+    def prefill_chunk_s(self, tokens: int, blocks: int) -> float:
+        """One prefill-chunk dispatch of `tokens` total tokens."""
+        return (
+            self.dispatch_ms
+            + self.prefill_tok_ms_per_block * blocks * max(1, tokens)
+        ) / 1000.0
+
+    def group_s(self, kind: str, rows: int, tokens: int,
+                blocks: int) -> float:
+        if kind == "decode":
+            return self.decode_group_s(rows, blocks)
+        return self.prefill_chunk_s(tokens, blocks)
+
+    # ------------------------------------------------------------ calibration
+    @classmethod
+    def from_bench_json(
+        cls, source, num_blocks: int = 8
+    ) -> "CostModel":
+        """Fit from a bench results dict or JSON file path. Bench numbers
+        are end-to-end (all spans + wire); the fit attributes the wire
+        share to hop_rtt_ms's default and the rest to per-block compute,
+        which is the right split for *relative* scenario comparisons (the
+        sim's job) even when the absolute split is approximate."""
+        if isinstance(source, (str, bytes)):
+            with open(source) as f:
+                data = json.load(f)
+        else:
+            data = dict(source or {})
+        model = cls()
+        step_ms = None
+        sps = _dig(data, "chain.steps_per_sec", "steps_per_sec")
+        if isinstance(sps, (int, float)) and sps > 0:
+            step_ms = 1000.0 / float(sps)
+        tbt = _dig(data, "decode.tbt_p50_ms", "tbt_p50_ms", "chain.tbt_p50_ms")
+        if isinstance(tbt, (int, float)) and tbt > 0:
+            step_ms = float(tbt) if step_ms is None else min(step_ms, tbt)
+        if step_ms is not None:
+            # one chain step = dispatch + wire + blocks * row cost
+            compute_ms = max(0.1, step_ms - model.dispatch_ms
+                             - model.hop_rtt_ms)
+            model.decode_row_ms_per_block = compute_ms / max(1, num_blocks)
+        ttft = _dig(data, "prefill.ttft_ms", "ttft_ms", "chain.ttft_ms")
+        toks = _dig(data, "prefill.prompt_tokens", "prompt_tokens")
+        if (
+            isinstance(ttft, (int, float)) and ttft > 0
+            and isinstance(toks, (int, float)) and toks > 0
+        ):
+            compute_ms = max(0.1, float(ttft) - model.dispatch_ms
+                             - model.hop_rtt_ms)
+            model.prefill_tok_ms_per_block = compute_ms / (
+                float(toks) * max(1, num_blocks)
+            )
+        return model
+
+    @classmethod
+    def from_env(cls, num_blocks: int = 8) -> "CostModel":
+        path = env.get("BBTPU_SIM_COST_JSON")
+        if path:
+            return cls.from_bench_json(path, num_blocks=num_blocks)
+        return cls()
+
+
+def _dig(data: dict, *dotted: str):
+    """First present dotted key, tolerant of either nesting or flat keys."""
+    for key in dotted:
+        node = data
+        for part in key.split("."):
+            if not isinstance(node, dict) or part not in node:
+                node = None
+                break
+            node = node[part]
+        if node is not None:
+            return node
+    return None

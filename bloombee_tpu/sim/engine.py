@@ -1,0 +1,226 @@
+"""Discrete-event conductor over the SteppableClock.
+
+The control plane under test is ordinary asyncio + worker-thread code; it
+was never written against an event-queue API. The engine therefore drives
+it from the OUTSIDE: let the loop and the compute threads run until the
+process *quiesces* (nothing runnable now, every live thread parked in a
+virtual ``clock.sleep``), then jump the SteppableClock to the earliest
+deadline any sleeper is waiting for. Repeat. Minutes of protocol time
+cost milliseconds of wall time and every dwell window, lease expiry, and
+backoff fires in exact virtual order.
+
+The one genuinely hard part is knowing when compute is mid-flight: a
+``run_in_executor`` callable that has been submitted but has not yet
+reached its cost-model ``clock.sleep`` is invisible to the clock, and
+advancing past it would deliver its completion at the wrong virtual
+instant. ``CountingExecutor`` closes that window with two counters:
+``submit`` increments a *queued* count on the loop thread; the runner
+moves it to *running* the moment the worker picks it up, and decrements
+*running* only AFTER publishing the result to the proxy future —
+publishing runs the ``wrap_future`` callback synchronously, which
+enqueues the asyncio-side resolution via ``call_soon_threadsafe`` — so
+a settled count guarantees every finished compute's wakeup is already in
+the loop's ready queue. The conductor treats compute as settled only
+when every running thread is parked in a virtual sleep AND no executor
+has queued work with an idle worker (a hand-off in flight); queued work
+*behind* a sleeping runner is settled — it cannot start until the clock
+advances, which is exactly what the conductor is about to do.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+
+from bloombee_tpu.utils import clock as clock_mod
+from bloombee_tpu.utils.clock import SteppableClock
+
+
+class SimStalled(RuntimeError):
+    """The simulation can make no progress: live tasks remain but nothing
+    sleeps on the virtual clock (a deadlock in the code under test), or a
+    wall/virtual budget was exhausted."""
+
+
+class CountingExecutor:
+    """ThreadPoolExecutor facade whose in-flight submissions are countable
+    by the conductor. API-compatible with the slice ComputeQueue uses
+    (``submit`` + ``shutdown``)."""
+
+    def __init__(self, engine: "SimEngine"):
+        self._engine = engine
+        # guarded by the engine's lock: submissions the worker has not
+        # picked up yet / runners between pickup and result publication
+        self._queued = 0
+        self._running = 0
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="simcompute"
+        )
+
+    def submit(self, fn, *args, **kwargs):
+        eng = self._engine
+        with eng._plock:
+            self._queued += 1
+        proxy: concurrent.futures.Future = concurrent.futures.Future()
+
+        def runner():
+            with eng._plock:
+                self._queued -= 1
+                self._running += 1
+            if not proxy.set_running_or_notify_cancel():
+                with eng._plock:
+                    self._running -= 1
+                return
+            try:
+                result = fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — relayed to awaiter
+                proxy.set_exception(e)
+            else:
+                # set_result synchronously runs wrap_future's callback,
+                # which call_soon_threadsafe's the asyncio resolution —
+                # decrementing AFTER it means settled implies every
+                # wakeup is already enqueued on the loop
+                proxy.set_result(result)
+            with eng._plock:
+                self._running -= 1
+
+        inner = self._pool.submit(runner)
+
+        def _on_inner(f):
+            # shutdown(cancel_futures=True) cancels queued runners that
+            # never start; without this the queued count would leak and
+            # the conductor would wait forever
+            if f.cancelled():
+                proxy.cancel()
+                with eng._plock:
+                    self._queued -= 1
+
+        inner.add_done_callback(_on_inner)
+        return proxy
+
+    def _settled_locked(self) -> tuple[bool, int]:
+        """(no hand-off in flight, running count). Caller holds the
+        engine lock. Queued work behind a busy (sleeping) worker is
+        settled: it cannot start until virtual time advances."""
+        return (not self._queued or self._running >= 1), self._running
+
+    def shutdown(self, wait: bool = True, cancel_futures: bool = False):
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+class SimEngine:
+    """Owns the SteppableClock, the counting executors, and the
+    quiesce-then-advance conductor loop."""
+
+    def __init__(self, start: float = 1000.0):
+        self.clock = SteppableClock(start=start)
+        self._plock = threading.Lock()
+        self._executors: list[CountingExecutor] = []
+        self.advances = 0  # conductor diagnostics (tests / --json output)
+
+    # ------------------------------------------------------------- executors
+    def new_executor(self) -> CountingExecutor:
+        ex = CountingExecutor(self)
+        self._executors.append(ex)
+        return ex
+
+    def _compute_settled(self) -> bool:
+        """True when no compute thread is between submit and its virtual
+        sleep: every running submission is accounted for by a thread
+        blocked in clock.sleep (or has already published its result), and
+        no executor has queued work its worker is free to start."""
+        running = 0
+        with self._plock:
+            for ex in self._executors:
+                ok, n = ex._settled_locked()
+                if not ok:
+                    return False  # worker hand-off in flight
+                running += n
+        return running <= self.clock.blocked_sleepers()
+
+    # ------------------------------------------------------------- conductor
+    def now(self) -> float:
+        return self.clock.monotonic()
+
+    async def _quiesce(self, loop) -> None:
+        """Run the loop until nothing is immediately runnable and all
+        in-flight compute has parked on the virtual clock."""
+        while True:
+            await asyncio.sleep(0)
+            if getattr(loop, "_ready", None):
+                continue  # more callbacks became runnable; keep draining
+            if not self._compute_settled():
+                # a compute thread is running real code between submit and
+                # its cost-model sleep; give it a hair of real time
+                await asyncio.sleep(0.0002)
+                continue
+            if getattr(loop, "_ready", None):
+                continue
+            return
+
+    async def run_tasks(
+        self,
+        tasks: list,
+        max_virtual_s: float = 3600.0,
+        max_wall_s: float = 300.0,
+    ) -> None:
+        """Drive virtual time until every task in `tasks` is done.
+        Background loops (announcers, promotion watchers, samplers) may
+        keep sleeping; the caller cancels them afterwards."""
+        loop = asyncio.get_running_loop()
+        horizon = self.clock.monotonic() + max_virtual_s
+        wall_end = clock_mod.perf_counter() + max_wall_s
+        idle_rounds = 0
+        while True:
+            await self._quiesce(loop)
+            if all(t.done() for t in tasks):
+                return
+            if clock_mod.perf_counter() > wall_end:
+                raise SimStalled(
+                    f"wall budget exhausted ({max_wall_s:.0f}s) with "
+                    f"{sum(not t.done() for t in tasks)} task(s) live"
+                )
+            if self.clock.monotonic() >= horizon:
+                raise SimStalled(
+                    f"virtual horizon exhausted ({max_virtual_s:.0f}s) "
+                    f"with {sum(not t.done() for t in tasks)} task(s) live"
+                )
+            nd = self.clock.next_deadline()
+            if nd is None:
+                # live tasks but no virtual sleeper: either a thread is
+                # about to park (give it real time) or the code under
+                # test deadlocked (fail loudly, don't hang CI)
+                idle_rounds += 1
+                if idle_rounds > 2000:
+                    raise SimStalled(
+                        "no virtual sleeper and tasks never complete — "
+                        "deadlock in the code under test?"
+                    )
+                await asyncio.sleep(0.0005)
+                continue
+            idle_rounds = 0
+            dt = nd - self.clock.monotonic()
+            if dt <= 0:
+                # a just-woken sync sleeper still holds its (expired)
+                # deadline entry; let its thread run it off
+                await asyncio.sleep(0.0002)
+                continue
+            self.clock.advance(dt)
+            self.advances += 1
+
+    # ------------------------------------------------------------------ run
+    def run(self, coro, *args, **kwargs):
+        """Install the virtual clock process-wide, run `coro` (a coroutine
+        function called with this engine + *args), restore the previous
+        clock, and tear the executors down."""
+        prev = clock_mod.install(self.clock)
+        try:
+            return asyncio.run(coro(self, *args, **kwargs))
+        finally:
+            if prev is None:
+                clock_mod.reset()  # back to lazy env-driven default
+            else:
+                clock_mod.install(prev)
+            for ex in self._executors:
+                ex.shutdown()

@@ -1,0 +1,260 @@
+"""Per-scenario metrics and the metastable-failure convergence gates.
+
+A metastable failure is a swarm that stays broken after its trigger
+clears: shedding that never returns to baseline because retries feed the
+very queue that sheds them, promotion loops that flap a standby in and
+out, sessions starving while capacity sits idle. Point-in-time metrics
+can't see these — they are properties of the TIME SERIES after the
+perturbation — so a sampler records per-virtual-second counter snapshots
+and the gates score the tail of the series.
+
+Gate bounds are env-tunable (declared here, BB005 house style) so a
+deliberately mis-tuned control plane — e.g. ``BBTPU_ADMIT_RETRY_MS=1``,
+which turns every shed into an instant re-stampede — demonstrably FAILS
+while the stock tuning passes: the anti-vacuity contract of
+``python -m bloombee_tpu.sim --require``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+
+from bloombee_tpu.utils import clock, env
+
+env.declare(
+    "BBTPU_SIM_SETTLE_S", float, 45.0,
+    "simulator gate: virtual seconds after a perturbation (flash-crowd "
+    "end, crash, peak passing) by which the swarm's shed rate must have "
+    "returned to zero — the metastability bound",
+)
+env.declare(
+    "BBTPU_SIM_RETRY_AMP_MAX", float, 8.0,
+    "simulator gate: maximum retry amplification (server-reaching "
+    "session-open attempts divided by sessions) before the run counts "
+    "as a retry storm",
+)
+env.declare(
+    "BBTPU_SIM_SHED_AMP_MAX", float, 15.0,
+    "simulator gate: maximum mean open attempts among sessions that got "
+    "shed at least once — retry INTENSITY, scale-invariant where the "
+    "overall amplification dilutes with background traffic volume",
+)
+env.declare(
+    "BBTPU_SIM_FLAP_MAX", int, 6,
+    "simulator gate: maximum promotion+demotion transitions per server "
+    "per scenario before standby behavior counts as flapping",
+)
+env.declare(
+    "BBTPU_SIM_PROMOTE_LATENCY_S", float, 30.0,
+    "simulator gate: virtual seconds from span loss (crash) to the first "
+    "standby promotion",
+)
+
+
+@dataclasses.dataclass
+class Sample:
+    t: float  # virtual seconds since scenario start
+    shed: int  # cumulative shed_requests+shed_sessions across servers
+    promotions: int
+    demotions: int
+    rebalances: int
+    capacity_ok: bool
+
+
+class Sampler:
+    """Once per virtual second, snapshot the swarm's cumulative counters.
+    Runs as a background task; the scenario cancels it after the session
+    population completes."""
+
+    def __init__(self, swarm, start_t: float):
+        self.swarm = swarm
+        self.start_t = start_t
+        self.samples: list[Sample] = []
+
+    def snap(self) -> None:
+        shed = promos = demos = rebal = 0
+        for s in self.swarm.servers.values():
+            shed += s.admission.shed_requests + s.admission.shed_sessions
+            promos += s.promotions
+            demos += s.demotions + s.promotions_yielded
+            rebal += s.rebalances_moved
+        self.samples.append(Sample(
+            t=clock.monotonic() - self.start_t,
+            shed=shed, promotions=promos, demotions=demos,
+            rebalances=rebal, capacity_ok=self.swarm.has_capacity_now(),
+        ))
+
+    async def run(self) -> None:
+        while True:
+            self.snap()
+            await clock.async_sleep(1.0)
+
+
+def percentile(xs: list, p: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(p / 100.0 * len(xs)))
+    return float(xs[i])
+
+
+def last_shed_time(samples: list[Sample]) -> float:
+    """Virtual time of the last sample interval in which anything shed."""
+    last, prev = 0.0, 0
+    for s in samples:
+        if s.shed > prev:
+            last = s.t
+        prev = s.shed
+    return last
+
+
+def first_promotion_time(samples: list[Sample]) -> float | None:
+    for s in samples:
+        if s.promotions > 0:
+            return s.t
+    return None
+
+
+def evaluate(
+    name: str,
+    results: list,
+    samples: list[Sample],
+    servers: dict,
+    *,
+    perturb_end_t: float | None = None,  # crowd end / crash / peak, in
+    # scenario-relative virtual seconds; None = no settle gate
+    expect_shed: bool = False,
+    expect_promotion: bool = False,
+    expect_rebalance: bool = False,
+    min_complete_frac: float = 0.97,
+) -> tuple[dict, list[str]]:
+    """Score one scenario: (metrics json, gate-failure strings)."""
+    settle_s = float(env.get("BBTPU_SIM_SETTLE_S"))
+    amp_max = float(env.get("BBTPU_SIM_RETRY_AMP_MAX"))
+    shed_amp_max = float(env.get("BBTPU_SIM_SHED_AMP_MAX"))
+    flap_max = int(env.get("BBTPU_SIM_FLAP_MAX"))
+    promote_max_s = float(env.get("BBTPU_SIM_PROMOTE_LATENCY_S"))
+
+    ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
+    tbts = [x for r in results for x in r.tbts_s]
+    n = max(1, len(results))
+    completed = sum(r.completed for r in results)
+    starved = sum(r.starved_with_capacity for r in results)
+    attempts = sum(r.attempts for r in results)
+    sheds = sum(r.sheds for r in results)
+    amp = attempts / n
+    shed_hit = [r for r in results if r.sheds > 0]
+    shed_amp = (
+        sum(r.attempts for r in shed_hit) / len(shed_hit)
+        if shed_hit else 0.0
+    )
+    shed_end = last_shed_time(samples)
+    promo_t = first_promotion_time(samples)
+
+    flap = {
+        sid: s.promotions + s.demotions + s.promotions_yielded
+        for sid, s in servers.items()
+    }
+    counters = {sid: s.stats() for sid, s in servers.items()}
+    total_shed = sum(
+        s.admission.shed_requests + s.admission.shed_sessions
+        for s in servers.values()
+    )
+    metrics = {
+        "sessions": len(results),
+        "completed": completed,
+        "gave_up": sum(r.gave_up for r in results),
+        "starved_with_capacity": starved,
+        "ttft_p50_s": percentile(ttfts, 50),
+        "ttft_p95_s": percentile(ttfts, 95),
+        "tbt_p50_s": percentile(tbts, 50),
+        "tbt_p95_s": percentile(tbts, 95),
+        "shed_total": total_shed,
+        "shed_rate_converged_at_s": shed_end,
+        "retry_amplification": amp,
+        "shed_retry_amplification": shed_amp,
+        "session_sheds": sheds,
+        "no_route_total": sum(r.no_route for r in results),
+        "abandons": sum(r.abandons for r in results),
+        "promotion_latency_s": (
+            None if promo_t is None or perturb_end_t is None
+            else max(0.0, promo_t - perturb_end_t)
+        ),
+        "promotions": sum(s.promotions for s in servers.values()),
+        "demotions": sum(s.demotions for s in servers.values()),
+        "rebalances_moved": sum(
+            s.rebalances_moved for s in servers.values()
+        ),
+        "max_flap": max(flap.values()) if flap else 0,
+    }
+
+    failures: list[str] = []
+    if starved:
+        failures.append(
+            f"{name}: {starved} session(s) starved past their deadline "
+            "while swarm capacity existed"
+        )
+    if completed < min_complete_frac * len(results):
+        failures.append(
+            f"{name}: only {completed}/{len(results)} sessions completed "
+            f"(gate {min_complete_frac:.0%})"
+        )
+    if amp > amp_max:
+        failures.append(
+            f"{name}: retry amplification {amp:.2f} exceeds "
+            f"{amp_max:.2f} — retry storm (metastable)"
+        )
+    if shed_amp > shed_amp_max:
+        failures.append(
+            f"{name}: shed sessions averaged {shed_amp:.1f} open "
+            f"attempts each (gate {shed_amp_max:.1f}) — under-hinted "
+            "retries hammering the shedding swarm (metastable)"
+        )
+    if perturb_end_t is not None and shed_end > perturb_end_t + settle_s:
+        failures.append(
+            f"{name}: shedding still active {shed_end - perturb_end_t:.0f}s "
+            f"after the perturbation cleared (settle bound {settle_s:.0f}s) "
+            "— the swarm did not converge (metastable)"
+        )
+    worst_flap = max(flap.values()) if flap else 0
+    if worst_flap > flap_max:
+        failures.append(
+            f"{name}: {worst_flap} promotion/demotion transitions on one "
+            f"server (gate {flap_max}) — standby flapping"
+        )
+    if expect_shed and total_shed == 0:
+        failures.append(
+            f"{name}: expected overload shedding but none occurred — "
+            "scenario lost its teeth (vacuous run)"
+        )
+    if expect_promotion:
+        if metrics["promotions"] < 1:
+            failures.append(
+                f"{name}: expected a standby promotion but none happened"
+            )
+        elif (
+            metrics["promotion_latency_s"] is not None
+            and metrics["promotion_latency_s"] > promote_max_s
+        ):
+            failures.append(
+                f"{name}: promotion took "
+                f"{metrics['promotion_latency_s']:.0f}s "
+                f"(gate {promote_max_s:.0f}s)"
+            )
+    if expect_rebalance and metrics["rebalances_moved"] < 1:
+        failures.append(
+            f"{name}: expected a measured-load rebalance move but none "
+            "happened"
+        )
+    return {"metrics": metrics, "counters": counters}, failures
+
+
+async def cancel_quietly(tasks: list) -> None:
+    for t in tasks:
+        t.cancel()
+    for t in tasks:
+        try:
+            await t
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass

@@ -1,0 +1,369 @@
+"""SimServer: a swarm node whose control plane is the production code.
+
+What is REAL here: the ComputeQueue (priority scheduling, group
+coalescing, gather windows, wait-percentile gauges), the
+AdmissionController (fair-share shedding with retry-after hints), the
+standby promotion/demotion state machine (PromotionLoopMixin — the exact
+BlockServer code), measured rebalancing (block_selection.
+rebalance_if_needed against this server's duck-typed surface), registry
+leases (InProcessRegistry expiry is the failure detector), and the load
+adverts every peer routes by. What is simulated: the matmul — a
+cost-model ``clock.sleep`` on the compute thread — and process death.
+
+Faults arrive via the production ``wire/faults.py`` schedule: every
+decode dispatch on this server ticks ``FaultSchedule.due`` with this
+server's (host, port) as the peer, so scenario scripts use the same
+"crash at decode step N on port P" vocabulary chaos e2e tests use.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import types
+
+from bloombee_tpu.server.admission import AdmissionController
+from bloombee_tpu.server.block_selection import rebalance_if_needed
+from bloombee_tpu.server.compute_queue import ComputeQueue
+from bloombee_tpu.server.promotion import PromotionLoopMixin
+from bloombee_tpu.swarm.data import ServerInfo, ServerState
+from bloombee_tpu.utils import clock, ledger
+
+logger = logging.getLogger(__name__)
+
+
+class SimUnreachable(RuntimeError):
+    """The peer is crashed or partitioned (wire-level failure)."""
+
+
+class SimOverloaded(RuntimeError):
+    """Admission shed: carries the server's retry-after hint."""
+
+    def __init__(self, retry_after_ms: int):
+        super().__init__(f"shed; retry after {retry_after_ms}ms")
+        self.retry_after_ms = int(retry_after_ms)
+
+
+class _PrefixStatsStub:
+    """Promotion logs warm-page counts from manager.prefix_stats(); the
+    sim has no KV arena, so the count is honestly zero."""
+
+    def prefix_stats(self) -> dict:
+        return {}
+
+
+class SimServer(PromotionLoopMixin):
+    def __init__(
+        self,
+        engine,
+        registry,
+        model_uid: str,
+        server_id: str,
+        start_block: int,
+        end_block: int,
+        num_model_blocks: int,
+        cost,
+        *,
+        port: int,
+        standby: bool = False,
+        throughput: float = 1.0,
+        announce_period: float = 2.0,
+        lease_s: float = 6.0,
+        admission: AdmissionController | None = None,
+        promote_high_ms: float = 600.0,
+        promote_low_ms: float = 150.0,
+        promote_sustain_s: float = 4.0,
+        promote_jitter_s: float = 1.0,
+        drain_timeout: float = 20.0,
+        rebalance_period: float = 0.0,  # 0 = rebalancing off
+        chunk_tokens: int = 256,
+        max_group: int = 8,
+        cost_scale: float = 1.0,  # slow host: actual compute is this many
+        # times the model's cost while the ADVERT still claims nominal
+        # throughput — the mismatch only measured rebalancing can see
+        rng=None,
+        faults=None,  # wire/faults.py FaultSchedule, shared per scenario
+    ):
+        self.engine = engine
+        self.registry = registry
+        self.model_uid = model_uid
+        self.server_id = server_id
+        self.start_block = int(start_block)
+        self.end_block = int(end_block)
+        self.num_model_blocks = int(num_model_blocks)
+        self.cost = cost
+        self.host, self.port = "sim", int(port)
+        self.throughput = float(throughput)
+        self.announce_period = float(announce_period)
+        self.lease_s = float(lease_s)
+        self.chunk_tokens = int(chunk_tokens)
+        self.cost_scale = float(cost_scale)
+        self.faults = faults
+        if faults is not None:
+            faults.bind_crash(server_id, self.crash)
+
+        # promotion-mixin host contract (see server/promotion.py docstring)
+        self._standby = bool(standby)
+        self._promoted = False
+        self._draining = False
+        self._sessions: dict[str, str] = {}
+        self.promote_high_ms = float(promote_high_ms)
+        self.promote_low_ms = float(promote_low_ms)
+        self.promote_sustain_s = float(promote_sustain_s)
+        self.promote_jitter_s = float(promote_jitter_s)
+        self.drain_timeout = float(drain_timeout)
+        self._promote_rng = rng or random.Random(
+            int.from_bytes(server_id.encode(), "little") & 0xFFFF
+        )
+        self.promotions = 0
+        self.demotions = 0
+        self.promotions_yielded = 0
+        self.demotions_aborted = 0
+        self.manager = _PrefixStatsStub()
+
+        # rebalance contract (block_selection.rebalance_if_needed)
+        self.rebalance_period = float(rebalance_period)
+        self.spec = types.SimpleNamespace(num_hidden_layers=num_model_blocks)
+        self.rebalances_moved = 0
+        self.rebalances_failed = 0
+        self.rebalance_skipped_hysteresis = 0
+        self.rebalance_last_move_at: float | None = None
+
+        # real data plane control: queue + admission on env-default knobs
+        # (an AdmissionController() here reads BBTPU_ADMIT_* exactly like
+        # production — that is what lets a mis-tuned env knob fail gates)
+        self.compute = ComputeQueue(
+            max_group=max_group, executor=engine.new_executor()
+        )
+        self.admission = admission or AdmissionController()
+
+        # fault state
+        self._crashed = False
+        self.crashed_at: float | None = None
+        self._unreachable_until = 0.0
+        self.extra_delay_s = 0.0  # degradation: added to every dispatch
+        self._tasks: list = []
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        import asyncio
+
+        self.compute.start()
+        for coro in (self._announce_loop(),) + (
+            (self._promotion_loop(),) if self._standby else ()
+        ) + (
+            (self._rebalance_loop(),) if self.rebalance_period > 0 else ()
+        ):
+            self._tasks.append(asyncio.create_task(coro))
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self.compute.kill()
+
+    def crash(self) -> None:
+        """Hard process death: compute dies mid-flight, adverts stop, the
+        registry lease expires and the swarm routes around the corpse."""
+        if self._crashed:
+            return
+        self._crashed = True
+        self.crashed_at = clock.monotonic()
+        ledger.fault("server.crash")
+        logger.warning("sim server %s CRASHED at t=%.1f", self.server_id,
+                       clock.monotonic())
+        self.stop()
+
+    def reachable(self) -> bool:
+        return (
+            not self._crashed
+            and clock.monotonic() >= self._unreachable_until
+        )
+
+    # -------------------------------------------------------------- sessions
+    def open_session(self, session_id: str, client_id: str) -> None:
+        """Session-open RPC: refused while standby/draining (the real
+        session-open asymmetry), shed by the REAL admission controller on
+        NEW work only — established steps never re-consult it."""
+        if not self.reachable():
+            raise SimUnreachable(self.server_id)
+        if self._standby or self._draining:
+            raise SimUnreachable(f"{self.server_id} not serving")
+        retry = self.admission.admit_new(
+            client_id,
+            self.compute.current_delay_ms(self.admission.window_s),
+        )
+        if retry is not None:
+            self.admission.shed_sessions += 1
+            raise SimOverloaded(retry)
+        self._sessions[session_id] = client_id
+
+    def close_session(self, session_id: str) -> None:
+        self._sessions.pop(session_id, None)
+
+    # --------------------------------------------------------------- compute
+    async def prefill(
+        self, session_id: str, client_id: str, tokens: int,
+        stream_started_at: float,
+    ) -> None:
+        """Chunked prefill through the real queue: each chunk rides at the
+        aged chunk priority so old streams' chunks outrank fresh ones."""
+        from bloombee_tpu.server.compute_queue import aged_chunk_priority
+
+        remaining = int(tokens)
+        while remaining > 0:
+            chunk = min(self.chunk_tokens, remaining)
+            remaining -= chunk
+            await self._dispatch(
+                "prefill", chunk, aged_chunk_priority(stream_started_at),
+                client_id,
+            )
+
+    async def decode_step(self, session_id: str, client_id: str) -> None:
+        from bloombee_tpu.server.compute_queue import PRIORITY_INFERENCE
+
+        await self._dispatch("decode", 1, PRIORITY_INFERENCE, client_id)
+        self._tick_faults()
+
+    async def _dispatch(
+        self, kind: str, tokens: int, priority: float, client_id: str
+    ) -> None:
+        if not self.reachable():
+            raise SimUnreachable(self.server_id)
+        await self.compute.submit_group(
+            priority, (kind,), {"tokens": tokens},
+            self._make_run_group(kind), task_class=kind,
+        )
+        if not self.reachable():  # crashed/partitioned while computing:
+            raise SimUnreachable(self.server_id)  # the reply never lands
+        self.admission.note_tokens(client_id, tokens)
+
+    def _make_run_group(self, kind: str):
+        cost, blocks = self.cost, self.end_block - self.start_block
+
+        def run(payloads: list) -> list:
+            toks = sum(int(p["tokens"]) for p in payloads)
+            clock.sleep(
+                cost.group_s(kind, len(payloads), toks, blocks)
+                * self.cost_scale
+                + self.extra_delay_s
+            )
+            return [True] * len(payloads)
+
+        return run
+
+    # ---------------------------------------------------------------- faults
+    def _tick_faults(self) -> None:
+        """One span-output decode reply on this server: advance the
+        scenario's scripted-fault counters exactly like the wire plan
+        does, and apply whatever came due."""
+        if self.faults is None:
+            return
+        for f in self.faults.due((self.host, self.port)):
+            self.faults.log.append((f.at_step, f.action, f.port))
+            ledger.fault(f"wire.scheduled.{f.action}")
+            if f.action == "crash":
+                cb = self.faults._crash_cbs.get(f.target or self.server_id)
+                if cb is not None:
+                    cb()
+            elif f.action == "partition":
+                self._unreachable_until = clock.monotonic() + f.delay_s
+                logger.warning(
+                    "sim server %s partitioned for %.1fs", self.server_id,
+                    f.delay_s,
+                )
+            elif f.action == "delay":
+                self.extra_delay_s += f.delay_s  # creeping degradation
+
+    # ------------------------------------------------------------ announcing
+    def _advert_state(self) -> ServerState:
+        if self._standby and self._promoted:
+            return ServerState.DRAINING  # mid-demotion drain
+        if self._standby:
+            return ServerState.JOINING
+        return ServerState.ONLINE
+
+    def _server_info(self, state: ServerState) -> ServerInfo:
+        wait = self.compute.wait_stats_ms()
+        return ServerInfo(
+            state=state,
+            host=self.host,
+            port=self.port,
+            throughput=self.throughput,
+            inference_rps=self.throughput,
+            start_block=self.start_block,
+            end_block=self.end_block,
+            promoted_standby=self._promoted,
+            load={
+                "ts": clock.now(),
+                "delay_ms": self.compute.current_delay_ms(
+                    self.admission.window_s
+                ),
+                "queue_depth": float(self.compute.depth()),
+                "wait_ms": {"p50": wait["p50"], "p95": wait["p95"]},
+                "active_sessions": float(len(self._sessions)),
+                "shedding": self.admission.shedding,
+            },
+        )
+
+    async def _announce(self, state: ServerState) -> None:
+        if not self.reachable():  # a partitioned server can't reach the
+            return  # registry either: its lease just ages out
+        await self.registry.declare_blocks(
+            self.model_uid, self.server_id,
+            range(self.start_block, self.end_block),
+            self._server_info(state), expiration=self.lease_s,
+        )
+
+    async def _announce_loop(self) -> None:
+        while not self._crashed:
+            try:
+                await self._announce(self._advert_state())
+            except Exception as e:  # registry flap: next period retries
+                logger.warning("announce failed: %s", e)
+            await clock.async_sleep(self.announce_period)
+
+    # ------------------------------------------------------------- rebalance
+    async def _rebalance_loop(self) -> None:
+        while not self._crashed:
+            await clock.async_sleep(self.rebalance_period)
+            if self._standby or self._draining or not self.reachable():
+                continue
+            try:
+                await rebalance_if_needed(self)
+            except Exception as e:
+                logger.warning("rebalance failed: %s", e)
+
+    async def rebalance_to(self, start: int, end: int) -> None:
+        """Move this server's span: revoke the old lease, flip bounds,
+        re-announce — the sim analogue of drain + reload + re-announce."""
+        old = (self.start_block, self.end_block)
+        await self.registry.revoke_blocks(
+            self.model_uid, self.server_id, range(*old)
+        )
+        self.start_block, self.end_block = int(start), int(end)
+        self.rebalance_last_move_at = clock.monotonic()
+        ledger.recovery("server.rebalance_reannounce")
+        logger.warning(
+            "sim server %s rebalanced [%d:%d) -> [%d:%d)", self.server_id,
+            old[0], old[1], start, end,
+        )
+        await self._announce(self._advert_state())
+
+    # ----------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        """rpc_info-shaped counter surface (health --probe house style)."""
+        return {
+            "server_id": self.server_id,
+            "span": [self.start_block, self.end_block],
+            "state": self._advert_state().name,
+            "crashed": self._crashed,
+            "promotions": self.promotions,
+            "demotions": self.demotions,
+            "promotions_yielded": self.promotions_yielded,
+            "demotions_aborted": self.demotions_aborted,
+            "rebalances_moved": self.rebalances_moved,
+            "rebalances_failed": self.rebalances_failed,
+            "rebalance_skipped_hysteresis": self.rebalance_skipped_hysteresis,
+            "admission": self.admission.stats(),
+            "queue_wait_ms": self.compute.wait_stats_ms(),
+        }

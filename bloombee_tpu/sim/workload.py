@@ -1,0 +1,130 @@
+"""Generative workloads: session populations with realistic structure.
+
+Serving workloads are not Poisson-with-fixed-shapes: prompt lengths are
+heavy-tailed (log-normal — a few huge documents dominate prefill work),
+agent loops re-send a long shared system prompt (prefix-cache hits in
+production, modeled as skipped prefill tokens), arrival rates breathe
+diurnally, and the interesting failures start with a flash crowd — a
+burst of arrivals compressed into seconds. Every generator is seeded and
+deterministic: the same (kind, n, seed) always yields the same sessions,
+so gate failures reproduce bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+from bloombee_tpu.sim.client import SessionSpec
+
+
+def _shapes(rng: random.Random, i: int, num_clients: int,
+            agent_frac: float, patience_s: float) -> dict:
+    prompt = int(min(2048, max(16, rng.lognormvariate(math.log(120), 0.8))))
+    decode = int(min(64, max(4, rng.expovariate(1.0 / 10.0))))
+    shared = 0
+    if rng.random() < agent_frac:
+        # agent loop: a long shared system prompt dominates the prompt
+        # and prefills from cache (only the tail is new work)
+        prompt = max(prompt, 256)
+        shared = int(prompt * 0.8)
+    return dict(
+        session_id=f"s{i}",
+        client_id=f"c{i % num_clients}",
+        prompt_tokens=prompt,
+        decode_tokens=decode,
+        shared_prefix_tokens=shared,
+        patience_s=patience_s,
+    )
+
+
+def poisson_sessions(
+    n: int, horizon_s: float, seed: int = 0, num_clients: int = 20,
+    agent_frac: float = 0.3, patience_s: float = 120.0,
+) -> list[SessionSpec]:
+    """Constant-rate Poisson arrivals over `horizon_s`."""
+    rng = random.Random(seed)
+    rate = n / max(1e-9, horizon_s)
+    t, out = 0.0, []
+    for i in range(n):
+        t += rng.expovariate(rate)
+        out.append(SessionSpec(
+            arrival_s=min(t, horizon_s),
+            **_shapes(rng, i, num_clients, agent_frac, patience_s),
+        ))
+    return out
+
+
+def diurnal_sessions(
+    n: int, horizon_s: float, seed: int = 0, num_clients: int = 20,
+    agent_frac: float = 0.3, patience_s: float = 120.0,
+    trough_frac: float = 0.1,
+) -> list[SessionSpec]:
+    """Inhomogeneous Poisson via thinning: rate ramps from a trough up to
+    a peak at horizon/2 and back down (one simulated day)."""
+    rng = random.Random(seed)
+    # peak rate sized so the thinned total comes out near n
+    mean_frac = trough_frac + (1.0 - trough_frac) / 2.0
+    peak = n / max(1e-9, horizon_s * mean_frac)
+    t, i, out = 0.0, 0, []
+    while i < n:
+        t += rng.expovariate(peak)
+        # sin^2 is periodic: arrivals that spill past horizon_s simply
+        # land in the next day's ramp, guaranteeing exactly n sessions
+        frac = trough_frac + (1.0 - trough_frac) * (
+            math.sin(math.pi * t / horizon_s) ** 2
+        )
+        if rng.random() > frac:
+            continue  # thinned away: off-peak lull
+        out.append(SessionSpec(
+            arrival_s=t,
+            **_shapes(rng, i, num_clients, agent_frac, patience_s),
+        ))
+        i += 1
+    return out
+
+
+def flash_crowd_sessions(
+    n: int, horizon_s: float, seed: int = 0, num_clients: int = 20,
+    agent_frac: float = 0.3, patience_s: float = 120.0,
+    crowd_n: int = 100, crowd_at_s: float | None = None,
+    crowd_width_s: float = 3.0,
+) -> list[SessionSpec]:
+    """Baseline Poisson traffic plus a flash crowd of ``crowd_n``
+    sessions (capped at half of n) landing inside a seconds-wide window.
+    The crowd is ABSOLUTE, not a fraction of daily traffic — "the site
+    got linked" is the same size event whatever the background rate — so
+    the queue backlog it builds, and therefore the overload physics the
+    gates score, is identical between a smoke run and the CI-sized one.
+    What the gate scores is the AFTERMATH: does shedding converge, or do
+    abandon-and-retry clients feed the very queue that sheds them?"""
+    rng = random.Random(seed)
+    crowd = min(int(crowd_n), n // 2)
+    base = poisson_sessions(
+        n - crowd, horizon_s, seed=seed + 1, num_clients=num_clients,
+        agent_frac=agent_frac, patience_s=patience_s,
+    )
+    at = horizon_s * 0.4 if crowd_at_s is None else crowd_at_s
+    out = list(base)
+    for j in range(crowd):
+        i = len(base) + j
+        shape = _shapes(rng, i, num_clients, 0.0, patience_s)
+        # crowd arrivals are NEW users behind a gateway: substantial
+        # prompts, nothing in any prefix cache, a separate client pool,
+        # and NO SDK penalty machinery — they honor only the server's
+        # Retry-After hint (naive), which is what makes a mis-tuned
+        # admission retry knob a retry storm instead of a non-event
+        # floor sized so the spike clearly crosses even the under-share
+        # hard watermark (4x BBTPU_ADMIT_HIGH_MS): fresh crowd clients
+        # carry no fair-share debt, so the real admission controller is
+        # deliberately lenient with them until the queue is deeply backed
+        # up — that leniency is part of what the gate must see through
+        shape["prompt_tokens"] = max(800, shape["prompt_tokens"])
+        shape["shared_prefix_tokens"] = 0
+        shape["client_id"] = f"crowd{j % 10}"
+        out.append(SessionSpec(
+            arrival_s=at + rng.random() * crowd_width_s, naive=True,
+            **shape,
+        ))
+    out.sort(key=lambda s: s.arrival_s)
+    return out

@@ -149,6 +149,10 @@ class SteppableClock(Clock):
         self._seq = itertools.count()
         # (virtual deadline, seq, loop, future) min-heap of async sleepers
         self._async_waiters: list = []
+        # virtual deadlines of threads currently blocked in sleep();
+        # sim engines read these (via next_deadline/blocked_sleepers) to
+        # decide how far to auto-advance without overshooting a waker
+        self._sync_deadlines: dict = {}
 
     def time(self) -> float:
         with self._cond:
@@ -178,10 +182,32 @@ class SteppableClock(Clock):
             )
 
     def sleep(self, seconds: float) -> None:
+        key = (threading.get_ident(), next(self._seq))
         with self._cond:
             deadline = self._now + max(0.0, seconds)
-            while self._now < deadline:
-                self._cond.wait()
+            self._sync_deadlines[key] = deadline
+            try:
+                while self._now < deadline:
+                    self._cond.wait()
+            finally:
+                del self._sync_deadlines[key]
+
+    def next_deadline(self) -> float | None:
+        """Earliest virtual deadline any sleeper (sync or async) is
+        waiting for, or None when nobody is sleeping. A discrete-event
+        driver advances exactly to this instant so no sleeper oversleeps
+        virtual time."""
+        with self._cond:
+            cands = list(self._sync_deadlines.values())
+            if self._async_waiters:
+                cands.append(self._async_waiters[0][0])
+            return min(cands) if cands else None
+
+    def blocked_sleepers(self) -> int:
+        """Number of threads currently blocked inside sleep() (async
+        sleepers are visible via next_deadline, not counted here)."""
+        with self._cond:
+            return len(self._sync_deadlines)
 
     async def async_sleep(self, seconds: float) -> None:
         if seconds <= 0:

@@ -81,6 +81,9 @@ def import_declaring_modules() -> None:
     import bloombee_tpu.server.artifacts  # noqa: F401
     import bloombee_tpu.server.block_selection  # noqa: F401
     import bloombee_tpu.server.block_server  # noqa: F401
+    import bloombee_tpu.sim.cost  # noqa: F401
+    import bloombee_tpu.sim.metrics  # noqa: F401
+    import bloombee_tpu.sim.scenarios  # noqa: F401
     import bloombee_tpu.utils.clock  # noqa: F401
     import bloombee_tpu.utils.jitwatch  # noqa: F401
     import bloombee_tpu.utils.ledger  # noqa: F401

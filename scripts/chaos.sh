@@ -60,6 +60,16 @@
 #                frames; the test's own plan adds seeded reset + in-flight
 #                corruption with the integrity layer on, so the ledger
 #                gate proves decode survived the codec pool under faults
+#   SIM          1 = swarm-simulator entry: replay the virtual-clock
+#                traffic simulator's scenario sweep (`python -m
+#                bloombee_tpu.sim --require --smoke`) INSTEAD of a pytest
+#                leg, appending to the SAME per-entry ledger, so the
+#                metastable-convergence gates (shed settle, retry
+#                amplification, promotion latency, starvation) block the
+#                chaos gate and the ledger proves the scripted crashes,
+#                promotions, and rebalances actually ran. Runs with stock
+#                tuning (its gates define healthy for the DEFAULT knobs),
+#                not the entry's chaos env
 #   TESTS        comma-separated test-file list for this entry (default:
 #                the whole chaos-marked suite). Feature entries target the
 #                files that actually exercise their flags — the per-entry
@@ -108,15 +118,17 @@ MATRIX=(
     "SEED=71 DELAY_P=0.02 ARTIFACT=1 JITWATCH=1 TESTS=tests/test_artifact_cache.py"
     "SEED=67 DELAY_P=0.02 UNIRAGGED=1 JITWATCH=1 TESTS=tests/test_universal_ragged.py,tests/test_mixed_batch.py,tests/test_spec_decode.py,tests/test_batched_decode.py,tests/test_chunked_prefill.py,tests/test_jitwatch.py,tests/test_chaos.py"
     "SEED=41 DELAY_P=0.05 CORRUPT=0.05 CODEC=1 TESTS=tests/test_wire_pipeline.py"
+    "SEED=29 SIM=1"
 )
 for entry in "${MATRIX[@]}"; do
     # per-entry defaults; each entry overrides only what it varies
     SEED=0 DELAY_P=0 ADMIT=0 PARTITION_P=0 MIXED=0 SPEC=0 REBALANCE=0
     CORRUPT=0 LOCKWATCH=0 JITWATCH=0 ARTIFACT=0 UNIRAGGED=0 CODEC=0
+    SIM=0
     TESTS=tests/
     for tok in ${entry}; do
         case "${tok%%=*}" in
-            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|LOCKWATCH|JITWATCH|ARTIFACT|UNIRAGGED|CODEC|TESTS)
+            SEED|DELAY_P|ADMIT|PARTITION_P|MIXED|SPEC|REBALANCE|CORRUPT|LOCKWATCH|JITWATCH|ARTIFACT|UNIRAGGED|CODEC|SIM|TESTS)
                 declare "${tok}" ;;
             *)
                 echo "chaos: unknown matrix token '${tok}'" >&2
@@ -195,13 +207,27 @@ BBTPU_WIRE_PIPELINE_INLINE=${wire_inline}"
     entry_start=${SECONDS}
     rc=0
     test_targets="${TESTS//,/ }"
-    env ${env_line} BBTPU_CHAOS_LEDGER="${ledger_file}" \
-        BBTPU_LOCKWATCH_REPORT="${lockwatch_file}" \
-        BBTPU_JITWATCH_REPORT="${jitwatch_file}" \
-        JAX_COMPILATION_CACHE_DIR="${compile_cache}" \
-        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0.5 \
-        python -m pytest ${test_targets} -q -m chaos \
-        -p no:cacheprovider -p no:xdist -p no:randomly "$@" || rc=$?
+    if [ "${SIM}" != "0" ]; then
+        # the SIM entry replays the simulator's own CI gate instead of a
+        # pytest leg (tier-1 already runs tests/test_sim.py; replaying it
+        # here would double-pay its wall cost for zero new coverage).
+        # Stock tuning on purpose: the --require gates define "healthy"
+        # for the DEFAULT knobs, so the chaos env would make a red
+        # un-attributable. Same ledger file so the vacuity gate below
+        # sees the sim's scripted crashes/promotions/rebalances
+        env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+            BBTPU_CHAOS_LEDGER="${ledger_file}" \
+            BBTPU_SIM_SEED="${SEED}" \
+            python -m bloombee_tpu.sim --require --smoke >&2 || rc=$?
+    else
+        env ${env_line} BBTPU_CHAOS_LEDGER="${ledger_file}" \
+            BBTPU_LOCKWATCH_REPORT="${lockwatch_file}" \
+            BBTPU_JITWATCH_REPORT="${jitwatch_file}" \
+            JAX_COMPILATION_CACHE_DIR="${compile_cache}" \
+            JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0.5 \
+            python -m pytest ${test_targets} -q -m chaos \
+            -p no:cacheprovider -p no:xdist -p no:randomly "$@" || rc=$?
+    fi
     # the ARTIFACT entry pins both gates to the artifact paths it exists
     # to exercise: the corrupt/declined fallback must have LEDGERED, and
     # the pre-installed standby must have warmed up from cache hits alone
@@ -228,8 +254,13 @@ server.artifact_fallback_compile"
     if [ "${rc}" -ne 0 ]; then
         echo "chaos: RED entry '${entry}' after ${elapsed}s" >&2
         echo "chaos: reproduce with:" >&2
-        echo "  ${env_line} python -m pytest ${test_targets} -q -m chaos" \
-             "-p no:cacheprovider -p no:xdist -p no:randomly" >&2
+        if [ "${SIM}" != "0" ]; then
+            echo "  BBTPU_SIM_SEED=${SEED}" \
+                 "python -m bloombee_tpu.sim --require --smoke" >&2
+        else
+            echo "  ${env_line} python -m pytest ${test_targets} -q -m chaos" \
+                 "-p no:cacheprovider -p no:xdist -p no:randomly" >&2
+        fi
         rm -f "${ledger_file}" "${lockwatch_file}" "${jitwatch_file}"
         exit "${rc}"
     fi

@@ -245,6 +245,14 @@ def test_server_aborts_expired_deadline_work(tiny_model_dir):
     in-budget step on the same session still answers."""
     model_dir, _, config = tiny_model_dir
 
+    # scaled virtual clock: the jam duration and the step's budget are
+    # both virtual, so the expiry ordering is identical at 1/3 the wall
+    # time (the pickup sleeps below stay real — they wait on the worker
+    # thread, not on protocol time — and burn 0.3 virtual seconds each,
+    # which the jam length must comfortably cover)
+    from bloombee_tpu.utils import clock as vclock
+    from bloombee_tpu.utils.clock import ScaledClock
+
     async def run():
         s = _server(model_dir, None, 0, 3)
         await s.start()
@@ -256,7 +264,7 @@ def test_server_aborts_expired_deadline_work(tiny_model_dir):
         # jam the single compute worker: the next step sits in queue while
         # its budget burns (the stalled-client scenario, server side)
         jam = asyncio.create_task(
-            s.compute.submit(PRIORITY_INFERENCE, time.sleep, 0.6)
+            s.compute.submit(PRIORITY_INFERENCE, vclock.sleep, 0.9)
         )
         await asyncio.sleep(0.1)  # the jam is now running on the worker
         hidden = np.zeros((1, 2, config.hidden_size), np.float32)
@@ -288,7 +296,11 @@ def test_server_aborts_expired_deadline_work(tiny_model_dir):
         await conn.close()
         await s.stop()
 
-    asyncio.run(run())
+    prev = vclock.install(ScaledClock(scale=3.0))
+    try:
+        asyncio.run(run())
+    finally:
+        vclock.install(prev)
 
 
 # ------------------------------------------------------------- graceful drain

@@ -27,7 +27,7 @@ def test_matrix_entries_are_keyval_tokens():
     known = {
         "SEED", "DELAY_P", "ADMIT", "PARTITION_P", "MIXED", "SPEC",
         "REBALANCE", "CORRUPT", "LOCKWATCH", "JITWATCH", "ARTIFACT",
-        "UNIRAGGED", "CODEC", "TESTS",
+        "UNIRAGGED", "CODEC", "SIM", "TESTS",
     }
     for entry in entries:
         for tok in entry.split():
@@ -37,6 +37,11 @@ def test_matrix_entries_are_keyval_tokens():
             )
     assert any("CORRUPT=" in e for e in entries), (
         "no Byzantine corruption entry in the chaos matrix"
+    )
+    # the swarm-simulator entry replays the metastable-convergence gate
+    # (python -m bloombee_tpu.sim --require --smoke) on every chaos run
+    assert any("SIM=" in e for e in entries), (
+        "no swarm-simulator entry in the chaos matrix"
     )
     # at least one BROAD entry must replay the whole chaos-marked suite:
     # targeted feature entries (TESTS=...) keep the gate inside its wall

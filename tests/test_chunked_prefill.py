@@ -382,6 +382,9 @@ def test_deadline_abort_mid_stream_frees_partial_pages(
     step is dropped (deadlines_expired counts it, no reply is sent) and
     the rollback frees every speculative page the completed chunks wrote —
     the handle is back at zero context with zero referenced pages."""
+    from bloombee_tpu.utils import clock as vclock
+    from bloombee_tpu.utils.clock import ScaledClock
+
     model_dir, _, config = tiny_model_dir
 
     class FakeStream:
@@ -403,7 +406,10 @@ def test_deadline_abort_mid_stream_frees_partial_pages(
             orig = s.executor.prefill_chunk
 
             def slow_chunk(handle, hidden, **kw):
-                time.sleep(0.06)  # 4 chunks x 60 ms >> the 100 ms budget
+                # 4 chunks x 60 virtual ms >> the 100 ms budget; the
+                # sleep runs on the installed (scaled) clock so the wall
+                # cost halves while the deadline math stays identical
+                vclock.sleep(0.06)
                 return orig(handle, hidden, **kw)
 
             monkeypatch.setattr(s.executor, "prefill_chunk", slow_chunk)
@@ -428,4 +434,11 @@ def test_deadline_abort_mid_stream_frees_partial_pages(
             await s.stop()
             await reg.stop()
 
-    asyncio.run(run())
+    # deadline_s, the chunk sleeps, and the server's expiry check all
+    # read the same installed clock, so a 2x scale preserves every
+    # comparison while halving the real sleeping
+    prev = vclock.install(ScaledClock(scale=2.0))
+    try:
+        asyncio.run(run())
+    finally:
+        vclock.install(prev)

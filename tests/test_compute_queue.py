@@ -584,14 +584,19 @@ def test_chunk_stream_interleaves_queued_decodes():
     queued while chunk N occupies the worker runs BEFORE chunk N+1 —
     decodes land between chunks instead of waiting out the whole prompt."""
 
+    from bloombee_tpu.utils import clock as vclock
+    from bloombee_tpu.utils.clock import ScaledClock
+
     async def run():
         q = ComputeQueue()
         q.start()
         order = []
-        t0 = time.monotonic()
+        t0 = vclock.monotonic()
 
         def work(tag):
-            time.sleep(0.02)  # occupy the worker like a device dispatch
+            # occupy the worker like a device dispatch — on the scaled
+            # clock, so the interleaving stays but the waiting shrinks
+            vclock.sleep(0.02)
             order.append(tag)
 
         async def chunk_stream():
@@ -628,4 +633,8 @@ def test_chunk_stream_interleaves_queued_decodes():
         assert stats["decode"] != {"p50": 0.0, "p95": 0.0} or order
         await q.stop()
 
-    asyncio.run(run())
+    prev = vclock.install(ScaledClock(scale=4.0))
+    try:
+        asyncio.run(run())
+    finally:
+        vclock.install(prev)

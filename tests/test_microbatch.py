@@ -85,6 +85,9 @@ def test_microbatch_overlap_beats_serial(tiny):
     """Inject compute delay proportional to chunk rows; the 2-chunk pipeline
     across 2 servers must finish decode faster than whole-batch serial
     (total step time < sum of span compute times)."""
+    from bloombee_tpu.utils import clock as vclock
+    from bloombee_tpu.utils.clock import ScaledClock
+
     model_dir, _, config = tiny
     PER_ROW = 0.04
     B, STEPS = 4, 6
@@ -93,7 +96,10 @@ def test_microbatch_overlap_beats_serial(tiny):
         orig = server.executor.decode
 
         def wrapper(handle, hidden, **kw):
-            time.sleep(PER_ROW * hidden.shape[0])
+            # injected per-row delay on the scaled clock: the 2x scale
+            # halves the wall cost of both runs while leaving their
+            # RATIO (what the assertion compares) untouched
+            vclock.sleep(PER_ROW * hidden.shape[0])
             return orig(handle, hidden, **kw)
 
         server.executor.decode = wrapper
@@ -129,12 +135,17 @@ def test_microbatch_overlap_beats_serial(tiny):
         await reg.stop()
         return elapsed, np.asarray(out)
 
-    serial_t, serial_out = asyncio.run(run(1))
-    pipe_t, pipe_out = asyncio.run(run(2))
+    prev = vclock.install(ScaledClock(scale=2.0))
+    try:
+        serial_t, serial_out = asyncio.run(run(1))
+        pipe_t, pipe_out = asyncio.run(run(2))
+    finally:
+        vclock.install(prev)
     np.testing.assert_allclose(pipe_out, serial_out, atol=1e-5, rtol=1e-5)
     # serial: STEPS * 2 spans * B*PER_ROW = 6*2*0.16 = 1.92s of injected
-    # delay; pipelined ideal = 6 * 3 slots * 0.08 = 1.44s (+ overhead) —
-    # a ~0.5s margin so scheduler noise can't flip the comparison
+    # (virtual) delay; pipelined ideal = 6 * 3 slots * 0.08 = 1.44s
+    # (+ overhead) — a ~0.5s virtual (0.25s wall at 2x) margin so
+    # scheduler noise can't flip the comparison
     assert pipe_t < serial_t * 0.92, (pipe_t, serial_t)
 
 

@@ -145,15 +145,24 @@ def test_load_aware_off_ignores_adverts():
 
 # ------------------------------------------------- overload penalty class
 def test_overload_penalty_excludes_then_readmits():
-    m = _manager(overload_timeout=0.05, overload_max=0.1)
-    m.spans = {"a": _span("a", 0, 2), "b": _span("b", 0, 2)}
-    m.note_peer_overloaded("a")
-    route = m.make_sequence()
-    assert [s.peer_id for s in route] == ["b"]
-    time.sleep(0.15)
-    # expired: the peer is routable again (half-open probe)
-    now = time.monotonic()
-    assert not m._ban_excludes("a", now)
+    # hand-stepped clock: the backoff expiry is a pure state transition,
+    # no real waiting needed
+    from bloombee_tpu.utils import clock
+    from bloombee_tpu.utils.clock import SteppableClock
+
+    c = SteppableClock()
+    prev = clock.install(c)
+    try:
+        m = _manager(overload_timeout=0.05, overload_max=0.1)
+        m.spans = {"a": _span("a", 0, 2), "b": _span("b", 0, 2)}
+        m.note_peer_overloaded("a")
+        route = m.make_sequence()
+        assert [s.peer_id for s in route] == ["b"]
+        c.advance(0.15)
+        # expired: the peer is routable again (half-open probe)
+        assert not m._ban_excludes("a", clock.monotonic())
+    finally:
+        clock.install(prev)
 
 
 def test_overload_is_shorter_class_than_fault_ban():

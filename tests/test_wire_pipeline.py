@@ -578,11 +578,16 @@ def test_chaos_decode_through_forced_codec_pool(tiny_model_dir, monkeypatch):
         stats = [s.rpc.pipeline_stats() for s in servers]
         assert any(p["enabled"] for p in stats), stats
         assert sum(p["rx_jobs"] for p in stats) > 0, stats
+        final_ports = {sp.span.server_info.port for sp in session._spans}
         await session.__aexit__(None, None, None)
 
         # the faults landed
         actions = {(site, act) for site, act, _ in plan.log}
-        assert ("send", "reset") in actions
+        # the one legitimate excuse for an unfired reset: the matrix's
+        # ambient corruption banned the preferred tail before its 3rd
+        # send, so the session finished the decode on the reroute target
+        # and the port-pinned rule had no traffic left to hit
+        assert ("send", "reset") in actions or s_b.port not in final_ports
         assert ("send", "delay") in actions
         assert ("send", "corrupt") in actions
         # the corruption was CAUGHT (digest mismatch -> replay), not
